@@ -1,0 +1,27 @@
+"""jepsen_tpu — a TPU-native framework for black-box safety testing of
+distributed systems.
+
+Re-architecture of the Jepsen ecosystem (jepsen-io/jepsen and its satellite
+libraries elle, knossos, io.jepsen/history — see SURVEY.md) designed for
+TPUs from scratch:
+
+- **History substrate** (`jepsen_tpu.history`): histories as structure-of-array
+  device tensors (mirrors `jepsen.history`'s dense Op vectors + pair index).
+- **Checkers** (`jepsen_tpu.checkers`): Elle-style transactional isolation
+  checking (dependency-edge inference under vmap + cycle detection as a
+  blocked-scan label-propagation kernel feeding the MXU) and Knossos-style
+  linearizability checking (memoized model + batched frontier search).
+- **Generator DSL + interpreter** (`jepsen_tpu.generator`): pure generators,
+  threaded workers (mirrors `jepsen.generator` / `generator/interpreter.clj`).
+- **Fault injection** (`jepsen_tpu.nemesis`): partitions, kill/pause, clock
+  skew, file corruption (mirrors `jepsen.nemesis`, `jepsen.net`).
+- **Control plane** (`jepsen_tpu.control`): pluggable Remote protocol
+  (mirrors `jepsen.control`).
+- **Store** (`jepsen_tpu.store`): two-phase persistent runs with chunked
+  binary histories (mirrors `jepsen.store` / `store/format.clj`).
+
+The checkers are the TPU-resident heart; everything else is host-side
+orchestration, as in the reference (SURVEY.md §1: L2-L3 are pure).
+"""
+
+__version__ = "0.1.0"

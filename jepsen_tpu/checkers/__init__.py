@@ -1,0 +1,5 @@
+"""Checkers: the L3 layer (SURVEY.md §2.1 checker API, §2.3 Elle, §2.4 Knossos)."""
+
+from jepsen_tpu.checkers.api import Checker, check_safe, compose
+
+__all__ = ["Checker", "check_safe", "compose"]

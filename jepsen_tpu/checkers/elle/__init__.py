@@ -1,0 +1,6 @@
+"""Elle-style transactional isolation checking (SURVEY.md §2.3).
+
+`oracle` is the exact host reference implementation (clarity over speed);
+`device` is the TPU pipeline (edge inference + blocked-scan cycle kernel)
+differentially tested against it.
+"""

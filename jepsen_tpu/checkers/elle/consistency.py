@@ -1,0 +1,146 @@
+"""Consistency-model lattice.
+
+Equivalent of the reference's `elle/consistency_model.clj` (SURVEY.md §2.3):
+a DAG of consistency models ordered by strength, a mapping from observed
+anomalies to the models they rule out, and `friendly_boundary` reporting —
+"not(serializable) but maybe(snapshot-isolation)".
+
+The model set is the load-bearing core of the reference's ~40-model lattice
+(Adya PL levels, the snapshot-isolation family, session/strong variants).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Set
+
+# model -> models it directly implies (stronger -> weaker edges)
+IMPLIES: Dict[str, List[str]] = {
+    "strict-serializable": ["serializable", "strong-session-serializable",
+                            "strong-snapshot-isolation", "linearizable"],
+    "strong-session-serializable": ["serializable"],
+    "serializable": ["repeatable-read", "view-serializable", "read-atomic"],
+    "view-serializable": [],
+    "repeatable-read": ["cursor-stability", "consistent-view"],
+    "strong-snapshot-isolation": ["snapshot-isolation",
+                                  "strong-session-snapshot-isolation"],
+    "strong-session-snapshot-isolation": ["snapshot-isolation"],
+    "snapshot-isolation": ["consistent-view", "monotonic-atomic-view",
+                           "read-atomic"],
+    "consistent-view": ["monotonic-view"],
+    "monotonic-view": ["read-committed"],
+    "cursor-stability": ["read-committed"],
+    "causal-cerone": ["read-atomic"],
+    "parallel-snapshot-isolation": ["causal-cerone"],
+    "read-atomic": ["monotonic-atomic-view"],
+    "monotonic-atomic-view": ["read-committed"],
+    "read-committed": ["read-uncommitted"],
+    "read-uncommitted": [],
+    "linearizable": [],
+}
+
+ALL_MODELS = sorted(IMPLIES.keys())
+
+# Canonical aliases users may pass (reference supports many).
+ALIASES = {
+    "strict-1SR": "strict-serializable",
+    "strong-serializable": "strict-serializable",
+    "PL-3": "serializable",
+    "PL-2.99": "repeatable-read",
+    "PL-2+": "consistent-view",
+    "PL-2": "read-committed",
+    "PL-1": "read-uncommitted",
+    "SI": "snapshot-isolation",
+    "serializability": "serializable",
+}
+
+# model -> anomalies it directly proscribes (closed downward over IMPLIES:
+# a model also proscribes everything its weaker models do).
+PROSCRIBED: Dict[str, Set[str]] = {
+    "read-uncommitted": {"G0", "duplicate-elements", "incompatible-order",
+                         "cyclic-versions"},
+    "read-committed": {"G1a", "G1b", "G1c", "dirty-update", "aborted-read",
+                       "intermediate-read"},
+    "monotonic-atomic-view": {"monotonic-atomic-view-violation"},
+    "read-atomic": {"internal", "fractured-read"},
+    "causal-cerone": {"G1c-process", "G0-process"},
+    "parallel-snapshot-isolation": set(),
+    "monotonic-view": set(),
+    "consistent-view": {"G-single"},
+    "cursor-stability": {"G-cursor", "lost-update"},
+    "snapshot-isolation": {"G-single", "G-SI", "lost-update"},
+    "repeatable-read": {"G2-item", "lost-update"},
+    "serializable": {"G2-item", "G2", "G-nonadjacent", "G-single"},
+    "view-serializable": {"G2-item"},
+    "strong-session-serializable": {"G2-item-process", "G-single-process",
+                                    "G1c-process", "G0-process"},
+    "strong-session-snapshot-isolation": {"G-single-process", "G1c-process"},
+    "strong-snapshot-isolation": {"G-single-realtime", "G1c-realtime"},
+    "strict-serializable": {"G2-item-realtime", "G-single-realtime",
+                            "G1c-realtime", "G0-realtime",
+                            "G-nonadjacent-realtime"},
+    "linearizable": set(),
+}
+
+
+def canonical(model: str) -> str:
+    m = ALIASES.get(model, model)
+    if m not in IMPLIES:
+        raise ValueError(f"unknown consistency model {model!r}")
+    return m
+
+
+def _descendants(model: str) -> Set[str]:
+    """All models implied by `model` (including itself)."""
+    seen: Set[str] = set()
+    stack = [model]
+    while stack:
+        m = stack.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        stack.extend(IMPLIES[m])
+    return seen
+
+
+_DESC: Dict[str, FrozenSet[str]] = {m: frozenset(_descendants(m)) for m in IMPLIES}
+
+
+def proscribed_anomalies(model: str) -> Set[str]:
+    """Every anomaly that rules out `model` (its own + all weaker models')."""
+    out: Set[str] = set()
+    for m in _DESC[canonical(model)]:
+        out |= PROSCRIBED[m]
+    return out
+
+
+def anomaly_impossible_models(anomalies: Iterable[str]) -> Set[str]:
+    """All models ruled out by any of the observed anomalies."""
+    obs = set(anomalies)
+    return {m for m in IMPLIES if proscribed_anomalies(m) & obs}
+
+
+def friendly_boundary(anomalies: Iterable[str]) -> Dict[str, List[str]]:
+    """Reference `elle.consistency-model/friendly-boundary`:
+
+    {:not        — the weakest violated models (the informative boundary)
+     :also-not   — all other violated models}
+    """
+    impossible = anomaly_impossible_models(anomalies)
+    # minimal (weakest) violated: no other violated model is implied by it
+    boundary = set()
+    for m in impossible:
+        weaker = _DESC[m] - {m}
+        if not (weaker & impossible):
+            boundary.add(m)
+    return {
+        "not": sorted(boundary),
+        "also-not": sorted(impossible - boundary),
+    }
+
+
+def anomalies_for_models(models: Iterable[str]) -> Set[str]:
+    """Which anomalies must be searched for to validate `models`."""
+    out: Set[str] = set()
+    for m in models:
+        out |= proscribed_anomalies(m)
+    return out

@@ -1,0 +1,40 @@
+"""History substrate: the L2 layer (SURVEY.md §2.2).
+
+Mirrors the capability surface of the `io.jepsen/history` library
+(`jepsen/history.clj`): Op records, dense/sparse histories, O(1) pair
+index / invocation / completion lookup, lazy filters — plus the TPU-native
+part: flattening histories into structure-of-array device tensors
+(`jepsen_tpu.history.soa`) and folds as device segment reductions
+(`jepsen_tpu.history.fold`).
+"""
+
+from jepsen_tpu.history.ops import (
+    Op,
+    History,
+    history,
+    invoke,
+    ok,
+    fail,
+    info,
+    INVOKE,
+    OK,
+    FAIL,
+    INFO,
+)
+from jepsen_tpu.history.soa import PackedTxns, pack_txns
+
+__all__ = [
+    "Op",
+    "History",
+    "history",
+    "invoke",
+    "ok",
+    "fail",
+    "info",
+    "INVOKE",
+    "OK",
+    "FAIL",
+    "INFO",
+    "PackedTxns",
+    "pack_txns",
+]

@@ -1,0 +1,213 @@
+"""Structure-of-array packing of transactional histories.
+
+This is the TPU-native half of the history substrate (SURVEY.md §7 stage 1):
+a completed history is flattened into dense numpy/device arrays — the
+direct analogue of the reference's dense `jepsen.history` vectors, laid out
+so that Elle-style edge inference runs as vectorized segment ops on device.
+
+Layout (all int32 unless noted):
+
+  txn_*   — one row per completed client transaction (ok / fail / info):
+            type (i8: 1 ok, 2 fail, 3 info), process, invoke_pos /
+            complete_pos (event indices in the original history — these are
+            the realtime & process orders), orig_index (completion op index).
+  mop_*   — one row per micro-op, flattened across all txns in txn order:
+            txn (owner), kind (i8: 0 append/write, 1 read), key (dense id),
+            val (append/write value id; read value id for rw-register),
+            rd_start / rd_len (list-append read lists into rd_elems;
+            rd_len == -1 means the read's result is unknown — info/fail).
+  rd_elems — concatenated list-append read lists (value ids).
+
+Keys and values are remapped to dense ids; `key_names` / `val_names` map
+back for reporting.  Value ids are globally unique *per (key, value) pair*
+so that `(key, val_id)` identity is just `val_id` — list-append values are
+unique per key by generator contract, and the checker verifies duplicates
+anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu.history.ops import FAIL, INFO, INVOKE, OK, History, Op
+
+MOP_APPEND = 0  # also rw-register write
+MOP_READ = 1
+
+TXN_OK = 1
+TXN_FAIL = 2
+TXN_INFO = 3
+
+
+@dataclasses.dataclass
+class PackedTxns:
+    """A transactional history flattened to structure-of-arrays."""
+
+    # per-txn
+    txn_type: np.ndarray  # i8 [T]
+    txn_process: np.ndarray  # i32 [T]
+    txn_invoke_pos: np.ndarray  # i32 [T]
+    txn_complete_pos: np.ndarray  # i32 [T]
+    txn_orig_index: np.ndarray  # i32 [T]
+    # per-mop
+    mop_txn: np.ndarray  # i32 [M]
+    mop_kind: np.ndarray  # i8 [M]
+    mop_key: np.ndarray  # i32 [M]
+    mop_val: np.ndarray  # i32 [M]
+    mop_rd_start: np.ndarray  # i32 [M]
+    mop_rd_len: np.ndarray  # i32 [M]
+    rd_elems: np.ndarray  # i32 [R]
+    # id maps
+    key_names: List[Any]
+    val_names: List[Any]  # val id -> (key id, value)
+    n_events: int  # number of events in the original history
+
+    @property
+    def n_txns(self) -> int:
+        return len(self.txn_type)
+
+    @property
+    def n_mops(self) -> int:
+        return len(self.mop_txn)
+
+    @property
+    def n_keys(self) -> int:
+        return len(self.key_names)
+
+    @property
+    def n_vals(self) -> int:
+        return len(self.val_names)
+
+
+def _mops_of(op: Op) -> Sequence:
+    v = op.value
+    if v is None:
+        return []
+    if not isinstance(v, (list, tuple)):
+        raise ValueError(f"txn op value must be a list of mops, got {v!r}")
+    return v
+
+
+def pack_txns(h: History | Sequence[Op], workload: str = "list-append") -> PackedTxns:
+    """Flatten a history's completed client transactions to SoA arrays.
+
+    Follows the reference's semantics for op visibility (elle/list_append.clj):
+    - `ok` txns contribute their completion value (reads filled in);
+    - `info` txns contribute the *invocation*'s mops — their writes may have
+      committed, their reads are unknown;
+    - `fail` txns' writes are known-uncommitted (used for G1a); reads unknown.
+    """
+    if not isinstance(h, History):
+        ops = list(h)
+        # raw op sequences may lack indices; (re)index unless already indexed
+        h = History(ops, reindex=any(op.index < 0 for op in ops))
+
+    key_ids: dict = {}
+    key_names: List[Any] = []
+    val_ids: dict = {}  # (key_id, value) -> val id
+    val_names: List[Any] = []
+
+    def key_id(k) -> int:
+        i = key_ids.get(k)
+        if i is None:
+            i = len(key_names)
+            key_ids[k] = i
+            key_names.append(k)
+        return i
+
+    def val_id(ki: int, v) -> int:
+        i = val_ids.get((ki, v))
+        if i is None:
+            i = len(val_names)
+            val_ids[(ki, v)] = i
+            val_names.append((ki, v))
+        return i
+
+    txn_type: List[int] = []
+    txn_process: List[int] = []
+    txn_invoke_pos: List[int] = []
+    txn_complete_pos: List[int] = []
+    txn_orig_index: List[int] = []
+    mop_txn: List[int] = []
+    mop_kind: List[int] = []
+    mop_key: List[int] = []
+    mop_val: List[int] = []
+    mop_rd_start: List[int] = []
+    mop_rd_len: List[int] = []
+    rd_elems: List[int] = []
+
+    la = workload == "list-append"
+
+    for pos, op in enumerate(h.ops):
+        if op.type == INVOKE or not op.is_client_op():
+            continue
+        if op.type == OK:
+            ttype, mops, known_reads = TXN_OK, _mops_of(op), True
+        else:
+            inv = h.invocation(op)
+            src = inv if inv is not None else op
+            ttype = TXN_FAIL if op.type == FAIL else TXN_INFO
+            mops, known_reads = _mops_of(src), False
+        t = len(txn_type)
+        txn_type.append(ttype)
+        txn_process.append(int(op.process))
+        inv = h.invocation(op)
+        txn_invoke_pos.append(inv.index if inv is not None else pos)
+        txn_complete_pos.append(pos)
+        txn_orig_index.append(op.index)
+        for m in mops:
+            fkind = m[0]
+            k = key_id(m[1])
+            mop_txn.append(t)
+            mop_key.append(k)
+            if fkind in ("append", "w"):
+                mop_kind.append(MOP_APPEND)
+                mop_val.append(val_id(k, m[2]))
+                mop_rd_start.append(-1)
+                mop_rd_len.append(-1)
+            elif fkind == "r":
+                mop_kind.append(MOP_READ)
+                rv = m[2] if len(m) > 2 else None
+                if la:
+                    mop_val.append(-1)
+                    if known_reads and rv is not None:
+                        mop_rd_start.append(len(rd_elems))
+                        mop_rd_len.append(len(rv))
+                        rd_elems.extend(val_id(k, v) for v in rv)
+                    else:
+                        mop_rd_start.append(-1)
+                        mop_rd_len.append(-1)
+                else:  # rw-register: scalar read value (None -> unborn/-1)
+                    if known_reads:
+                        mop_val.append(-1 if rv is None else val_id(k, rv))
+                        mop_rd_len.append(0)
+                    else:
+                        mop_val.append(-1)
+                        mop_rd_len.append(-1)
+                    mop_rd_start.append(-1)
+            else:
+                raise ValueError(f"unknown mop kind {fkind!r}")
+
+    def a(x, dt=np.int32):
+        return np.asarray(x, dtype=dt)
+
+    return PackedTxns(
+        txn_type=a(txn_type, np.int8),
+        txn_process=a(txn_process),
+        txn_invoke_pos=a(txn_invoke_pos),
+        txn_complete_pos=a(txn_complete_pos),
+        txn_orig_index=a(txn_orig_index),
+        mop_txn=a(mop_txn),
+        mop_kind=a(mop_kind, np.int8),
+        mop_key=a(mop_key),
+        mop_val=a(mop_val),
+        mop_rd_start=a(mop_rd_start),
+        mop_rd_len=a(mop_rd_len),
+        rd_elems=a(rd_elems),
+        key_names=key_names,
+        val_names=val_names,
+        n_events=len(h.ops),
+    )

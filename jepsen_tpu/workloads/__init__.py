@@ -1,0 +1,1 @@
+"""Workloads (L5, SURVEY.md §2.6): test suites the framework expresses."""

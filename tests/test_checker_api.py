@@ -1,0 +1,68 @@
+"""Built-in checker tests (reference checker_test.clj style)."""
+
+from jepsen_tpu.checkers.api import (
+    CounterChecker, QueueChecker, SetChecker, Stats, UniqueIds,
+    check_safe, compose,
+)
+from jepsen_tpu.history import history, invoke, ok, fail, info
+
+
+def test_queue_info_enqueue_not_lost():
+    # an indeterminate enqueue that never appears is NOT lost
+    h = history([
+        invoke(0, "enqueue", 1),
+        info(0, "enqueue", 1),
+    ])
+    res = QueueChecker().check({}, h)
+    assert res["valid?"] is True
+    assert res["lost-count"] == 0
+
+
+def test_queue_lost_and_unexpected():
+    h = history([
+        invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+        invoke(1, "dequeue", None), ok(1, "dequeue", 7),
+    ])
+    res = QueueChecker().check({}, h)
+    assert res["valid?"] is False
+    assert res["lost"] == {1: 1}
+    assert res["unexpected"] == {7: 1}
+
+
+def test_set_checker():
+    h = history([
+        invoke(0, "add", 1), ok(0, "add", 1),
+        invoke(1, "add", 2), ok(1, "add", 2),
+        invoke(2, "add", 3), fail(2, "add", 3),
+        invoke(0, "read", None), ok(0, "read", [1]),
+    ])
+    res = SetChecker().check({}, h)
+    assert res["valid?"] is False
+    assert res["lost"] == [2]
+
+
+def test_counter_checker():
+    h = history([
+        invoke(0, "add", 1), ok(0, "add", 1),
+        invoke(1, "read", None), ok(1, "read", 1),
+        invoke(0, "add", 2), info(0, "add", 2),   # maybe applied
+        invoke(1, "read", None), ok(1, "read", 3),
+        invoke(2, "read", None), ok(2, "read", 1),
+        invoke(3, "read", None), ok(3, "read", 9),  # impossible
+    ])
+    res = CounterChecker().check({}, h)
+    assert res["valid?"] is False
+    assert len(res["errors"]) == 1 and res["errors"][0]["value"] == 9
+
+
+def test_stats_and_compose():
+    h = history([
+        invoke(0, "txn", None), ok(0, "txn", None),
+        invoke(1, "cas", None), fail(1, "cas", None),
+    ])
+    res = Stats().check({}, h)
+    assert res["valid?"] is False  # cas never succeeded
+    assert res["by-f"]["txn"]["ok-count"] == 1
+    combined = compose({"stats": Stats(), "uids": UniqueIds()})
+    out = check_safe(combined, {}, h)
+    assert out["valid?"] is False

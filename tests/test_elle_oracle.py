@@ -1,0 +1,294 @@
+"""Oracle list-append checker tests: one micro-history per anomaly,
+mirroring the reference's elle/list_append_test.clj strategy (SURVEY.md §4).
+"""
+
+import pytest
+
+from jepsen_tpu.checkers.elle import oracle
+from jepsen_tpu.history import history, invoke, ok, fail, info
+from jepsen_tpu.workloads import synth
+
+
+def txn_pair(process, mops_inv, mops_ok, t0=0):
+    return [
+        invoke(process, "txn", mops_inv),
+        ok(process, "txn", mops_ok),
+    ]
+
+
+def seq_history(*txns):
+    """Sequential (non-overlapping) history: txn i fully before txn i+1."""
+    ops = []
+    for i, (mops_inv, mops_ok) in enumerate(txns):
+        ops.append(invoke(i % 5, "txn", mops_inv))
+        if mops_ok == "fail":
+            ops.append(fail(i % 5, "txn", mops_inv))
+        elif mops_ok == "info":
+            ops.append(info(i % 5, "txn", None))
+        else:
+            ops.append(ok(i % 5, "txn", mops_ok))
+    return history(ops)
+
+
+def concurrent_history(*txns):
+    """All txns invoke first, then all complete (no realtime edges)."""
+    inv, comp = [], []
+    for i, (mops_inv, mops_ok) in enumerate(txns):
+        inv.append(invoke(i, "txn", mops_inv))
+        if mops_ok == "fail":
+            comp.append(fail(i, "txn", mops_inv))
+        else:
+            comp.append(ok(i, "txn", mops_ok))
+    return history(inv + comp)
+
+
+def test_valid_sequential():
+    h = seq_history(
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["r", "x", None]], [["r", "x", [1]]]),
+        ([["append", "x", 2]], [["append", "x", 2]]),
+        ([["r", "x", None]], [["r", "x", [1, 2]]]),
+    )
+    res = oracle.check(h, ["strict-serializable"])
+    assert res["valid?"] is True
+    assert res["anomaly-types"] == []
+
+
+def test_g1a_aborted_read():
+    h = seq_history(
+        ([["append", "x", 1]], "fail"),
+        ([["r", "x", None]], [["r", "x", [1]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert res["valid?"] is False
+    assert "G1a" in res["anomaly-types"]
+    assert "read-committed" in res["not"] + res["also-not"]
+
+
+def test_g1b_intermediate_read():
+    h = concurrent_history(
+        ([["append", "x", 1], ["append", "x", 2]],
+         [["append", "x", 1], ["append", "x", 2]]),
+        ([["r", "x", None]], [["r", "x", [1]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert res["valid?"] is False
+    assert "G1b" in res["anomaly-types"]
+
+
+def test_internal_inconsistency():
+    h = seq_history(
+        ([["append", "x", 5], ["r", "x", None]],
+         [["append", "x", 5], ["r", "x", [5, 7]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert "internal" in res["anomaly-types"]
+
+
+def test_duplicate_elements():
+    h = seq_history(
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["r", "x", None]], [["r", "x", [1, 1]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert "duplicate-elements" in res["anomaly-types"]
+
+
+def test_incompatible_order():
+    h = concurrent_history(
+        ([["r", "x", None]], [["r", "x", [1, 2]]]),
+        ([["r", "x", None]], [["r", "x", [2, 1]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert "incompatible-order" in res["anomaly-types"]
+
+
+def test_dirty_update():
+    h = concurrent_history(
+        ([["append", "x", 1]], "fail"),
+        ([["append", "x", 2]], [["append", "x", 2]]),
+        ([["r", "x", None]], [["r", "x", [1, 2]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert "dirty-update" in res["anomaly-types"]
+    assert "G1a" in res["anomaly-types"]  # reading 1 is also an aborted read
+
+
+def test_g0_write_cycle():
+    # ww cycle via interleaved version orders on two keys
+    h = concurrent_history(
+        ([["append", "k", 1], ["append", "j", 20]],
+         [["append", "k", 1], ["append", "j", 20]]),
+        ([["append", "k", 2], ["append", "j", 10]],
+         [["append", "k", 2], ["append", "j", 10]]),
+        ([["r", "k", None], ["r", "j", None]],
+         [["r", "k", [1, 2]], ["r", "j", [10, 20]]]),
+    )
+    res = oracle.check(h, ["read-uncommitted"])
+    assert res["valid?"] is False
+    assert "G0" in res["anomaly-types"]
+
+
+def test_g1c_wr_cycle():
+    h = concurrent_history(
+        ([["append", "x", 1], ["r", "y", None]],
+         [["append", "x", 1], ["r", "y", [9]]]),
+        ([["append", "y", 9], ["r", "x", None]],
+         [["append", "y", 9], ["r", "x", [1]]]),
+    )
+    res = oracle.check(h, ["read-committed"])
+    assert res["valid?"] is False
+    assert "G1c" in res["anomaly-types"]
+    nodes = set()
+    for step in res["anomalies"]["G1c"][0]["cycle"]:
+        nodes.add(step["src"])
+        nodes.add(step["dst"])
+    assert len(nodes) == 2
+
+
+def test_g_single():
+    # T0 -ww-> T1 (k versions), T1 -rw-> T0 (T1 read j=[] missing T0's append)
+    h = concurrent_history(
+        ([["append", "k", 1], ["append", "j", 10]],
+         [["append", "k", 1], ["append", "j", 10]]),
+        ([["append", "k", 2], ["r", "j", None]],
+         [["append", "k", 2], ["r", "j", []]]),
+        ([["r", "k", None], ["r", "j", None]],
+         [["r", "k", [1, 2]], ["r", "j", [10]]]),
+    )
+    res = oracle.check(h, ["snapshot-isolation"])
+    assert res["valid?"] is False
+    assert "G-single" in res["anomaly-types"]
+    assert "G2-item" not in res["anomaly-types"]  # not searched for SI
+    # under serializable, the same cycle also matches G2-item
+    res2 = oracle.check(h, ["serializable"])
+    assert "G-single" in res2["anomaly-types"]
+    assert "G2-item" in res2["anomaly-types"]
+
+
+def test_g2_item_write_skew():
+    # classic write skew: two rw edges, adjacent -> G2-item but not G-single
+    h = concurrent_history(
+        ([["r", "x", None], ["append", "y", 10]],
+         [["r", "x", []], ["append", "y", 10]]),
+        ([["r", "y", None], ["append", "x", 1]],
+         [["r", "y", []], ["append", "x", 1]]),
+        ([["r", "x", None], ["r", "y", None]],
+         [["r", "x", [1]], ["r", "y", [10]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert res["valid?"] is False
+    assert "G2-item" in res["anomaly-types"]
+    assert "G-single" not in res["anomaly-types"]
+    # snapshot isolation permits write skew: SI check stays valid
+    res_si = oracle.check(h, ["snapshot-isolation"])
+    assert res_si["valid?"] is True
+
+
+def test_realtime_cycle_strict_only():
+    # T0 reads T1's append but completed before T1 invoked:
+    # wr T1->T0 + realtime T0->T1 cycle. Strict-serializable invalid,
+    # plain serializable valid.
+    h = history([
+        invoke(0, "txn", [["r", "x", None]]),
+        ok(0, "txn", [["r", "x", [1]]]),
+        invoke(1, "txn", [["append", "x", 1]]),
+        ok(1, "txn", [["append", "x", 1]]),
+    ])
+    res = oracle.check(h, ["strict-serializable"])
+    assert res["valid?"] is False
+    assert "G1c-realtime" in res["anomaly-types"]
+    res2 = oracle.check(h, ["serializable"])
+    assert res2["valid?"] is True
+
+
+def test_info_txn_writes_count():
+    # an info (indeterminate) txn's append observed by a read is fine,
+    # and participates in the graph without G1a
+    h = seq_history(
+        ([["append", "x", 1]], "info"),
+        ([["r", "x", None]], [["r", "x", [1]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert res["valid?"] is True
+    assert "G1a" not in res["anomaly-types"]
+
+
+def test_empty_history_unknown():
+    res = oracle.check(history([]), ["serializable"])
+    assert res["valid?"] == "unknown"
+
+
+# -- synthetic generator round-trips ---------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_synth_valid(seed):
+    h = synth.la_history(n_txns=150, n_keys=6, concurrency=5,
+                         fail_prob=0.05, info_prob=0.05, seed=seed)
+    res = oracle.check(h, ["strict-serializable"])
+    assert res["valid?"] is True, res
+
+
+def test_synth_inject_g1a():
+    h = synth.la_history(n_txns=150, n_keys=6, concurrency=5, seed=3)
+    assert synth.inject_g1a(h)
+    res = oracle.check(h, ["serializable"])
+    assert res["valid?"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_synth_inject_wr_cycle():
+    h = synth.la_history(n_txns=150, n_keys=6, concurrency=5, seed=4)
+    assert synth.inject_wr_cycle(h)
+    res = oracle.check(h, ["read-committed"])
+    assert res["valid?"] is False
+    assert "G1c" in res["anomaly-types"]
+
+
+def test_synth_inject_rw_cycle():
+    h = synth.la_history(n_txns=150, n_keys=6, concurrency=5, seed=5)
+    assert synth.inject_rw_cycle(h)
+    res = oracle.check(h, ["serializable"])
+    assert res["valid?"] is False
+    assert ("G2-item" in res["anomaly-types"]
+            or "G-single" in res["anomaly-types"])
+
+
+def test_packed_generator_valid():
+    p = synth.packed_la_history(n_txns=2000, n_keys=20, seed=7)
+    res = oracle.check(p, ["serializable"])
+    assert res["valid?"] is True, res["anomaly-types"]
+
+
+# -- regressions from code review ------------------------------------------
+
+
+def test_no_false_g_nonadjacent_on_single_rw_cycle():
+    # a single-rw (G-single) cycle must NOT be reported as G-nonadjacent:
+    # non-simple closed walks don't count (Adya cycles are simple)
+    h = concurrent_history(
+        ([["append", "k", 1], ["append", "j", 10]],
+         [["append", "k", 1], ["append", "j", 10]]),
+        ([["append", "k", 2], ["r", "j", None]],
+         [["append", "k", 2], ["r", "j", []]]),
+        ([["r", "k", None], ["r", "j", None]],
+         [["r", "k", [1, 2]], ["r", "j", [10]]]),
+    )
+    res = oracle.check(h, ["serializable"])
+    assert "G-single" in res["anomaly-types"]
+    assert "G-nonadjacent" not in res["anomaly-types"]
+
+
+def test_raw_op_list_gets_indexed():
+    # passing a raw op list (indices unset) must behave like history():
+    # realtime edges depend on positions
+    ops = [
+        invoke(0, "txn", [["r", "x", None]]),
+        ok(0, "txn", [["r", "x", [1]]]),
+        invoke(1, "txn", [["append", "x", 1]]),
+        ok(1, "txn", [["append", "x", 1]]),
+    ]
+    res = oracle.check(ops, ["strict-serializable"])
+    assert res["valid?"] is False
+    assert "G1c-realtime" in res["anomaly-types"]

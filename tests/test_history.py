@@ -1,0 +1,95 @@
+"""History substrate tests (mirrors the reference's history test strategy)."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import History, Op, history, invoke, ok, fail, info
+from jepsen_tpu.history.soa import (
+    MOP_APPEND, MOP_READ, TXN_FAIL, TXN_INFO, TXN_OK, pack_txns,
+)
+
+
+def test_pair_index_basic():
+    h = history([
+        invoke(0, "txn", [["r", 0, None]]),
+        invoke(1, "txn", [["append", 0, 1]]),
+        ok(1, "txn", [["append", 0, 1]]),
+        ok(0, "txn", [["r", 0, [1]]]),
+    ])
+    assert h.pair_index(0) == 3
+    assert h.pair_index(3) == 0
+    assert h.pair_index(1) == 2
+    assert h.completion(h[0]).index == 3
+    assert h.invocation(h[2]).index == 1
+
+
+def test_info_stays_unpaired_after_crash():
+    h = history([
+        invoke(0, "txn", [["append", 0, 1]]),
+        info(0, "txn", None),       # crash: pairs with the invoke
+        invoke(1, "txn", [["r", 0, None]]),
+        ok(1, "txn", [["r", 0, [1]]]),
+    ])
+    assert h.pair_index(0) == 1
+    assert h[1].is_info()
+
+
+def test_double_invoke_raises():
+    with pytest.raises(ValueError):
+        history([
+            invoke(0, "txn", None),
+            invoke(0, "txn", None),
+        ])
+
+
+def test_filters_preserve_indices():
+    h = history([
+        invoke(0, "txn", None),
+        ok(0, "txn", None),
+        invoke(0, "txn", None),
+        fail(0, "txn", None),
+    ])
+    oks = h.oks()
+    assert [o.index for o in oks] == [1]
+    assert [o.index for o in h.fails()] == [3]
+
+
+def test_pack_txns_list_append():
+    h = history([
+        invoke(0, "txn", [["append", "x", 1], ["r", "y", None]]),
+        ok(0, "txn", [["append", "x", 1], ["r", "y", [9]]]),
+        invoke(1, "txn", [["append", "y", 9]]),
+        fail(1, "txn", [["append", "y", 9]]),
+        invoke(2, "txn", [["append", "x", 2]]),
+        info(2, "txn", None),
+    ])
+    p = pack_txns(h)
+    assert p.n_txns == 3
+    assert list(p.txn_type) == [TXN_OK, TXN_FAIL, TXN_INFO]
+    # ok txn: 2 mops, read filled
+    assert p.mop_kind[0] == MOP_APPEND and p.mop_kind[1] == MOP_READ
+    assert p.mop_rd_len[1] == 1
+    # fail txn: append known, from invocation
+    assert p.mop_kind[2] == MOP_APPEND
+    # info txn: mops from invocation
+    assert p.mop_kind[3] == MOP_APPEND
+    # key/value interning round-trips
+    assert p.key_names[p.mop_key[0]] == "x"
+    ki, v = p.val_names[p.mop_val[0]]
+    assert (p.key_names[ki], v) == ("x", 1)
+    # the ok read of y observes the failed append's value id
+    assert p.rd_elems[0] == p.mop_val[2]
+
+
+def test_pack_txns_rw_register():
+    h = history([
+        invoke(0, "txn", [["w", "x", 1], ["r", "x", None]]),
+        ok(0, "txn", [["w", "x", 1], ["r", "x", 1]]),
+        invoke(1, "txn", [["r", "y", None]]),
+        ok(1, "txn", [["r", "y", None]]),  # nil read (unborn)
+    ])
+    p = pack_txns(h, workload="rw-register")
+    assert p.n_txns == 2
+    assert p.mop_val[1] == p.mop_val[0]  # read sees the write's value id
+    assert p.mop_val[2] == -1            # nil read
+    assert p.mop_rd_len[2] == 0          # known read
