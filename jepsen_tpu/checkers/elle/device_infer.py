@@ -1,0 +1,407 @@
+"""Device-side edge inference + anomaly scans for list-append histories.
+
+The TPU half of the `elle/list_append.clj` equivalent: everything here runs
+under one `jax.jit` over the SoA history arrays (SURVEY.md §7 stage 2a/2b).
+
+Design notes (TPU-first, not a translation):
+- The reference builds per-key version orders with per-key Clojure maps and
+  unions bifurcan graphs.  Here every per-key computation is a flat
+  *segment op* over arrays sorted by key — the vmap-over-keys equivalent
+  that stays dense under Zipfian key skew (no ragged padding).  All scans
+  are parallel (cumsum / cummax / associative_scan); nothing sequential.
+- Version order per key = the longest ok-read of that key (reads must be
+  prefix-compatible; violations are flagged, as in the reference).
+- Dependency edges come out as fixed-capacity masked COO arrays, ready for
+  the cycle sweep kernel:
+    ww  — consecutive version writers  (capacity: read-element slots)
+    wr  — final-version writer -> reader (capacity: mop slots)
+    rw  — reader -> next-version writer  (capacity: mop slots)
+  plus chain inputs: per-process order and the realtime barrier chain (the
+  exact O(n)-edge transitive encoding of the realtime relation).
+- Non-cycle anomaly scans (duplicate-elements/appends, incompatible-order,
+  G1a, G1b, internal, dirty-update) are elementwise flags with counts and
+  argmax witnesses.  `internal` is exact whenever reads are
+  prefix-compatible; under incompatible-order the history is already
+  invalid and both checkers report it.
+
+All shapes static; padding is masked.  Pure function of its inputs — safe
+to vmap / shard_map over a batch of histories.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.history.soa import (
+    MOP_APPEND,
+    MOP_READ,
+    TXN_FAIL,
+    TXN_INFO,
+    TXN_OK,
+    PackedTxns,
+)
+from jepsen_tpu.ops.segments import (
+    segment_ids_from_starts,
+    segmented_cummax,
+    segmented_cumsum,
+)
+
+BIG = jnp.int32(2 ** 30)
+
+
+@dataclasses.dataclass
+class PaddedLA:
+    """Padded device inputs for a list-append history.
+
+    T/M/R are padded capacities; *_mask mark real rows.  val ids < R.
+    """
+
+    txn_type: jnp.ndarray          # (T,) i8 (0 = padding)
+    txn_process: jnp.ndarray       # (T,) i32
+    txn_invoke_pos: jnp.ndarray    # (T,) i32
+    txn_complete_pos: jnp.ndarray  # (T,) i32
+    txn_mask: jnp.ndarray          # (T,) bool
+    mop_txn: jnp.ndarray           # (M,) i32
+    mop_kind: jnp.ndarray          # (M,) i8
+    mop_key: jnp.ndarray           # (M,) i32
+    mop_val: jnp.ndarray           # (M,) i32 (append value id or -1)
+    mop_rd_start: jnp.ndarray      # (M,) i32
+    mop_rd_len: jnp.ndarray        # (M,) i32 (-1 unknown)
+    mop_mask: jnp.ndarray          # (M,) bool
+    rd_elems: jnp.ndarray          # (R,) i32
+    rd_elem_mask: jnp.ndarray      # (R,) bool
+    n_keys: int                    # static
+    n_vals: int                    # static
+
+
+jax.tree_util.register_dataclass(
+    PaddedLA,
+    data_fields=["txn_type", "txn_process", "txn_invoke_pos",
+                 "txn_complete_pos", "txn_mask", "mop_txn", "mop_kind",
+                 "mop_key", "mop_val", "mop_rd_start", "mop_rd_len",
+                 "mop_mask", "rd_elems", "rd_elem_mask"],
+    meta_fields=["n_keys", "n_vals"],
+)
+
+
+def pow2_at_least(n: int, floor: int = 8) -> int:
+    x = floor
+    while x < n:
+        x *= 2
+    return x
+
+
+def pad_packed(p: PackedTxns, t_pad: int = 0, m_pad: int = 0,
+               r_pad: int = 0) -> PaddedLA:
+    """Pad a PackedTxns to pow2 capacities (host-side, cheap numpy)."""
+    T = t_pad or pow2_at_least(p.n_txns)
+    M = m_pad or pow2_at_least(p.n_mops)
+    R = r_pad or pow2_at_least(max(len(p.rd_elems), p.n_vals, p.n_keys + 1))
+
+    def pad(a, n, fill=0):
+        out = np.full(n, fill, dtype=a.dtype)
+        out[: len(a)] = a
+        return jnp.asarray(out)
+
+    return PaddedLA(
+        txn_type=pad(p.txn_type, T),
+        txn_process=pad(p.txn_process, T),
+        txn_invoke_pos=pad(p.txn_invoke_pos, T),
+        txn_complete_pos=pad(p.txn_complete_pos, T),
+        txn_mask=jnp.asarray(np.arange(T) < p.n_txns),
+        mop_txn=pad(p.mop_txn, M),
+        mop_kind=pad(p.mop_kind, M, fill=-1),
+        mop_key=pad(p.mop_key, M),
+        mop_val=pad(p.mop_val, M, fill=-1),
+        mop_rd_start=pad(p.mop_rd_start, M, fill=-1),
+        mop_rd_len=pad(p.mop_rd_len, M, fill=-1),
+        mop_mask=jnp.asarray(np.arange(M) < p.n_mops),
+        rd_elems=pad(p.rd_elems, R, fill=-1),
+        rd_elem_mask=jnp.asarray(np.arange(R) < len(p.rd_elems)),
+        n_keys=p.n_keys,
+        n_vals=p.n_vals,
+    )
+
+
+@partial(jax.jit, static_argnames=("n_keys",))
+def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
+    """Full inference: anomaly flags + dependency edges + chains + ranks."""
+    T = h.txn_type.shape[0]
+    M = h.mop_txn.shape[0]
+    R = h.rd_elems.shape[0]
+    V = R  # value-id capacity
+    nk = max(n_keys, 1)
+
+    ok = h.txn_type == TXN_OK
+    graph_txn = ok | (h.txn_type == TXN_INFO)  # fail txns carry no edges
+
+    is_append = h.mop_mask & (h.mop_kind == MOP_APPEND) & (h.mop_val >= 0)
+    is_read = h.mop_mask & (h.mop_kind == MOP_READ)
+    mop_txn_c = jnp.clip(h.mop_txn, 0, T - 1)
+    reader_ok = ok[mop_txn_c]
+    known_read = is_read & (h.mop_rd_len >= 0) & reader_ok
+    mop_pos = jnp.arange(M, dtype=jnp.int32)
+
+    # ---- writers ---------------------------------------------------------
+    val_slot = jnp.where(is_append, h.mop_val, V)
+    writer = jnp.full(V + 1, -1, jnp.int32).at[val_slot].max(
+        jnp.where(is_append, h.mop_txn, -1))[:V]
+    writer_type = jnp.where(
+        writer >= 0, h.txn_type[jnp.clip(writer, 0, T - 1)], 0)
+    app_count = jnp.zeros(V + 1, jnp.int32).at[val_slot].add(
+        is_append.astype(jnp.int32))[:V]
+    duplicate_appends = jnp.sum((app_count > 1).astype(jnp.int32))
+
+    # final vs intermediate appends: an append is final iff it is the last
+    # append of its (txn, key) group — detected on mops sorted by
+    # (txn, key, pos)
+    sort_app = jnp.lexsort((mop_pos,
+                            jnp.where(is_append, h.mop_key, nk),
+                            jnp.where(is_append, h.mop_txn, T)))
+    sa_txn = h.mop_txn[sort_app]
+    sa_key = h.mop_key[sort_app]
+    sa_app = is_append[sort_app]
+    sa_val = h.mop_val[sort_app]
+    nxt_same = jnp.concatenate([(sa_txn[1:] == sa_txn[:-1]) &
+                                (sa_key[1:] == sa_key[:-1]) & sa_app[1:],
+                                jnp.zeros(1, bool)])
+    sa_final = sa_app & ~nxt_same
+    is_final = jnp.zeros(V + 1, bool).at[
+        jnp.where(sa_app, sa_val, V)].max(sa_final)[:V]
+
+    # ---- version orders (longest known read per key) ---------------------
+    key_slot = jnp.where(known_read, h.mop_key, nk)
+    ord_len = jnp.zeros(nk + 1, jnp.int32).at[key_slot].max(
+        jnp.where(known_read, h.mop_rd_len, 0))[:nk]
+    # pick one longest read per key (two-pass scatter; no 64-bit packing);
+    # ties take the earliest read, matching the host oracle
+    is_longest = known_read & (h.mop_rd_len == ord_len[
+        jnp.clip(h.mop_key, 0, nk - 1)])
+    ord_read_raw = jnp.full(nk + 1, M, jnp.int32).at[
+        jnp.where(is_longest, h.mop_key, nk)].min(
+        jnp.where(is_longest, mop_pos, M))[:nk]
+    ord_read = jnp.where(ord_read_raw < M, ord_read_raw, -1)
+    ord_start = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(ord_len)[:-1].astype(jnp.int32)])
+    total_ord = jnp.sum(ord_len)
+
+    # materialize ord_elems: slot j belongs to key k(j) at offset o(j)
+    slot = jnp.arange(R, dtype=jnp.int32)
+    slot_key = jnp.clip(
+        jnp.searchsorted(ord_start, slot, side="right") - 1, 0, nk - 1)
+    slot_off = slot - ord_start[slot_key]
+    slot_valid = slot < total_ord
+    src_read = ord_read[slot_key]
+    src_start = jnp.where(src_read >= 0,
+                          h.mop_rd_start[jnp.clip(src_read, 0, M - 1)], 0)
+    ord_elems = jnp.where(
+        slot_valid, h.rd_elems[jnp.clip(src_start + slot_off, 0, R - 1)], -1)
+    cv = jnp.clip(ord_elems, 0, V - 1)
+
+    # ---- read-element table ----------------------------------------------
+    # elem -> owning read mop: scatter read ids at their start slots, then
+    # forward-fill with a parallel cummax (read extents are contiguous and
+    # allocated in mop order, so ids are increasing)
+    has_elems = known_read & (h.mop_rd_len > 0)
+    seed = jnp.full(R + 1, -1, jnp.int32).at[
+        jnp.where(has_elems, h.mop_rd_start, R)].max(
+        jnp.where(has_elems, mop_pos, -1))[:R]
+    elem_read = jax.lax.cummax(seed)
+    er = jnp.clip(elem_read, 0, M - 1)
+    elem_off = slot - h.mop_rd_start[er]
+    elem_in_read = h.rd_elem_mask & (elem_read >= 0) & (elem_off >= 0) & \
+        (elem_off < h.mop_rd_len[er])
+    elem_key = h.mop_key[er]
+    elem_txn = h.mop_txn[er]
+    ev = jnp.clip(h.rd_elems, 0, V - 1)
+
+    # incompatible-order: element disagrees with its key's version order
+    expect = ord_elems[jnp.clip(
+        ord_start[jnp.clip(elem_key, 0, nk - 1)] + elem_off, 0, R - 1)]
+    incompat = elem_in_read & (h.rd_elems != expect)
+    incompatible_order = jnp.sum(incompat.astype(jnp.int32))
+    incompat_witness = jnp.argmax(incompat)
+
+    # G1a: reading a failed txn's append
+    g1a = elem_in_read & (writer_type[ev] == TXN_FAIL)
+    g1a_count = jnp.sum(g1a.astype(jnp.int32))
+    g1a_witness = jnp.argmax(g1a)
+
+    # duplicate elements inside one read: adjacent equal after a
+    # (read, value) sort
+    d_order = jnp.lexsort((jnp.where(elem_in_read, ev, V),
+                           jnp.where(elem_in_read, elem_read, M)))
+    d_read = jnp.where(elem_in_read, elem_read, M)[d_order]
+    d_val = jnp.where(elem_in_read, ev, V)[d_order]
+    dups = (d_read[1:] == d_read[:-1]) & (d_val[1:] == d_val[:-1]) & \
+        (d_read[1:] < M)
+    duplicate_elements = jnp.sum(dups.astype(jnp.int32))
+
+    # G1b: last element of a read is an intermediate append of another txn
+    is_last_elem = elem_in_read & (elem_off == h.mop_rd_len[er] - 1)
+    g1b = is_last_elem & (writer[ev] >= 0) & (~is_final[ev]) & \
+        (writer[ev] != elem_txn)
+    g1b_count = jnp.sum(g1b.astype(jnp.int32))
+    g1b_witness = jnp.argmax(g1b)
+
+    # dirty-update: aborted write immediately followed by a committed one
+    nxt_slot_same_key = slot_valid & (slot + 1 < total_ord) & \
+        (slot_key == slot_key[jnp.clip(slot + 1, 0, R - 1)])
+    nv = jnp.clip(ord_elems[jnp.clip(slot + 1, 0, R - 1)], 0, V - 1)
+    dirty = nxt_slot_same_key & (writer_type[cv] == TXN_FAIL) & \
+        (writer_type[nv] == TXN_OK)
+    dirty_update = jnp.sum(dirty.astype(jnp.int32))
+
+    # ---- internal consistency --------------------------------------------
+    # mops sorted by (txn, key, pos) form per-(txn,key) runs.  Within a run:
+    #   n_app_before[q]  — appends since the last known read (exclusive)
+    #   prev_q[q]        — run position of the last known read before q
+    # Then a read of length L with previous read of length P must satisfy
+    # L == P + n_app_before, and its elements at offsets [base, base+n)
+    # (base = P, or L - n when no previous read) must equal the appended
+    # values at run positions q-n .. q-1, in order.  Exact given
+    # prefix-compatible reads (see module docstring).
+    run_sort = jnp.lexsort((mop_pos,
+                            jnp.where(h.mop_mask, h.mop_key, nk),
+                            jnp.where(h.mop_mask, h.mop_txn, T)))
+    inv_run = jnp.zeros(M, jnp.int32).at[run_sort].set(mop_pos)
+    t2 = jnp.where(h.mop_mask, h.mop_txn, T)[run_sort]
+    k2 = jnp.where(h.mop_mask, h.mop_key, nk)[run_sort]
+    app2 = is_append[run_sort]
+    known2 = known_read[run_sort]
+    len2 = h.mop_rd_len[run_sort]
+    val2 = h.mop_val[run_sort]
+    run_start = jnp.concatenate([jnp.ones(1, bool),
+                                 (t2[1:] != t2[:-1]) | (k2[1:] != k2[:-1])])
+    q = jnp.arange(M, dtype=jnp.int32)
+    cum_app_excl = segmented_cumsum(app2.astype(jnp.int32), run_start,
+                                    exclusive=True)
+    prev_q = segmented_cummax(jnp.where(known2, q, -1), run_start,
+                              exclusive=True, neutral=-1)
+    have_prev = prev_q >= 0
+    prev_app_base = jnp.where(
+        have_prev,
+        (cum_app_excl + app2.astype(jnp.int32))[jnp.clip(prev_q, 0, M - 1)],
+        0)
+    n_app_before = cum_app_excl - prev_app_base
+    prev_len = jnp.where(have_prev, len2[jnp.clip(prev_q, 0, M - 1)], 0)
+
+    bad_len = known2 & have_prev & (len2 != prev_len + n_app_before)
+    bad_suffix = known2 & ~have_prev & (len2 < n_app_before)
+    internal_len_bad = jnp.sum((bad_len | bad_suffix).astype(jnp.int32))
+
+    # element-side content check: element at offset o of read m belongs to
+    # the appends-since-last-read window iff o >= base; it must then equal
+    # the append at run position q(m) - n + (o - base)
+    er_run = inv_run[er]                          # run position of the read
+    er_n = n_app_before[jnp.clip(er_run, 0, M - 1)]
+    er_have = have_prev[jnp.clip(er_run, 0, M - 1)]
+    er_prev_len = prev_len[jnp.clip(er_run, 0, M - 1)]
+    base = jnp.where(er_have, er_prev_len, h.mop_rd_len[er] - er_n)
+    j = elem_off - base
+    in_window = elem_in_read & (j >= 0) & (j < er_n)
+    exp_val = val2[jnp.clip(er_run - er_n + j, 0, M - 1)]
+    internal_content = in_window & (h.rd_elems != exp_val)
+    internal = internal_len_bad + jnp.sum(internal_content.astype(jnp.int32))
+
+    # ---- dependency edges -------------------------------------------------
+    ww_src = jnp.where(slot_valid, writer[cv], -1)
+    ww_dst = jnp.where(nxt_slot_same_key, writer[nv], -1)
+    ww_ok = nxt_slot_same_key & (ww_src >= 0) & (ww_dst >= 0) & \
+        (ww_src != ww_dst) & \
+        graph_txn[jnp.clip(ww_src, 0, T - 1)] & \
+        graph_txn[jnp.clip(ww_dst, 0, T - 1)]
+
+    last_val = jnp.where(
+        has_elems,
+        h.rd_elems[jnp.clip(h.mop_rd_start + h.mop_rd_len - 1, 0, R - 1)], -1)
+    wr_src = jnp.where(last_val >= 0, writer[jnp.clip(last_val, 0, V - 1)], -1)
+    wr_dst = h.mop_txn
+    wr_ok = has_elems & (wr_src >= 0) & (wr_src != wr_dst) & \
+        graph_txn[jnp.clip(wr_src, 0, T - 1)]
+
+    key_c = jnp.clip(h.mop_key, 0, nk - 1)
+    has_next = known_read & (h.mop_rd_len < ord_len[key_c])
+    nxt_val = jnp.where(
+        has_next,
+        ord_elems[jnp.clip(ord_start[key_c] + h.mop_rd_len, 0, R - 1)], -1)
+    rw_dst = jnp.where(nxt_val >= 0, writer[jnp.clip(nxt_val, 0, V - 1)], -1)
+    rw_src = h.mop_txn
+    rw_ok = has_next & (rw_dst >= 0) & (rw_dst != rw_src) & \
+        graph_txn[jnp.clip(rw_dst, 0, T - 1)]
+
+    # ---- node ranks -------------------------------------------------------
+    # txn = 2*complete_pos (even), barrier = 2*complete_pos + 1 (odd);
+    # padding gets unique high ranks with no edges attached
+    tidx = jnp.arange(T, dtype=jnp.int32)
+    rank_txn = jnp.where(h.txn_mask, 2 * h.txn_complete_pos, BIG + tidx)
+
+    # ---- chains -----------------------------------------------------------
+    # process chains: ok/info txns by (process, invoke_pos); complete_pos is
+    # monotone along a process chain, so ranks increase as required
+    pslot = jnp.where(h.txn_mask & graph_txn, h.txn_process, BIG)
+    porder = jnp.lexsort((h.txn_invoke_pos, pslot))
+    p_nodes = porder.astype(jnp.int32)
+    p_sorted = pslot[porder]
+    p_mask = p_sorted < BIG
+    p_starts = jnp.concatenate([jnp.ones(1, bool),
+                                p_sorted[1:] != p_sorted[:-1]])
+
+    # realtime barriers: one per ok txn, ordered by completion
+    bslot = jnp.where(h.txn_mask & ok, h.txn_complete_pos, BIG)
+    border = jnp.argsort(bslot)
+    b_txn = border.astype(jnp.int32)
+    b_mask = bslot[border] < BIG
+    barrier_node = (T + tidx).astype(jnp.int32)
+    rank_barrier = jnp.where(b_mask, 2 * bslot[border] + 1, BIG + T + tidx)
+    b_starts = jnp.concatenate([jnp.ones(1, bool), jnp.zeros(T - 1, bool)])
+    tb_src = b_txn
+    tb_dst = barrier_node
+    tb_ok = b_mask
+    comp_sorted = jnp.where(b_mask, bslot[border], BIG)
+    bi = jnp.searchsorted(comp_sorted, h.txn_invoke_pos, side="left") - 1
+    bt_ok = h.txn_mask & graph_txn & (bi >= 0)
+    bt_src = (T + jnp.clip(bi, 0, T - 1)).astype(jnp.int32)
+    bt_dst = tidx
+
+    return {
+        "counts": {
+            "duplicate-appends": duplicate_appends,
+            "duplicate-elements": duplicate_elements,
+            "incompatible-order": incompatible_order,
+            "G1a": g1a_count,
+            "G1b": g1b_count,
+            "dirty-update": dirty_update,
+            "internal": internal,
+        },
+        "witness": {
+            "incompatible-order": incompat_witness,
+            "G1a": g1a_witness,
+            "G1b": g1b_witness,
+        },
+        "edges": {
+            "ww": (ww_src, ww_dst, ww_ok),
+            "wr": (wr_src, wr_dst, wr_ok),
+            "rw": (rw_src, rw_dst, rw_ok),
+            "tb": (tb_src, tb_dst, tb_ok),
+            "bt": (bt_src, bt_dst, bt_ok),
+        },
+        "chains": {
+            "process": (p_nodes, p_starts, p_mask),
+            "barrier": (barrier_node, b_starts, b_mask),
+        },
+        "ranks": {
+            "txn": rank_txn.astype(jnp.int32),
+            "barrier": rank_barrier.astype(jnp.int32),
+        },
+        "order": {
+            "elems": ord_elems, "start": ord_start, "len": ord_len,
+            "writer": writer,
+        },
+    }

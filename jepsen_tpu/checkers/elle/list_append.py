@@ -1,0 +1,262 @@
+"""TPU list-append checker — the flagship device pipeline.
+
+`check()` here is API-compatible with `jepsen_tpu.checkers.elle.oracle.check`
+(the exact host reference) and with the capability surface of the
+reference's `elle.list-append/check` (SURVEY.md §2.3): same anomaly
+taxonomy, same consistency-model verdicts.
+
+Split of labor (mirrors the reference's SCC-on-graph / search-in-SCC split,
+relocated to TPU):
+  device — SoA packing -> `device_infer.infer` (version orders, non-cycle
+           anomaly scans, ww/wr/rw/process/realtime edges) -> per-projection
+           cycle detection via the rank-sweep kernel (`ops.cycle_sweep`).
+  host   — only when a projection reports a cycle: extract the small
+           offending region around witness backward edges (numpy frontier
+           BFS) and classify/render the exact cycle per anomaly spec with
+           the shared rel-constrained search (`graph.find_cycle`).
+
+Fast path: a valid history never leaves the device except for O(1) flags.
+
+If the sweep fails to converge (adversarial alternation depth; see
+ops/cycle_sweep.py) the checker falls back to the host oracle — verdicts
+are never approximated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.elle import consistency, oracle
+from jepsen_tpu.checkers.elle.device_infer import PaddedLA, infer, pad_packed
+from jepsen_tpu.checkers.elle.graph import (
+    REL_NAMES,
+    REL_PROCESS,
+    REL_REALTIME,
+    REL_RW,
+    REL_WR,
+    REL_WW,
+    CycleSpec,
+    EdgeList,
+    find_cycle,
+)
+from jepsen_tpu.checkers.elle.specs import CYCLE_ANOMALY_SPECS, SPEC_ORDER
+from jepsen_tpu.history.soa import TXN_OK, PackedTxns, pack_txns
+from jepsen_tpu.ops.cycle_sweep import SweepGraph, detect_cycles
+
+
+def check(history, consistency_models: Sequence[str] = ("serializable",),
+          anomalies: Sequence[str] = (), max_reported: int = 8,
+          _force_no_fallback: bool = False) -> Dict[str, Any]:
+    """Check a list-append history on device.  Accepts History / op list /
+    PackedTxns."""
+    p = history if isinstance(history, PackedTxns) \
+        else pack_txns(history, "list-append")
+    if p.n_txns == 0 or not (p.txn_type == TXN_OK).any():
+        return {"valid?": "unknown", "anomaly-types": [], "anomalies": {},
+                "not": [], "also-not": []}
+
+    h = pad_packed(p)
+    out = infer(h, h.n_keys)
+
+    found: Dict[str, List[Any]] = {}
+    counts = {k: int(v) for k, v in out["counts"].items()}
+    for name, cnt in counts.items():
+        if cnt > 0:
+            found[name] = [{"count": cnt}]
+
+    # which anomalies to search/report
+    want = set(consistency.anomalies_for_models(
+        [consistency.canonical(m) for m in consistency_models]))
+    want |= set(anomalies)
+    want |= {"duplicate-appends", "duplicate-elements", "incompatible-order"}
+
+    # ---- cycle anomalies: group specs by rel projection -------------------
+    specs = [(name, CYCLE_ANOMALY_SPECS[name]) for name in SPEC_ORDER
+             if name in want]
+    projections: Dict[frozenset, List[Tuple[str, CycleSpec]]] = {}
+    for name, spec in specs:
+        projections.setdefault(spec.rels, []).append((name, spec))
+
+    T = h.txn_type.shape[0]
+    edges = out["edges"]
+    chains = out["chains"]
+    rank = jnp.concatenate([out["ranks"]["txn"], out["ranks"]["barrier"]])
+
+    # static concatenated edge arrays; per-projection masks
+    e_src = jnp.concatenate([edges[k][0] for k in ("ww", "wr", "rw", "tb",
+                                                   "bt")])
+    e_dst = jnp.concatenate([edges[k][1] for k in ("ww", "wr", "rw", "tb",
+                                                   "bt")])
+    sizes = [edges[k][0].shape[0] for k in ("ww", "wr", "rw", "tb", "bt")]
+    rel_of = np.concatenate([
+        np.full(sizes[0], REL_WW), np.full(sizes[1], REL_WR),
+        np.full(sizes[2], REL_RW), np.full(sizes[3], REL_REALTIME),
+        np.full(sizes[4], REL_REALTIME)]).astype(np.int8)
+    base_mask = jnp.concatenate([edges[k][2] for k in ("ww", "wr", "rw",
+                                                       "tb", "bt")])
+    rel_arr = jnp.asarray(rel_of)
+
+    pc_nodes, pc_starts, pc_mask = chains["process"]
+    bc_nodes, bc_starts, bc_mask = chains["barrier"]
+    chain_nodes = jnp.concatenate([pc_nodes, bc_nodes])
+    chain_starts = jnp.concatenate([pc_starts, bc_starts])
+
+    host_edges: EdgeList = None  # lazily materialized for classification
+    needs_fallback = False
+    for rels, group in projections.items():
+        sel = jnp.zeros_like(base_mask)
+        for r in rels:
+            sel = sel | (rel_arr == r)
+        mask = base_mask & sel
+        cmask = jnp.concatenate([
+            pc_mask & (REL_PROCESS in rels),
+            bc_mask & (REL_REALTIME in rels)])
+        g = SweepGraph(n_nodes=2 * T, rank=rank, nc_src=e_src, nc_dst=e_dst,
+                       nc_mask=mask, chain_nodes=chain_nodes,
+                       chain_starts=chain_starts, chain_mask=cmask)
+        res = detect_cycles(g)
+        if not res.converged:
+            needs_fallback = True
+            break
+        if not res.has_cycle:
+            continue
+        # ---- host classification over witness regions --------------------
+        if host_edges is None:
+            host_edges = _materialize_host_edges(
+                e_src, e_dst, base_mask, rel_of, chains, T)
+        proj = host_edges.project(_expand_rels(rels))
+        regions = _witness_regions(
+            proj, np.asarray(e_src), np.asarray(e_dst), res.witness_edge_ids,
+            2 * T, limit=16)
+        for name, spec in group:
+            hit = None
+            for region in regions:
+                hit = find_cycle(region, proj, _spec_with_chains(spec))
+                if hit is not None:
+                    break
+            if hit is not None:
+                found.setdefault(name, []).append(
+                    {"cycle": _render(hit, p, T),
+                     "witnesses": int(len(res.witness_edge_ids))})
+
+    if needs_fallback:
+        if _force_no_fallback:
+            raise RuntimeError("cycle sweep did not converge")
+        return oracle.check(p, consistency_models, anomalies,
+                            max_reported=max_reported)
+
+    found = {k: v for k, v in found.items() if k in want}
+    anomaly_types = sorted(found.keys())
+    boundary = consistency.friendly_boundary(anomaly_types)
+    bad = set(boundary["not"]) | set(boundary["also-not"])
+    requested_bad = bad & {consistency.canonical(m)
+                           for m in consistency_models}
+    return {
+        "valid?": not requested_bad,
+        "anomaly-types": anomaly_types,
+        "anomalies": found,
+        "not": boundary["not"],
+        "also-not": boundary["also-not"],
+    }
+
+
+def _expand_rels(rels: frozenset) -> Set[int]:
+    """Projection rel set for host classification (chains share rel codes)."""
+    return set(rels)
+
+
+def _spec_with_chains(spec: CycleSpec) -> CycleSpec:
+    return spec
+
+
+def _materialize_host_edges(e_src, e_dst, mask, rel_of, chains, T
+                            ) -> EdgeList:
+    """Pull device edges + chain-implied edges into a host EdgeList."""
+    src = np.asarray(e_src)
+    dst = np.asarray(e_dst)
+    m = np.asarray(mask)
+    parts_s = [src[m]]
+    parts_d = [dst[m]]
+    parts_r = [rel_of[m]]
+    for cname, rel in (("process", REL_PROCESS), ("barrier", REL_REALTIME)):
+        nodes, starts, cm = (np.asarray(x) for x in chains[cname])
+        ok = cm[:-1] & cm[1:] & ~starts[1:]
+        parts_s.append(nodes[:-1][ok])
+        parts_d.append(nodes[1:][ok])
+        parts_r.append(np.full(int(ok.sum()), rel, np.int8))
+    e = EdgeList()
+    e.src = np.concatenate(parts_s).astype(np.int32)
+    e.dst = np.concatenate(parts_d).astype(np.int32)
+    e.rel = np.concatenate(parts_r).astype(np.int8)
+    return e
+
+
+def _csr(n: int, src: np.ndarray, dst: np.ndarray):
+    order = np.argsort(src, kind="stable")
+    ss, dd = src[order], dst[order]
+    starts = np.searchsorted(ss, np.arange(n + 1))
+    return dd, starts
+
+
+def _bfs_reach(n: int, src, dst, roots: np.ndarray) -> np.ndarray:
+    """Boolean reachability from roots via numpy frontier expansion."""
+    dd, starts = _csr(n, src, dst)
+    seen = np.zeros(n, bool)
+    seen[roots] = True
+    frontier = np.unique(roots)
+    while len(frontier):
+        outs = np.concatenate([dd[starts[v]:starts[v + 1]] for v in frontier]) \
+            if len(frontier) < 1024 else _expand_all(dd, starts, frontier)
+        outs = outs[~seen[outs]]
+        if not len(outs):
+            break
+        seen[outs] = True
+        frontier = np.unique(outs)
+    return seen
+
+
+def _expand_all(dd, starts, frontier):
+    counts = starts[frontier + 1] - starts[frontier]
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, dd.dtype)
+    idx = np.repeat(starts[frontier], counts) + \
+        (np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts))
+    return dd[idx]
+
+
+def _witness_regions(proj: EdgeList, e_src, e_dst, witness_ids, n_nodes,
+                     limit: int = 16) -> List[np.ndarray]:
+    """Nodes on cycles through each witness backward edge (u -> w):
+    forward-reach(w) ∩ reverse-reach(u) in the projection."""
+    regions = []
+    for wid in witness_ids[:limit]:
+        u, w = int(e_src[wid]), int(e_dst[wid])
+        fwd = _bfs_reach(n_nodes, proj.src, proj.dst, np.array([w]))
+        bwd = _bfs_reach(n_nodes, proj.dst, proj.src, np.array([u]))
+        nodes = np.nonzero(fwd & bwd)[0]
+        if len(nodes):
+            regions.append(nodes.astype(np.int64))
+    return regions
+
+
+def _render(cyc, p: PackedTxns, T: int):
+    orig = p.txn_orig_index
+    out = []
+    pend_src = None
+    k = next((i for i, (s, _, _) in enumerate(cyc) if s < T), 0)
+    cyc = cyc[k:] + cyc[:k]
+    for (s, rel, d) in cyc:
+        if d >= T:
+            if s < T:
+                pend_src = s
+            continue
+        src = s if s < T else pend_src
+        out.append({"src": int(orig[src]) if src is not None and
+                    src < p.n_txns else src,
+                    "rel": REL_NAMES[rel],
+                    "dst": int(orig[d]) if d < p.n_txns else d})
+    return out

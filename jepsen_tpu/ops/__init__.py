@@ -1,0 +1,1 @@
+"""Device primitives: segment ops, bitset label propagation, cycle kernels."""

@@ -1,0 +1,210 @@
+"""Device cycle detection over dependency graphs: the parallel-SCC engine.
+
+This replaces the reference's sequential Java Tarjan
+(`io.lacuna.bifurcan.Graphs/stronglyConnectedComponents`, SURVEY.md §2.5 #1)
+with a TPU-shaped decomposition.  Tarjan is inherently sequential; instead:
+
+1. **Rank decomposition.**  Nodes carry a static rank (completion order of
+   txns, with realtime-barrier nodes interleaved).  Edges split into
+   *forward* (rank(src) < rank(dst)) and *backward* (the rest).  Forward
+   edges alone form a DAG, so **every cycle contains >= 1 backward edge**.
+   In valid histories backward edges are rare (version order mostly agrees
+   with commit order), giving a device-only fast path: K == 0 -> acyclic.
+
+2. **Forward reachability from backward-edge heads.**  label[v] = the set
+   of backward edges e with dst(e) ->* v through forward edges, as (N, K)
+   0/1 int8 planes (OR == max, so relaxation is scatter-max — native on
+   TPU).  Long chains (realtime barrier chain, per-process order, per-key
+   ww version order) would make naive relaxation O(diameter); they are
+   instead resolved each round by **segmented prefix-OR scans**
+   (associative_scan, O(log N) depth), so rounds are bounded by the number
+   of *non-chain* hops (wr/rw/barrier-entry/exit edges) on the longest
+   shortest-path — small in practice.  Fixpoint via `lax.while_loop`.
+
+3. **Meta-closure.**  Cycle exists iff the K-node meta-graph — meta-edge
+   e -> e' iff dst(e) ->*_forward src(e') — has a cycle (self-loops
+   included).  K x K boolean closure by repeated squaring (MXU-friendly).
+
+Backward edges on meta-cycles are returned as *witnesses*; exact anomaly
+classification/explanation happens host-side on the (small) offending
+subgraph, mirroring the reference's SCC -> in-SCC search split.
+
+If the fixpoint loop hits `max_rounds` without converging the result is
+flagged `converged=False`; callers MUST fall back to the host checker
+(checkers are oracles — a truncated propagation could miss cycles, and we
+never trade exactness for speed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.ops.segments import (
+    gather_rows,
+    scatter_or,
+    segmented_prefix_or,
+)
+
+
+@dataclasses.dataclass
+class SweepGraph:
+    """Static, padded graph layout for the sweep kernel (device arrays).
+
+    Non-chain edges are COO (src, dst, mask).  Chain edges are given as
+    concatenated node sequences: chain_nodes with chain_starts flags; the
+    implied edges are chain_nodes[i] -> chain_nodes[i+1] within a segment.
+    chain_mask disables whole entries (padding / rel not in projection).
+    All ranks must be unique per node; forward = rank increases.
+    """
+
+    n_nodes: int
+    rank: jnp.ndarray          # (N,) int32, unique
+    nc_src: jnp.ndarray        # (E,) int32 non-chain edges
+    nc_dst: jnp.ndarray        # (E,) int32
+    nc_mask: jnp.ndarray       # (E,) bool
+    chain_nodes: jnp.ndarray   # (C,) int32
+    chain_starts: jnp.ndarray  # (C,) bool
+    chain_mask: jnp.ndarray    # (C,) bool
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "max_k", "max_rounds"))
+def _sweep(n_nodes: int, max_k: int, max_rounds: int,
+           rank, nc_src, nc_dst, nc_mask,
+           chain_nodes, chain_starts, chain_mask):
+    """Core kernel.  Returns (has_cycle, witness_bits, n_backward, converged).
+
+    witness_bits: (max_k,) int8 — 1 for backward edges on some cycle.
+    n_backward: actual number of backward edges found (may exceed max_k —
+    caller must re-batch; we still compute exactly for the first max_k and
+    report overflow via n_backward).
+    """
+    # ---- split edges: backward iff rank[src] >= rank[dst] -----------------
+    # (chain edges are forward by construction: caller guarantees ranks
+    # increase along chains)
+    r_src = rank[jnp.clip(nc_src, 0, n_nodes - 1)]
+    r_dst = rank[jnp.clip(nc_dst, 0, n_nodes - 1)]
+    is_back = nc_mask & (r_src >= r_dst)
+    n_back = jnp.sum(is_back.astype(jnp.int32))
+
+    # stable enumeration of backward edges: order by edge position
+    back_order = jnp.cumsum(is_back.astype(jnp.int32)) - 1  # id per back edge
+    back_id = jnp.where(is_back, back_order, -1)
+    in_budget = is_back & (back_id < max_k)
+
+    # backward edge endpoints, gathered into (max_k,) tables
+    E = nc_src.shape[0]
+    sink = max_k
+    scat_idx = jnp.where(in_budget, back_id, sink).astype(jnp.int32)
+    bsrc = jnp.zeros((max_k + 1,), jnp.int32).at[scat_idx].max(
+        jnp.where(in_budget, nc_src, 0))[:max_k]
+    bdst = jnp.zeros((max_k + 1,), jnp.int32).at[scat_idx].max(
+        jnp.where(in_budget, nc_dst, 0))[:max_k]
+    bvalid = (jnp.arange(max_k) < n_back)
+
+    # ---- forward reachability from backward dsts --------------------------
+    # labels: (N, max_k) int8; seed label[bdst[e], e] = 1
+    labels0 = jnp.zeros((n_nodes, max_k), jnp.int8)
+    labels0 = labels0.at[jnp.where(bvalid, bdst, 0),
+                         jnp.arange(max_k)].max(bvalid.astype(jnp.int8))
+
+    fwd_mask = nc_mask & ~is_back  # forward non-chain edges only
+
+    def chain_pass(labels):
+        vals = gather_rows(labels, chain_nodes, chain_mask)
+        # inclusive scan, then each node ORs its predecessors' scan value:
+        # propagate exclusive prefix to each position, scatter back
+        pref = segmented_prefix_or(vals, chain_starts, exclusive=True)
+        return scatter_or(labels, chain_nodes, pref, chain_mask)
+
+    def relax_pass(labels):
+        vals = gather_rows(labels, nc_src, fwd_mask)
+        return scatter_or(labels, nc_dst, vals, fwd_mask)
+
+    def body(state):
+        labels, _, i = state
+        new = chain_pass(labels)
+        new = relax_pass(new)
+        new = chain_pass(new)
+        changed = jnp.any(new != labels)
+        return new, changed, i + 1
+
+    def cond(state):
+        _, changed, i = state
+        return changed & (i < max_rounds)
+
+    labels, changed, rounds = jax.lax.while_loop(
+        cond, body, (chain_pass(labels0), jnp.array(True), jnp.array(0)))
+    converged = ~(changed & (rounds >= max_rounds))
+
+    # ---- meta-graph closure ----------------------------------------------
+    # meta[e, e2] = dst(e) ->* src(e2)  (forward reach), i.e.
+    # labels[src(e2), e] == 1
+    meta = gather_rows(labels, bsrc, bvalid).T  # (max_k, max_k): meta[e][e2]
+    meta = meta & bvalid[:, None].astype(jnp.int8) \
+                & bvalid[None, :].astype(jnp.int8)
+    # closure by repeated boolean squaring: R = meta OR meta@meta ...
+    def close_body(_, r):
+        ri = r.astype(jnp.int32)
+        r2 = ((ri @ ri) > 0).astype(jnp.int8)
+        return r | r2
+
+    n_sq = max(1, int(np.ceil(np.log2(max(2, max_k)))))
+    closure = jax.lax.fori_loop(0, n_sq, close_body, meta)
+    # backward edge e is on a cycle iff closure[e][e] (dst ->* src, then
+    # the edge src -> dst itself closes it)
+    witness = jnp.diagonal(closure) & bvalid.astype(jnp.int8)
+    has_cycle = jnp.any(witness == 1)
+    return has_cycle, witness, n_back, converged
+
+
+@dataclasses.dataclass
+class SweepResult:
+    has_cycle: bool
+    witness_edge_ids: np.ndarray  # indices into the non-chain edge arrays
+    n_backward: int
+    converged: bool
+
+
+def detect_cycles(g: SweepGraph, max_k: int = 128,
+                  max_rounds: int = 64) -> SweepResult:
+    """Run the sweep; rebatch automatically if backward edges exceed max_k.
+
+    Exact: cycle reported iff one exists in the (masked) graph, provided
+    converged=True.  Witnesses identify backward edges on cycles (for the
+    first max_k; enough to hand the host a subgraph to classify).
+    """
+    has, wit, n_back, conv = _sweep(
+        g.n_nodes, max_k, max_rounds, g.rank, g.nc_src, g.nc_dst, g.nc_mask,
+        g.chain_nodes, g.chain_starts, g.chain_mask)
+    n_back = int(n_back)
+    if n_back > max_k:
+        # too many backward edges for the bit budget: double and retry
+        return detect_cycles(g, max_k=max(max_k * 2, _pow2(n_back)),
+                             max_rounds=max_rounds)
+    wit = np.asarray(wit)
+    conv = bool(conv)
+    has = bool(has)
+    # map witness backward-edge ids back to edge-array positions
+    mask = np.asarray(g.nc_mask)
+    rank = np.asarray(g.rank)
+    src = np.clip(np.asarray(g.nc_src), 0, g.n_nodes - 1)
+    dst = np.clip(np.asarray(g.nc_dst), 0, g.n_nodes - 1)
+    is_back = mask & (rank[src] >= rank[dst])
+    back_pos = np.nonzero(is_back)[0]
+    wit_ids = back_pos[np.nonzero(wit[:len(back_pos)])[0]] \
+        if len(back_pos) else np.zeros(0, np.int64)
+    return SweepResult(has_cycle=has, witness_edge_ids=wit_ids,
+                       n_backward=n_back, converged=conv)
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p

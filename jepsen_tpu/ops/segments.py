@@ -1,0 +1,134 @@
+"""Segment primitives for device-side history analysis.
+
+These are the building blocks the reference gets from JVM fork-join folds
+(`jepsen/history/fold.clj`) and bifurcan collections — re-expressed as
+XLA-friendly vectorized ops: segmented prefix-OR scans (chains), masked
+scatter-combine (relaxation steps), and run-boundary detection over sorted
+keys.  Everything here is shape-static and jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_starts_from_sorted(keys: jnp.ndarray) -> jnp.ndarray:
+    """Boolean 'segment starts here' flags for a sorted key array."""
+    if keys.shape[0] == 0:
+        return jnp.zeros((0,), dtype=bool)
+    first = jnp.ones((1,), dtype=bool)
+    rest = keys[1:] != keys[:-1]
+    return jnp.concatenate([first, rest])
+
+
+def segmented_prefix_or(values: jnp.ndarray, starts: jnp.ndarray,
+                        exclusive: bool = False) -> jnp.ndarray:
+    """Segmented prefix-OR along axis 0.
+
+    values: (n, ...) integer/bool lanes; starts: (n,) bool, True at the first
+    element of each segment.  Returns, for each position, the OR of all
+    values from its segment start through itself (or strictly before, if
+    exclusive).  Implemented with `jax.lax.associative_scan` over the
+    standard segmented-combine monoid, so it runs in O(log n) depth — this
+    is what lets chain-structured dependency edges (realtime barrier chain,
+    process order, per-key version order) propagate in one pass instead of
+    O(chain length) rounds.
+    """
+    n = values.shape[0]
+    if n == 0:
+        return values
+    if exclusive:
+        # exclusive = inclusive scan over values shifted down one slot, with
+        # segment-start positions zeroed (they must not see the previous
+        # segment's last value)
+        shifted = jnp.concatenate(
+            [jnp.zeros_like(values[:1]), values[:-1]], axis=0)
+        vals = jnp.where(_bcast(starts, shifted), jnp.zeros_like(shifted),
+                         shifted)
+        return _seg_scan(vals, starts)
+    return _seg_scan(values, starts)
+
+
+def _bcast(flags: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
+    return flags.reshape(flags.shape + (1,) * (like.ndim - 1))
+
+
+def _seg_scan(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        v = jnp.where(_bcast(fb, vb), vb, va | vb)
+        return fa | fb, v
+
+    _, out = jax.lax.associative_scan(combine, (starts, values), axis=0)
+    return out
+
+
+def scatter_or(target: jnp.ndarray, idx: jnp.ndarray, values: jnp.ndarray,
+               mask: jnp.ndarray) -> jnp.ndarray:
+    """target[idx] |= values where mask, for 0/1 int8 label planes.
+
+    For boolean-per-bit labels, OR == max, so this lowers to scatter-max,
+    which XLA supports natively on TPU.  Masked rows are redirected to a
+    sink row that is dropped afterwards.
+    """
+    n = target.shape[0]
+    sink = jnp.int32(n)
+    safe_idx = jnp.where(mask, idx.astype(jnp.int32), sink)
+    padded = jnp.concatenate(
+        [target, jnp.zeros((1,) + target.shape[1:], dtype=target.dtype)], axis=0)
+    out = padded.at[safe_idx].max(values)
+    return out[:n]
+
+
+def gather_rows(src: jnp.ndarray, idx: jnp.ndarray,
+                mask: jnp.ndarray) -> jnp.ndarray:
+    """src[idx] with masked rows zeroed (out-of-range-safe)."""
+    safe = jnp.where(mask, idx, 0).astype(jnp.int32)
+    rows = src[safe]
+    return jnp.where(_bcast(mask, rows), rows, jnp.zeros_like(rows))
+
+
+def segment_ids_from_starts(starts: jnp.ndarray) -> jnp.ndarray:
+    """0-based segment id per position from start flags (parallel cumsum)."""
+    return jnp.cumsum(starts.astype(jnp.int32)) - 1
+
+
+def segmented_cumsum(values: jnp.ndarray, starts: jnp.ndarray,
+                     exclusive: bool = False) -> jnp.ndarray:
+    """Per-segment running sum via global-cumsum minus segment base.
+
+    O(n) work, O(log n) depth — no sequential scan.
+    """
+    g = jnp.cumsum(values)
+    seg = segment_ids_from_starts(starts)
+    start_pos = jnp.nonzero(starts, size=starts.shape[0], fill_value=0)[0]
+    base_incl = g[start_pos]          # inclusive cumsum AT each segment start
+    start_vals = values[start_pos]
+    base = (base_incl - start_vals)[seg]   # cumsum strictly before segment
+    incl = g - base
+    return incl - values if exclusive else incl
+
+
+def segmented_cummax(values: jnp.ndarray, starts: jnp.ndarray,
+                     exclusive: bool = False,
+                     neutral: int = -(2 ** 31) + 1) -> jnp.ndarray:
+    """Per-segment running max (values int32).  Uses lax.cummax on values
+    with segment starts reset to a neutral floor by offsetting: implemented
+    via the associative scan monoid (flag, value)."""
+    import jax
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        v = jnp.where(fb, vb, jnp.maximum(va, vb))
+        return fa | fb, v
+
+    vals = values
+    if exclusive:
+        vals = jnp.concatenate(
+            [jnp.full((1,), neutral, values.dtype), values[:-1]])
+        vals = jnp.where(starts, jnp.full_like(vals, neutral), vals)
+    _, out = jax.lax.associative_scan(combine, (starts, vals))
+    return out

@@ -13,3 +13,19 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon (TPU tunnel) PJRT plugin is registered at interpreter startup by
+# sitecustomize — before this conftest runs.  Backend *initialization* would
+# dial the TPU relay even under JAX_PLATFORMS=cpu, so tests must drop the
+# factory before any jax backend init.
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    for _name in ("axon", "tpu"):
+        getattr(_xb, "_backend_factories", {}).pop(_name, None)
+    # a pytest plugin may have imported jax before this conftest, binding
+    # jax_platforms to the outer env's "axon" — override it too
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,123 @@
+"""Device list-append checker vs host oracle — differential tests.
+
+The reference's pattern of checking parallel folds against serial folds
+(SURVEY.md §4), upgraded to device-vs-host: every verdict and anomaly set
+must match the exact host oracle.  `_force_no_fallback=True` ensures we are
+actually testing the device path, not the oracle fallback.
+"""
+
+import pytest
+
+from jepsen_tpu.checkers.elle import list_append, oracle
+from jepsen_tpu.history import history, invoke, ok, fail, info
+from jepsen_tpu.workloads import synth
+
+MODELS = ["strict-serializable"]
+
+
+def both(h, models=MODELS):
+    r_o = oracle.check(h, models)
+    r_d = list_append.check(h, models, _force_no_fallback=True)
+    assert r_o["valid?"] == r_d["valid?"], (r_o, r_d)
+    assert set(r_o["anomaly-types"]) == set(r_d["anomaly-types"]), (r_o, r_d)
+    return r_d
+
+
+def concurrent_history(*txns):
+    inv, comp = [], []
+    for i, (mops_inv, mops_ok) in enumerate(txns):
+        inv.append(invoke(i, "txn", mops_inv))
+        if mops_ok == "fail":
+            comp.append(fail(i, "txn", mops_inv))
+        elif mops_ok == "info":
+            comp.append(info(i, "txn", None))
+        else:
+            comp.append(ok(i, "txn", mops_ok))
+    return history(inv + comp)
+
+
+def test_device_valid_and_g1c():
+    h = concurrent_history(
+        ([["append", "x", 1], ["r", "y", None]],
+         [["append", "x", 1], ["r", "y", [9]]]),
+        ([["append", "y", 9], ["r", "x", None]],
+         [["append", "y", 9], ["r", "x", [1]]]),
+    )
+    r = both(h)
+    assert r["valid?"] is False
+    assert "G1c" in r["anomaly-types"]
+
+
+def test_device_g_single():
+    h = concurrent_history(
+        ([["append", "k", 1], ["append", "j", 10]],
+         [["append", "k", 1], ["append", "j", 10]]),
+        ([["append", "k", 2], ["r", "j", None]],
+         [["append", "k", 2], ["r", "j", []]]),
+        ([["r", "k", None], ["r", "j", None]],
+         [["r", "k", [1, 2]], ["r", "j", [10]]]),
+    )
+    r = both(h)
+    assert "G-single" in r["anomaly-types"]
+    assert "G-nonadjacent" not in r["anomaly-types"]
+
+
+def test_device_write_skew():
+    h = concurrent_history(
+        ([["r", "x", None], ["append", "y", 10]],
+         [["r", "x", []], ["append", "y", 10]]),
+        ([["r", "y", None], ["append", "x", 1]],
+         [["r", "y", []], ["append", "x", 1]]),
+        ([["r", "x", None], ["r", "y", None]],
+         [["r", "x", [1]], ["r", "y", [10]]]),
+    )
+    r = both(h)
+    assert "G2-item" in r["anomaly-types"]
+    assert "G-single" not in r["anomaly-types"]
+
+
+def test_device_realtime_cycle():
+    h = history([
+        invoke(0, "txn", [["r", "x", None]]),
+        ok(0, "txn", [["r", "x", [1]]]),
+        invoke(1, "txn", [["append", "x", 1]]),
+        ok(1, "txn", [["append", "x", 1]]),
+    ])
+    r = both(h)
+    assert r["valid?"] is False
+    assert "G1c-realtime" in r["anomaly-types"]
+
+
+def test_device_noncycle_anomalies():
+    h = concurrent_history(
+        ([["append", "x", 1], ["append", "x", 2]],
+         [["append", "x", 1], ["append", "x", 2]]),
+        ([["r", "x", None]], [["r", "x", [1]]]),          # G1b
+        ([["append", "y", 7]], "fail"),
+        ([["r", "y", None]], [["r", "y", [7]]]),          # G1a
+        ([["append", "z", 5], ["r", "z", None]],
+         [["append", "z", 5], ["r", "z", [5, 9]]]),       # internal
+    )
+    r = both(h)
+    for a in ("G1a", "G1b", "internal"):
+        assert a in r["anomaly-types"]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_device_differential_synth(seed):
+    h = synth.la_history(n_txns=120, n_keys=5, concurrency=4,
+                         fail_prob=0.05, info_prob=0.05,
+                         multi_append_prob=0.2, seed=seed)
+    if seed % 4 == 1:
+        synth.inject_g1a(h)
+    elif seed % 4 == 2:
+        synth.inject_wr_cycle(h)
+    elif seed % 4 == 3:
+        synth.inject_rw_cycle(h)
+    both(h)
+
+
+def test_device_packed_generator_valid():
+    p = synth.packed_la_history(n_txns=3000, n_keys=24, seed=11)
+    r = list_append.check(p, MODELS, _force_no_fallback=True)
+    assert r["valid?"] is True, r["anomaly-types"]
