@@ -1,0 +1,60 @@
+"""Benchmark: list-append check throughput (the north-star metric).
+
+Generates a strict-serializable packed list-append history, runs the fused
+device core check (edge inference + 5 projection cycle sweeps), and
+reports verified ops/sec.  Baseline = the BASELINE.json target of a 10M-op
+history in 60 s on a v5e-8 (166,667 ops/s); vs_baseline > 1 beats it.
+
+Env knobs: BENCH_TXNS (default 1,000,000), BENCH_KEYS, BENCH_REPEATS.
+Prints exactly ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    n_txns = int(os.environ.get("BENCH_TXNS", 1_000_000))
+    # keys scale with size so per-key list lengths stay bounded (~12
+    # appends/key) — matching how real list-append workloads bound
+    # read-list growth (elle's gen rotates keys)
+    n_keys = int(os.environ.get("BENCH_KEYS", max(64, n_txns // 8)))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+
+    import jax
+
+    from jepsen_tpu.checkers.elle.device_core import core_check
+    from jepsen_tpu.checkers.elle.device_infer import pad_packed
+    from jepsen_tpu.workloads import synth
+
+    p = synth.packed_la_history(n_txns=n_txns, n_keys=n_keys,
+                                mops_per_txn=4, read_frac=0.25, seed=7)
+    h = pad_packed(p)
+
+    # warmup (compile)
+    bits, over = core_check(h, p.n_keys)
+    jax.block_until_ready(bits)
+    assert int(bits[-1]) == 1, "sweep did not converge on bench history"
+    assert int(bits[:12].sum()) == 0, "bench history must be valid"
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        bits, over = core_check(h, p.n_keys)
+        jax.block_until_ready(bits)
+        best = min(best, time.perf_counter() - t0)
+
+    ops_per_sec = n_txns / best
+    baseline = 10_000_000 / 60.0  # BASELINE.json: 10M ops under 60 s
+    print(json.dumps({
+        "metric": "elle-list-append-check-throughput",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(ops_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,10 +73,9 @@ class SweepGraph:
     chain_mask: jnp.ndarray    # (C,) bool
 
 
-@partial(jax.jit, static_argnames=("n_nodes", "max_k", "max_rounds"))
-def _sweep(n_nodes: int, max_k: int, max_rounds: int,
-           rank, nc_src, nc_dst, nc_mask,
-           chain_nodes, chain_starts, chain_mask):
+def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
+                  rank, nc_src, nc_dst, nc_mask,
+                  chain_nodes, chain_starts, chain_mask):
     """Core kernel.  Returns (has_cycle, witness_bits, n_backward, converged).
 
     witness_bits: (max_k,) int8 — 1 for backward edges on some cycle.
@@ -107,60 +106,74 @@ def _sweep(n_nodes: int, max_k: int, max_rounds: int,
         jnp.where(in_budget, nc_dst, 0))[:max_k]
     bvalid = (jnp.arange(max_k) < n_back)
 
-    # ---- forward reachability from backward dsts --------------------------
-    # labels: (N, max_k) int8; seed label[bdst[e], e] = 1
-    labels0 = jnp.zeros((n_nodes, max_k), jnp.int8)
-    labels0 = labels0.at[jnp.where(bvalid, bdst, 0),
-                         jnp.arange(max_k)].max(bvalid.astype(jnp.int8))
-
     fwd_mask = nc_mask & ~is_back  # forward non-chain edges only
 
-    def chain_pass(labels):
-        vals = gather_rows(labels, chain_nodes, chain_mask)
-        # inclusive scan, then each node ORs its predecessors' scan value:
-        # propagate exclusive prefix to each position, scatter back
-        pref = segmented_prefix_or(vals, chain_starts, exclusive=True)
-        return scatter_or(labels, chain_nodes, pref, chain_mask)
+    def propagate(_):
+        # labels: (N, max_k) int8; seed label[bdst[e], e] = 1
+        labels0 = jnp.zeros((n_nodes, max_k), jnp.int8)
+        labels0 = labels0.at[jnp.where(bvalid, bdst, 0),
+                             jnp.arange(max_k)].max(bvalid.astype(jnp.int8))
 
-    def relax_pass(labels):
-        vals = gather_rows(labels, nc_src, fwd_mask)
-        return scatter_or(labels, nc_dst, vals, fwd_mask)
+        def chain_pass(labels):
+            vals = gather_rows(labels, chain_nodes, chain_mask)
+            pref = segmented_prefix_or(vals, chain_starts, exclusive=True)
+            return scatter_or(labels, chain_nodes, pref, chain_mask)
 
-    def body(state):
-        labels, _, i = state
-        new = chain_pass(labels)
-        new = relax_pass(new)
-        new = chain_pass(new)
-        changed = jnp.any(new != labels)
-        return new, changed, i + 1
+        def relax_pass(labels):
+            vals = gather_rows(labels, nc_src, fwd_mask)
+            return scatter_or(labels, nc_dst, vals, fwd_mask)
 
-    def cond(state):
-        _, changed, i = state
-        return changed & (i < max_rounds)
+        def body(state):
+            labels, _, i = state
+            new = chain_pass(labels)
+            new = relax_pass(new)
+            new = chain_pass(new)
+            changed = jnp.any(new != labels)
+            return new, changed, i + 1
 
-    labels, changed, rounds = jax.lax.while_loop(
-        cond, body, (chain_pass(labels0), jnp.array(True), jnp.array(0)))
-    converged = ~(changed & (rounds >= max_rounds))
+        def cond(state):
+            _, changed, i = state
+            return changed & (i < max_rounds)
 
-    # ---- meta-graph closure ----------------------------------------------
-    # meta[e, e2] = dst(e) ->* src(e2)  (forward reach), i.e.
-    # labels[src(e2), e] == 1
-    meta = gather_rows(labels, bsrc, bvalid).T  # (max_k, max_k): meta[e][e2]
-    meta = meta & bvalid[:, None].astype(jnp.int8) \
-                & bvalid[None, :].astype(jnp.int8)
-    # closure by repeated boolean squaring: R = meta OR meta@meta ...
-    def close_body(_, r):
-        ri = r.astype(jnp.int32)
-        r2 = ((ri @ ri) > 0).astype(jnp.int8)
-        return r | r2
+        # carry components derive from sharded inputs so their varying-axis
+        # type matches the body's outputs under shard_map
+        changed0 = n_back >= 0                 # always True, varying-typed
+        rounds0 = jnp.where(n_back < 0, 1, 0)  # always 0, varying-typed
+        labels, changed, rounds = jax.lax.while_loop(
+            cond, body, (chain_pass(labels0), changed0, rounds0))
+        converged = ~(changed & (rounds >= max_rounds))
 
-    n_sq = max(1, int(np.ceil(np.log2(max(2, max_k)))))
-    closure = jax.lax.fori_loop(0, n_sq, close_body, meta)
-    # backward edge e is on a cycle iff closure[e][e] (dst ->* src, then
-    # the edge src -> dst itself closes it)
-    witness = jnp.diagonal(closure) & bvalid.astype(jnp.int8)
-    has_cycle = jnp.any(witness == 1)
+        # meta-graph closure: meta[e, e2] = dst(e) ->* src(e2), read from
+        # labels[src(e2), e]
+        meta = gather_rows(labels, bsrc, bvalid).T
+        meta = meta & bvalid[:, None].astype(jnp.int8) \
+                    & bvalid[None, :].astype(jnp.int8)
+
+        def close_body(_, r):
+            ri = r.astype(jnp.int32)
+            r2 = ((ri @ ri) > 0).astype(jnp.int8)
+            return r | r2
+
+        n_sq = max(1, int(np.ceil(np.log2(max(2, max_k)))))
+        closure = jax.lax.fori_loop(0, n_sq, close_body, meta)
+        # backward edge e is on a cycle iff closure[e][e] (dst ->* src,
+        # then the edge src -> dst itself closes it)
+        witness = jnp.diagonal(closure) & bvalid.astype(jnp.int8)
+        return jnp.any(witness == 1), witness, converged
+
+    def acyclic(_):
+        # no backward edges: forward edges strictly increase rank, so the
+        # projection is a DAG — nothing to propagate (the common case for
+        # valid histories; this skip is the fast path)
+        return (n_back < 0, jnp.zeros((max_k,), jnp.int8), n_back >= 0)
+
+    has_cycle, witness, converged = jax.lax.cond(
+        n_back > 0, propagate, acyclic, operand=None)
     return has_cycle, witness, n_back, converged
+
+
+_sweep = jax.jit(_sweep_arrays,
+                 static_argnames=("n_nodes", "max_k", "max_rounds"))
 
 
 @dataclasses.dataclass

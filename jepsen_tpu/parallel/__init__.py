@@ -1,0 +1,2 @@
+"""Sharded checking: mesh helpers, batched multi-history data parallelism,
+op-axis sharding (SURVEY.md §2.7)."""

@@ -1,0 +1,120 @@
+"""Batched multi-history checking sharded over a device mesh.
+
+The reference's closest analogue is `jepsen.independent` (checking per-key
+sub-histories "independently" on one JVM, SURVEY.md §2.1); here it becomes
+true data parallelism: a batch of histories is sharded over the mesh's
+`dp` axis with `shard_map`, each device runs the full single-jit core
+check (`device_core.core_check`) on its shard via `vmap`, and the per-
+history anomaly bitmaps are combined with an ICI `all_gather` — the
+BASELINE.json config-5 shape (100 x 1M-op histories on a v5e-8).
+
+Histories in a batch share padded capacities (pad to the max; the packed
+generator or the store's chunked loader provides equal-shaped arrays).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jepsen_tpu.checkers.elle.device_core import core_check
+from jepsen_tpu.checkers.elle.device_infer import PaddedLA, pad_packed
+from jepsen_tpu.history.soa import PackedTxns
+
+
+def make_mesh(n_devices: int = 0, axis: str = "dp") -> Mesh:
+    devs = jax.devices()
+    if n_devices:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def stack_padded(hs: Sequence[PaddedLA]) -> PaddedLA:
+    """Stack equal-shaped padded histories along a leading batch axis."""
+    first = hs[0]
+    out = {}
+    for f in ("txn_type", "txn_process", "txn_invoke_pos",
+              "txn_complete_pos", "txn_mask", "mop_txn", "mop_kind",
+              "mop_key", "mop_val", "mop_rd_start", "mop_rd_len", "mop_mask",
+              "rd_elems", "rd_elem_mask"):
+        out[f] = jnp.stack([getattr(h, f) for h in hs])
+    return PaddedLA(n_keys=first.n_keys, n_vals=first.n_vals, **out)
+
+
+def pad_batch(ps: Sequence[PackedTxns]) -> PaddedLA:
+    """Pad a list of PackedTxns to shared capacities and stack them."""
+    from jepsen_tpu.checkers.elle.device_infer import pow2_at_least
+
+    T = pow2_at_least(max(p.n_txns for p in ps))
+    M = pow2_at_least(max(p.n_mops for p in ps))
+    R = pow2_at_least(max(max(len(p.rd_elems), p.n_vals, p.n_keys + 1)
+                          for p in ps))
+    nk = max(p.n_keys for p in ps)
+    padded = []
+    for p in ps:
+        h = pad_packed(p, t_pad=T, m_pad=M, r_pad=R)
+        h.n_keys = nk
+        padded.append(h)
+    return stack_padded(padded)
+
+
+@partial(jax.jit, static_argnames=("n_keys",))
+def _batched_core(batch: PaddedLA, n_keys: int):
+    return jax.vmap(lambda h: core_check(h, n_keys))(batch)
+
+
+def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
+                axis: str = "dp") -> List[dict]:
+    """Check a batch of histories, sharded across the mesh if given.
+
+    Returns one summary dict per history: {"valid?", "bits", "exact"}.
+    The batch size must be divisible by the mesh axis size when sharding.
+    """
+    batch = pad_batch(ps)
+    n_keys = batch.n_keys
+
+    if mesh is None:
+        bits, over = _batched_core(batch, n_keys)
+    else:
+        spec = P(axis)
+        in_shard = NamedSharding(mesh, spec)
+
+        def put(x):
+            return jax.device_put(x, in_shard)
+
+        batch = jax.tree_util.tree_map(put, batch)
+
+        @partial(jax.shard_map, mesh=mesh, in_specs=(spec,),
+                 out_specs=(spec, spec))
+        def sharded(b):
+            bits, over = jax.vmap(lambda h: core_check(h, n_keys))(b)
+            return bits, over
+
+        bits, over = sharded(batch)
+
+    bits = np.asarray(bits)
+    over = np.asarray(over)
+    out = []
+    from jepsen_tpu.checkers.elle.device_core import COUNT_NAMES
+    for i in range(len(ps)):
+        row = bits[i]
+        counts = {n: int(row[j]) for j, n in enumerate(COUNT_NAMES)}
+        cycles = [bool(x) for x in row[len(COUNT_NAMES):-1]]
+        converged = bool(row[-1]) and int(over[i]) == 0
+        invalid = any(v > 0 for v in counts.values()) or any(cycles)
+        out.append({
+            "valid?": (not invalid) if converged else "unknown",
+            "counts": counts,
+            "cycles": {
+                "G0": cycles[0], "G1c": cycles[1], "G2-family": cycles[2],
+                "G2-family-process": cycles[3],
+                "G2-family-realtime": cycles[4],
+            },
+            "exact": converged,
+        })
+    return out
