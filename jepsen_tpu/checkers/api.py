@@ -336,6 +336,22 @@ class LogFilePattern(Checker):
                 "matches": matches[:32]}
 
 
+class Linearizable(Checker):
+    """Linearizability via the Knossos-equivalent competition search
+    (reference `checker/linearizable` -> knossos, SURVEY.md §2.1/§2.4)."""
+
+    def __init__(self, model=None, algorithm: str = "auto"):
+        self.model = model
+        self.algorithm = algorithm
+
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.checkers.knossos import analysis
+        from jepsen_tpu.models import cas_register
+
+        model = self.model or (test or {}).get("model") or cas_register()
+        return analysis(history, model, algorithm=self.algorithm)
+
+
 class ConcurrencyLimit(Checker):
     """Reference `concurrency-limit`: no more than n concurrent invocations
     (sanity check on the generator/interpreter)."""

@@ -1,0 +1,6 @@
+"""Knossos-style linearizability checking (SURVEY.md §2.4)."""
+
+from jepsen_tpu.checkers.knossos.wgl import check as check_wgl
+from jepsen_tpu.checkers.knossos.competition import analysis
+
+__all__ = ["check_wgl", "analysis"]

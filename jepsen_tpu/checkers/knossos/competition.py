@@ -1,0 +1,41 @@
+"""Competition: host WGL vs device frontier search.
+
+Equivalent of `knossos/competition.clj` (SURVEY.md §2.4), which races
+`linear` and `wgl` and takes the first definitive answer.  Here the two
+contestants are the exact host WGL (small-history anchor) and the TPU
+batched frontier search (scales wider).  The host runs first below a size
+threshold; the device verdict is used for larger histories, with the host
+as fallback when the device returns "unknown" (overflow / state
+explosion).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu.checkers.knossos import device_wgl, wgl
+from jepsen_tpu.checkers.knossos.prep import prepare
+from jepsen_tpu.history.ops import History
+from jepsen_tpu.models import Model
+
+HOST_FIRST_MAX_OPS = 256
+
+
+def analysis(history: History, model: Model,
+             algorithm: str = "auto", **kw) -> Dict[str, Any]:
+    """Linearizability analysis.  algorithm: auto | wgl | device."""
+    ops = prepare(history)
+    if algorithm == "wgl":
+        return wgl.check(ops, model, **kw)
+    if algorithm == "device":
+        return device_wgl.check(ops, model, **kw)
+    if len(ops) <= HOST_FIRST_MAX_OPS:
+        res = wgl.check(ops, model)
+        if res["valid?"] != "unknown":
+            return res
+        dres = device_wgl.check(ops, model)
+        return dres if dres["valid?"] != "unknown" else res
+    res = device_wgl.check(ops, model)
+    if res["valid?"] != "unknown":
+        return res
+    return wgl.check(ops, model)

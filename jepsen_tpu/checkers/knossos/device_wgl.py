@@ -1,0 +1,191 @@
+"""TPU linearizability search: batched frontier BFS over the config lattice.
+
+The reference's WGL is a sequential DFS with a JVM-bitset visited cache
+(`knossos/wgl.clj`).  Reframed for TPU (SURVEY.md §2.4, §2.7 "Knossos
+competition" row, BASELINE.json north star): the DFS branch set becomes a
+*wave* — all configurations with k linearized ops — processed as one wide
+tensor step:
+
+  config   = (model state int32, linearized bitset W x uint32)
+  wave     = frontier (F, W+1) in HBM
+  expand   = for every config x every op: candidate iff op not yet
+             linearized, its invocation precedes every unlinearized
+             return (real-time order), and the memoized transition table
+             admits it — all as (F, n) masked gathers
+  dedup    = Zobrist hashing (h(S ^ op) = h(S) ^ z[op]) so children hash
+             incrementally without materializing (F*n, W) bitsets; unique
+             by (h1, h2, state') via lexsort + adjacent-compare
+  success  = some config linearizes every op that returned
+
+`info` (crashed) ops never return and may stay unlinearized — exactly the
+reference's forever-concurrent treatment.
+
+Exactness: a 64-bit hash collision could merge two distinct configs
+(collision odds < 1e-9 per wave at the default frontier cap).  The result
+therefore carries `hash_dedup: True`; `competition.analysis` anchors
+definitive verdicts on the exact host search when the history is small and
+uses the device verdict beyond that, as the reference races wgl/linear.
+Frontier overflow -> `"unknown"` (never a wrong verdict).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.knossos.memo import Memo, StateExplosion, memoize
+from jepsen_tpu.checkers.knossos.prep import NEVER, LinOp
+from jepsen_tpu.models import Model
+
+INF = jnp.int32(2 ** 30)
+
+
+@partial(jax.jit, static_argnames=("n", "W", "max_frontier", "n_waves"))
+def _frontier_search(n: int, W: int, max_frontier: int, n_waves: int,
+                     invokes, returns, op_sym, must, table, z1, z2,
+                     init_state):
+    """Returns (linearizable, exhausted, overflow).
+
+    linearizable: some config covered every must-op.
+    exhausted: frontier emptied without success (=> not linearizable).
+    overflow: frontier cap exceeded at some wave (result unreliable).
+    """
+    F = max_frontier
+    word_idx = (jnp.arange(n) // 32).astype(jnp.int32)
+    bit = (jnp.arange(n) % 32).astype(jnp.int32)
+    op_bit = (jnp.uint32(1) << bit.astype(jnp.uint32))
+
+    # frontier: states (F,), bits (F, W) uint32, h1/h2 (F,), valid (F,)
+    states0 = jnp.zeros(F, jnp.int32).at[0].set(init_state)
+    bits0 = jnp.zeros((F, W), jnp.uint32)
+    h10 = jnp.zeros(F, jnp.uint32)
+    h20 = jnp.zeros(F, jnp.uint32)
+    valid0 = jnp.zeros(F, bool).at[0].set(True)
+
+    def success_of(states, bits, valid):
+        covered = jnp.all((bits & must[None, :]) == must[None, :], axis=1)
+        return jnp.any(valid & covered)
+
+    def wave(state):
+        states, bits, h1, h2, valid, done, overflow, w = state
+        # (F, n): is op i linearized in config c?
+        cfg_words = bits[:, word_idx]                      # (F, n)
+        in_s = (cfg_words >> bit.astype(jnp.uint32)) & 1
+        in_s = in_s.astype(bool)
+        # min return among unlinearized ops
+        ret_masked = jnp.where(in_s, INF, returns[None, :])
+        minret = jnp.min(ret_masked, axis=1)               # (F,)
+        cand = (~in_s) & (invokes[None, :] < minret[:, None]) & valid[:, None]
+        nxt_state = table[states[:, None], op_sym[None, :]]  # (F, n)
+        cand = cand & (nxt_state >= 0)
+
+        # flatten children: ids (F*n,), dedup keys via Zobrist
+        ch_h1 = (h1[:, None] ^ z1[None, :]).reshape(-1)
+        ch_h2 = (h2[:, None] ^ z2[None, :]).reshape(-1)
+        ch_state = nxt_state.reshape(-1)
+        ch_mask = cand.reshape(-1)
+        parent = jnp.repeat(jnp.arange(F, dtype=jnp.int32), n)
+        opid = jnp.tile(jnp.arange(n, dtype=jnp.int32), F)
+
+        # sort: invalid rows last, equal configs adjacent
+        order = jnp.lexsort((
+            ch_state, ch_h2, ch_h1, (~ch_mask).astype(jnp.int32)))
+        s_h1 = ch_h1[order]
+        s_h2 = ch_h2[order]
+        s_state = ch_state[order]
+        s_mask = ch_mask[order]
+        first = jnp.concatenate([
+            jnp.ones(1, bool),
+            (s_h1[1:] != s_h1[:-1]) | (s_h2[1:] != s_h2[:-1]) |
+            (s_state[1:] != s_state[:-1])])
+        keep = s_mask & first
+        n_new = jnp.sum(keep.astype(jnp.int32))
+        overflow = overflow | (n_new > F)
+
+        # compact the first F kept rows into the new frontier
+        kidx = jnp.cumsum(keep.astype(jnp.int32)) - 1      # target row
+        tgt = jnp.where(keep & (kidx < F), kidx, F)
+        take = jnp.full(F + 1, -1, jnp.int32).at[tgt].max(
+            jnp.arange(F * n, dtype=jnp.int32))[:F]        # source row in sorted
+        new_valid = take >= 0
+        tk = jnp.clip(take, 0, F * n - 1)
+        src = order[tk]
+        p = parent[src]
+        o = opid[src]
+        new_states = jnp.where(new_valid, ch_state[src], 0)
+        new_bits = bits[p] | (
+            jnp.zeros((F, W), jnp.uint32).at[
+                jnp.arange(F), word_idx[o]].set(op_bit[o]))
+        new_bits = jnp.where(new_valid[:, None], new_bits, 0)
+        new_h1 = jnp.where(new_valid, ch_h1[src], 0)
+        new_h2 = jnp.where(new_valid, ch_h2[src], 0)
+
+        done = done | success_of(new_states, new_bits, new_valid)
+        return (new_states, new_bits, new_h1, new_h2, new_valid, done,
+                overflow, w + 1)
+
+    def cond(state):
+        _, _, _, _, valid, done, overflow, w = state
+        return (~done) & (~overflow) & jnp.any(valid) & (w < n_waves)
+
+    init_done = success_of(states0, bits0, valid0)
+    init = (states0, bits0, h10, h20, valid0, init_done,
+            jnp.array(False), jnp.int32(0))
+    states, bits, h1, h2, valid, done, overflow, w = jax.lax.while_loop(
+        cond, wave, init)
+    exhausted = (~done) & (~overflow) & (~jnp.any(valid) | (w >= n_waves))
+    return done, exhausted, overflow
+
+
+def check(ops: Sequence[LinOp], model: Model,
+          max_frontier: int = 16384) -> Dict[str, Any]:
+    """Device linearizability check of prepared ops against a model."""
+    n = len(ops)
+    if n == 0:
+        return {"valid?": "unknown", "op-count": 0}
+    if n > 4096:
+        return {"valid?": "unknown", "op-count": n,
+                "reason": "too many ops for device WGL"}
+    try:
+        memo = memoize(model, ops)
+    except StateExplosion:
+        return {"valid?": "unknown", "op-count": n,
+                "reason": "model state explosion"}
+    n_pad = 8
+    while n_pad < n:
+        n_pad *= 2
+    W = (n_pad + 31) // 32
+    # padding ops: invoke at +inf so they are never candidates; returns just
+    # above the info-op cap so they never constrain minret below real ops
+    invokes = np.full(n_pad, 2 ** 30, np.int32)
+    returns = np.full(n_pad, 2 ** 29 + 1, np.int32)
+    op_sym = np.zeros(n_pad, np.int32)
+    must = np.zeros(W, np.uint32)
+    for i, op in enumerate(ops):
+        invokes[i] = op.invoke_pos
+        returns[i] = min(op.return_pos, 2 ** 29)
+        op_sym[i] = memo.op_sym[i]
+        if op.return_pos < NEVER:
+            must[i // 32] |= np.uint32(1 << (i % 32))
+    # padding ops: make them non-candidates (invoke = huge) and
+    # transitions irrelevant; returns huge so they never constrain minret
+    table = memo.table
+    rng = np.random.default_rng(0xC0FFEE)
+    z1 = rng.integers(0, 2 ** 32, n_pad, dtype=np.uint32)
+    z2 = rng.integers(0, 2 ** 32, n_pad, dtype=np.uint32)
+
+    lin, exhausted, overflow = _frontier_search(
+        n_pad, W, max_frontier, n + 1,
+        jnp.asarray(invokes), jnp.asarray(returns), jnp.asarray(op_sym),
+        jnp.asarray(must), jnp.asarray(table), jnp.asarray(z1),
+        jnp.asarray(z2), jnp.int32(memo.init_state))
+    lin, exhausted, overflow = (bool(lin), bool(exhausted), bool(overflow))
+    if overflow:
+        return {"valid?": "unknown", "op-count": n,
+                "reason": "frontier overflow", "hash_dedup": True}
+    return {"valid?": True if lin else False, "op-count": n,
+            "hash_dedup": True}

@@ -1,0 +1,159 @@
+"""Host WGL linearizability search (the exact anchor).
+
+Equivalent of `knossos/wgl.clj` (SURVEY.md §2.4): Wing-Gong-Lowe DFS over
+configurations (model state, linearized-set bitset) with a visited cache
+of packed configs.  Uses the memoized int transition table; bitsets are
+Python arbitrary-precision ints (the JVM BitSet analogue).  `info`
+(crashed) ops never return: they may linearize anywhere after invocation
+or not at all.
+
+This is BASELINE.json config 1's correctness anchor; the TPU batched
+frontier search (`device_wgl`) is differentially tested against it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from jepsen_tpu.checkers.knossos.memo import Memo, StateExplosion, memoize
+from jepsen_tpu.checkers.knossos.prep import NEVER, LinOp, prepare
+from jepsen_tpu.history.ops import History
+from jepsen_tpu.models import Inconsistent, Model
+
+
+def _search_memo(ops: Sequence[LinOp], memo: Memo,
+                 max_configs: int = 5_000_000):
+    """DFS over (linearized bitset, state).  Returns (ok, final_info)."""
+    n = len(ops)
+    must = 0  # bitmask of ops that MUST linearize (have returns)
+    for i, op in enumerate(ops):
+        if op.return_pos < NEVER:
+            must |= 1 << i
+    table = memo.table
+    op_sym = memo.op_sym
+    invokes = [op.invoke_pos for op in ops]
+    returns = [op.return_pos for op in ops]
+
+    # candidates(S): ops not in S invoked before min return of not-in-S ops
+    def candidates(S: int) -> List[int]:
+        minret = NEVER + 1
+        for i in range(n):
+            if not (S >> i) & 1 and returns[i] < minret:
+                minret = returns[i]
+        return [i for i in range(n)
+                if not (S >> i) & 1 and invokes[i] < minret]
+
+    seen = set()
+    # stack entries: (S, state, candidate list, next candidate index)
+    S, state = 0, memo.init_state
+    stack = [(S, state, candidates(S), 0)]
+    seen.add((S, state))
+    explored = 0
+    while stack:
+        S, state, cands, ci = stack[-1]
+        if (S & must) == must:
+            return True, None
+        if ci >= len(cands):
+            stack.pop()
+            continue
+        stack[-1] = (S, state, cands, ci + 1)
+        i = cands[ci]
+        s2 = int(table[state, op_sym[i]])
+        if s2 < 0:
+            continue
+        S2 = S | (1 << i)
+        key = (S2, s2)
+        if key in seen:
+            continue
+        seen.add(key)
+        explored += 1
+        if explored > max_configs:
+            return None, {"reason": "config budget exhausted"}
+        stack.append((S2, s2, candidates(S2), 0))
+    # exhausted without linearizing all required ops
+    return False, _final_info(ops, seen, memo)
+
+
+def _final_info(ops, seen, memo):
+    """Minimal failure context: the largest linearized sets reached."""
+    best = []
+    best_count = -1
+    for (S, st) in seen:
+        c = bin(S).count("1")
+        if c > best_count:
+            best_count = c
+            best = [(S, st)]
+        elif c == best_count and len(best) < 4:
+            best.append((S, st))
+    return {
+        "max-linearized": best_count,
+        "op-count": len(ops),
+        "configs": [{"linearized": [i for i in range(len(ops))
+                                    if (S >> i) & 1],
+                     "state": int(st)} for (S, st) in best[:4]],
+    }
+
+
+def _search_direct(ops: Sequence[LinOp], model: Model,
+                   max_configs: int = 1_000_000):
+    """Unmemoized DFS for models whose state space explodes."""
+    n = len(ops)
+    must = 0
+    for i, op in enumerate(ops):
+        if op.return_pos < NEVER:
+            must |= 1 << i
+    returns = [op.return_pos for op in ops]
+    invokes = [op.invoke_pos for op in ops]
+
+    def candidates(S: int) -> List[int]:
+        minret = NEVER + 1
+        for i in range(n):
+            if not (S >> i) & 1 and returns[i] < minret:
+                minret = returns[i]
+        return [i for i in range(n)
+                if not (S >> i) & 1 and invokes[i] < minret]
+
+    seen = set()
+    stack = [(0, model, candidates(0), 0)]
+    seen.add((0, model))
+    explored = 0
+    while stack:
+        S, m, cands, ci = stack[-1]
+        if (S & must) == must:
+            return True, None
+        if ci >= len(cands):
+            stack.pop()
+            continue
+        stack[-1] = (S, m, cands, ci + 1)
+        i = cands[ci]
+        m2 = m.step(ops[i].f, ops[i].value)
+        if isinstance(m2, Inconsistent):
+            continue
+        S2 = S | (1 << i)
+        if (S2, m2) in seen:
+            continue
+        seen.add((S2, m2))
+        explored += 1
+        if explored > max_configs:
+            return None, {"reason": "config budget exhausted"}
+        stack.append((S2, m2, candidates(S2), 0))
+    return False, {"op-count": n}
+
+
+def check(history: History | Sequence[LinOp], model: Model,
+          max_configs: int = 5_000_000) -> Dict[str, Any]:
+    """Check linearizability of a single-object history against a model."""
+    ops = history if isinstance(history, list) else prepare(history)
+    if not ops:
+        return {"valid?": "unknown", "op-count": 0}
+    try:
+        memo = memoize(model, ops)
+        ok, info = _search_memo(ops, memo, max_configs)
+    except StateExplosion:
+        ok, info = _search_direct(ops, model, max_configs)
+    if ok is None:
+        return {"valid?": "unknown", **(info or {})}
+    out: Dict[str, Any] = {"valid?": bool(ok), "op-count": len(ops)}
+    if info:
+        out["final-info"] = info
+    return out

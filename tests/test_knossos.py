@@ -1,0 +1,161 @@
+"""Linearizability checker tests: micro-histories with known verdicts plus
+host-vs-device differential testing (reference knossos test style,
+SURVEY.md §4)."""
+
+import pytest
+
+from jepsen_tpu.checkers.knossos import analysis, device_wgl, wgl
+from jepsen_tpu.checkers.knossos.prep import prepare
+from jepsen_tpu.history import history, invoke, ok, fail, info
+from jepsen_tpu.models import (
+    CASRegister,
+    FIFOQueue,
+    Mutex,
+    Register,
+    cas_register,
+    register,
+)
+from jepsen_tpu.workloads import synth
+
+
+def h_seq(*events):
+    return history(list(events))
+
+
+def test_trivially_linearizable():
+    h = h_seq(
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "read", None), ok(1, "read", 1),
+    )
+    assert wgl.check(h, register())["valid?"] is True
+
+
+def test_stale_read_not_linearizable():
+    h = h_seq(
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(0, "write", 2), ok(0, "write", 2),
+        invoke(1, "read", None), ok(1, "read", 1),
+    )
+    assert wgl.check(h, register())["valid?"] is False
+
+
+def test_concurrent_read_either_value():
+    # read concurrent with a write may see old or new value
+    h1 = h_seq(
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "read", None),
+        invoke(0, "write", 2),
+        ok(1, "read", 1),
+        ok(0, "write", 2),
+    )
+    h2 = h_seq(
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "read", None),
+        invoke(0, "write", 2),
+        ok(1, "read", 2),
+        ok(0, "write", 2),
+    )
+    assert wgl.check(h1, register())["valid?"] is True
+    assert wgl.check(h2, register())["valid?"] is True
+
+
+def test_cas_semantics():
+    h = h_seq(
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "cas", [1, 3]), ok(1, "cas", [1, 3]),
+        invoke(2, "read", None), ok(2, "read", 3),
+    )
+    assert wgl.check(h, cas_register())["valid?"] is True
+    h_bad = h_seq(
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "cas", [2, 3]), ok(1, "cas", [2, 3]),  # cas of wrong old
+    )
+    assert wgl.check(h_bad, cas_register())["valid?"] is False
+
+
+def test_failed_op_never_happened():
+    h = h_seq(
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "write", 9), fail(1, "write", 9),
+        invoke(2, "read", None), ok(2, "read", 1),
+    )
+    assert wgl.check(h, register())["valid?"] is True
+
+
+def test_info_write_may_or_may_not_apply():
+    base = [
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "write", 2), info(1, "write", 2),
+    ]
+    h_applied = h_seq(*base, invoke(2, "read", None), ok(2, "read", 2))
+    h_not = h_seq(*base, invoke(2, "read", None), ok(2, "read", 1))
+    assert wgl.check(h_applied, register())["valid?"] is True
+    assert wgl.check(h_not, register())["valid?"] is True
+    # but reading a value never written is invalid
+    h_bad = h_seq(*base, invoke(2, "read", None), ok(2, "read", 7))
+    assert wgl.check(h_bad, register())["valid?"] is False
+
+
+def test_mutex():
+    h = h_seq(
+        invoke(0, "acquire", None), ok(0, "acquire", None),
+        invoke(1, "acquire", None),
+        invoke(0, "release", None), ok(0, "release", None),
+        ok(1, "acquire", None),
+    )
+    assert wgl.check(h, Mutex())["valid?"] is True
+    h_bad = h_seq(
+        invoke(0, "acquire", None), ok(0, "acquire", None),
+        invoke(1, "acquire", None), ok(1, "acquire", None),
+    )
+    assert wgl.check(h_bad, Mutex())["valid?"] is False
+
+
+def test_fifo_queue():
+    h = h_seq(
+        invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+        invoke(0, "enqueue", 2), ok(0, "enqueue", 2),
+        invoke(1, "dequeue", None), ok(1, "dequeue", 1),
+        invoke(1, "dequeue", None), ok(1, "dequeue", 2),
+    )
+    assert wgl.check(h, FIFOQueue())["valid?"] is True
+    h_bad = h_seq(
+        invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+        invoke(0, "enqueue", 2), ok(0, "enqueue", 2),
+        invoke(1, "dequeue", None), ok(1, "dequeue", 2),  # out of order
+    )
+    assert wgl.check(h_bad, FIFOQueue())["valid?"] is False
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_synth_register_linearizable(seed):
+    h = synth.lin_register_history(n_ops=40, concurrency=3, seed=seed)
+    assert wgl.check(h, cas_register())["valid?"] is True
+
+
+def test_synth_register_stale_reads_detected():
+    hits = 0
+    for seed in range(8):
+        h = synth.lin_register_history(n_ops=40, concurrency=3,
+                                       stale_read_prob=0.4, seed=seed)
+        if wgl.check(h, cas_register())["valid?"] is False:
+            hits += 1
+    assert hits >= 4  # stale reads usually break linearizability
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_device_vs_host_differential(seed):
+    h = synth.lin_register_history(
+        n_ops=30, concurrency=3,
+        stale_read_prob=0.3 if seed % 2 else 0.0,
+        info_prob=0.1, seed=seed)
+    ops = prepare(h)
+    r_host = wgl.check(ops, cas_register())
+    r_dev = device_wgl.check(ops, cas_register(), max_frontier=4096)
+    assert r_host["valid?"] == r_dev["valid?"], (seed, r_host, r_dev)
+
+
+def test_analysis_competition():
+    h = synth.lin_register_history(n_ops=30, concurrency=3, seed=1)
+    res = analysis(h, cas_register())
+    assert res["valid?"] is True
