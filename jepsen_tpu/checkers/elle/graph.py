@@ -118,6 +118,44 @@ def realtime_edges(invoke_pos: np.ndarray, complete_pos: np.ndarray,
     return e, n
 
 
+def realtime_edges_subset(inv: np.ndarray, comp: np.ndarray,
+                          ok_ids: np.ndarray, in_mask: np.ndarray,
+                          n_nodes: int) -> Tuple[EdgeList, int]:
+    """Barrier-mediated realtime edges where only `ok_ids` complete and
+    nodes with `in_mask` receive in-edges (invoked).  Barrier node ids
+    start at n_nodes; returns (edges, n_barriers).  Barrier i corresponds
+    to the i-th completion in completion order (rank 2*comp+1)."""
+    ok_comp = comp[ok_ids]
+    order = np.argsort(ok_comp, kind="stable")
+    comp_sorted = ok_comp[order]
+    n_b = len(ok_ids)
+    if n_b == 0:
+        return EdgeList(), 0
+    src: List[np.ndarray] = [ok_ids[order].astype(np.int32)]
+    dst: List[np.ndarray] = [(n_nodes + np.arange(n_b)).astype(np.int32)]
+    if n_b > 1:
+        src.append((n_nodes + np.arange(n_b - 1)).astype(np.int32))
+        dst.append((n_nodes + np.arange(1, n_b)).astype(np.int32))
+    cand = np.nonzero(in_mask)[0]
+    b_idx = np.searchsorted(comp_sorted, inv[cand], side="left") - 1
+    mask = b_idx >= 0
+    if mask.any():
+        src.append((n_nodes + b_idx[mask]).astype(np.int32))
+        dst.append(cand[mask].astype(np.int32))
+    e = EdgeList()
+    e.src = np.concatenate(src)
+    e.dst = np.concatenate(dst)
+    e.rel = np.full(len(e.src), REL_REALTIME, dtype=np.int8)
+    return e, n_b
+
+
+def barrier_ranks(comp: np.ndarray, ok_ids: np.ndarray) -> np.ndarray:
+    """Ranks for the barrier nodes created by realtime_edges_subset."""
+    ok_comp = comp[ok_ids]
+    order = np.argsort(ok_comp, kind="stable")
+    return (2 * ok_comp[order] + 1).astype(np.int64)
+
+
 def process_edges(process: np.ndarray, invoke_pos: np.ndarray) -> EdgeList:
     """Chain each process's txns in invocation order (elle.core/process-graph)."""
     if len(process) == 0:

@@ -1,0 +1,91 @@
+"""Shared cycle-anomaly detection over host-built txn dependency edges.
+
+Used by checkers whose edge inference runs host-side (rw-register) but
+whose cycle *detection* still rides the device rank-sweep kernel — the
+same split `list_append` uses with device-built edges.  Falls back to host
+Tarjan + spec search when the device is unavailable or the sweep doesn't
+converge (exactness first).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from jepsen_tpu.checkers.elle.graph import (
+    REL_NAMES,
+    CycleSpec,
+    EdgeList,
+    find_cycle,
+    nontrivial_sccs,
+)
+from jepsen_tpu.checkers.elle.specs import CYCLE_ANOMALY_SPECS, SPEC_ORDER
+
+
+def cycle_anomalies(edges: EdgeList, n_nodes: int, rank: np.ndarray,
+                    want: set, use_device: bool = True,
+                    max_reported: int = 4) -> Dict[str, List[dict]]:
+    """Find cycle anomalies among `want` specs over the given edges.
+
+    rank: per-node order where most edges go forward (completion order);
+    used by the device sweep.  Returns {anomaly: [witness dicts]}.
+    """
+    specs = [(name, CYCLE_ANOMALY_SPECS[name]) for name in SPEC_ORDER
+             if name in want]
+    projections: Dict[frozenset, List[Tuple[str, CycleSpec]]] = {}
+    for name, spec in specs:
+        projections.setdefault(spec.rels, []).append((name, spec))
+
+    found: Dict[str, List[dict]] = {}
+    for rels, group in projections.items():
+        proj = edges.project(rels)
+        if not len(proj):
+            continue
+        regions = _cycle_regions(proj, n_nodes, rank, use_device)
+        if regions is None:
+            continue
+        for name, spec in group:
+            for region in regions[:max_reported * 4]:
+                hit = find_cycle(region, proj, spec)
+                if hit is not None:
+                    found.setdefault(name, []).append(
+                        {"cycle": [{"src": int(s), "rel": REL_NAMES[r],
+                                    "dst": int(d)} for (s, r, d) in hit]})
+                    break
+    return found
+
+
+def _cycle_regions(proj: EdgeList, n_nodes: int, rank: np.ndarray,
+                   use_device: bool):
+    """Node regions containing cycles, or None if the projection is
+    acyclic.  Device path: rank sweep -> witness backward edges -> local
+    BFS regions.  Host path: Tarjan SCCs."""
+    if use_device:
+        try:
+            import jax.numpy as jnp
+
+            from jepsen_tpu.ops.cycle_sweep import SweepGraph, detect_cycles
+
+            g = SweepGraph(
+                n_nodes=n_nodes, rank=jnp.asarray(rank),
+                nc_src=jnp.asarray(proj.src), nc_dst=jnp.asarray(proj.dst),
+                nc_mask=jnp.ones(len(proj.src), bool),
+                chain_nodes=jnp.zeros(0, jnp.int32),
+                chain_starts=jnp.zeros(0, bool),
+                chain_mask=jnp.zeros(0, bool))
+            res = detect_cycles(g)
+            if res.converged:
+                if not res.has_cycle:
+                    return None
+                from jepsen_tpu.checkers.elle.list_append import (
+                    _witness_regions,
+                )
+                regions = _witness_regions(
+                    proj, proj.src, proj.dst, res.witness_edge_ids, n_nodes)
+                if regions:
+                    return regions
+        except Exception:
+            pass  # fall through to exact host path
+    sccs = nontrivial_sccs(n_nodes, proj.src, proj.dst)
+    return sccs if sccs else None

@@ -429,3 +429,65 @@ def lin_register_history(n_ops: int = 50, concurrency: int = 3,
                 rv = history_vals[int(rng.integers(0, len(history_vals) - 1))]
             ops.append(Op(type=OK, process=p, f=f, value=rv))
     return History(ops)
+
+
+# ---------------------------------------------------------------------------
+# rw-register histories.
+# ---------------------------------------------------------------------------
+
+
+def rw_history(n_txns: int = 100, n_keys: int = 5, concurrency: int = 5,
+               max_mops: int = 3, read_prob: float = 0.5,
+               fail_prob: float = 0.0, info_prob: float = 0.0,
+               seed: int = 0) -> History:
+    """Simulate a strict-serializable rw-register history (unique writes)."""
+    rng = np.random.default_rng(seed)
+    db: Dict[int, Optional[int]] = {k: None for k in range(n_keys)}
+    next_val = 1
+    ops: List[Op] = []
+    open_txn: Dict[int, List] = {}
+    committed = 0
+    while committed < n_txns or open_txn:
+        p = int(rng.integers(0, concurrency))
+        if p not in open_txn:
+            if committed + len(open_txn) >= n_txns:
+                if not open_txn:
+                    break
+                p = list(open_txn.keys())[int(rng.integers(0, len(open_txn)))]
+            else:
+                mops = []
+                for _ in range(int(rng.integers(1, max_mops + 1))):
+                    k = int(rng.integers(0, n_keys))
+                    if rng.random() < read_prob:
+                        mops.append(["r", k, None])
+                    else:
+                        mops.append(["w", k, next_val])
+                        next_val += 1
+                ops.append(Op(type=INVOKE, process=p, f="txn",
+                              value=[list(m) for m in mops]))
+                open_txn[p] = mops
+                continue
+        mops = open_txn.pop(p)
+        committed += 1
+        r = rng.random()
+        if r < fail_prob:
+            ops.append(Op(type=FAIL, process=p, f="txn",
+                          value=[list(m) for m in mops]))
+            continue
+        is_info = r < fail_prob + info_prob
+        apply_w = (not is_info) or rng.random() < 0.5
+        local = dict(db)
+        filled = []
+        for m in mops:
+            if m[0] == "w":
+                local[m[1]] = m[2]
+                filled.append(["w", m[1], m[2]])
+            else:
+                filled.append(["r", m[1], local[m[1]]])
+        if apply_w:
+            db.update(local)
+        if is_info:
+            ops.append(Op(type=INFO, process=p, f="txn", value=None))
+        else:
+            ops.append(Op(type=OK, process=p, f="txn", value=filled))
+    return History(ops)

@@ -1,0 +1,131 @@
+"""rw-register checker tests (reference rw_register_test.clj style)."""
+
+import pytest
+
+from jepsen_tpu.checkers.elle import rw_register
+from jepsen_tpu.history import history, invoke, ok, fail, info
+from jepsen_tpu.workloads import synth
+
+
+def concurrent_history(*txns):
+    inv, comp = [], []
+    for i, (mops_inv, mops_ok) in enumerate(txns):
+        inv.append(invoke(i, "txn", mops_inv))
+        if mops_ok == "fail":
+            comp.append(fail(i, "txn", mops_inv))
+        else:
+            comp.append(ok(i, "txn", mops_ok))
+    return history(inv + comp)
+
+
+def test_valid_simple():
+    h = concurrent_history(
+        ([["w", "x", 1]], [["w", "x", 1]]),
+        ([["r", "x", None]], [["r", "x", 1]]),
+    )
+    res = rw_register.check(h, ["serializable"])
+    assert res["valid?"] is True, res
+
+
+def test_g1a():
+    h = concurrent_history(
+        ([["w", "x", 1]], "fail"),
+        ([["r", "x", None]], [["r", "x", 1]]),
+    )
+    res = rw_register.check(h, ["serializable"])
+    assert res["valid?"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_g1b_intermediate():
+    h = concurrent_history(
+        ([["w", "x", 1], ["w", "x", 2]], [["w", "x", 1], ["w", "x", 2]]),
+        ([["r", "x", None]], [["r", "x", 1]]),
+    )
+    res = rw_register.check(h, ["serializable"])
+    assert "G1b" in res["anomaly-types"]
+
+
+def test_internal():
+    h = concurrent_history(
+        ([["w", "x", 1], ["r", "x", None]],
+         [["w", "x", 1], ["r", "x", 9]]),
+        ([["w", "x", 9]], [["w", "x", 9]]),
+    )
+    res = rw_register.check(h, ["serializable"])
+    assert "internal" in res["anomaly-types"]
+
+
+def test_lost_update():
+    # T0 and T1 both read x=nil then write -> both updated the same version
+    h = concurrent_history(
+        ([["r", "x", None], ["w", "x", 1]],
+         [["r", "x", None], ["w", "x", 1]]),
+        ([["r", "x", None], ["w", "x", 2]],
+         [["r", "x", None], ["w", "x", 2]]),
+    )
+    res = rw_register.check(h, ["snapshot-isolation"])
+    assert res["valid?"] is False
+    assert "lost-update" in res["anomaly-types"]
+
+
+def test_g1c_wr_cycle():
+    # T0 writes x=1 and reads y=9; T1 writes y=9 and reads x=1
+    h = concurrent_history(
+        ([["w", "x", 1], ["r", "y", None]],
+         [["w", "x", 1], ["r", "y", 9]]),
+        ([["w", "y", 9], ["r", "x", None]],
+         [["w", "y", 9], ["r", "x", 1]]),
+    )
+    res = rw_register.check(h, ["read-committed"])
+    assert res["valid?"] is False
+    assert "G1c" in res["anomaly-types"]
+
+
+def test_write_skew_g2():
+    # classic write skew via rw edges from nil reads
+    h = concurrent_history(
+        ([["r", "x", None], ["w", "y", 10]],
+         [["r", "x", None], ["w", "y", 10]]),
+        ([["r", "y", None], ["w", "x", 1]],
+         [["r", "y", None], ["w", "x", 1]]),
+    )
+    res = rw_register.check(h, ["serializable"])
+    assert res["valid?"] is False
+    assert "G2-item" in res["anomaly-types"]
+    res_si = rw_register.check(h, ["snapshot-isolation"])
+    assert res_si["valid?"] is True
+
+
+def test_realtime_strict_only():
+    # read of a value written by a txn that invoked after the reader done
+    h = history([
+        invoke(0, "txn", [["r", "x", None]]),
+        ok(0, "txn", [["r", "x", 1]]),
+        invoke(1, "txn", [["w", "x", 1]]),
+        ok(1, "txn", [["w", "x", 1]]),
+    ])
+    res = rw_register.check(h, ["strict-serializable"])
+    assert res["valid?"] is False
+    assert "G1c-realtime" in res["anomaly-types"]
+    res2 = rw_register.check(h, ["serializable"])
+    assert res2["valid?"] is True
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_synth_valid(seed):
+    h = synth.rw_history(n_txns=150, n_keys=6, concurrency=5,
+                         fail_prob=0.05, info_prob=0.05, seed=seed)
+    res = rw_register.check(h, ["strict-serializable"])
+    assert res["valid?"] is True, (res["anomaly-types"], res["anomalies"])
+
+
+def test_synth_device_host_same():
+    for seed in range(4):
+        h = synth.rw_history(n_txns=120, n_keys=5, seed=seed)
+        r_dev = rw_register.check(h, ["strict-serializable"],
+                                  use_device=True)
+        r_host = rw_register.check(h, ["strict-serializable"],
+                                   use_device=False)
+        assert r_dev["valid?"] == r_host["valid?"]
+        assert r_dev["anomaly-types"] == r_host["anomaly-types"]
