@@ -57,7 +57,7 @@ ALIASES = {
 # a model also proscribes everything its weaker models do).
 PROSCRIBED: Dict[str, Set[str]] = {
     "read-uncommitted": {"G0", "duplicate-elements", "incompatible-order",
-                         "cyclic-versions"},
+                         "cyclic-versions", "duplicate-writes"},
     "read-committed": {"G1a", "G1b", "G1c", "dirty-update", "aborted-read",
                        "intermediate-read"},
     "monotonic-atomic-view": {"monotonic-atomic-view-violation"},

@@ -92,45 +92,26 @@ def realtime_edges(invoke_pos: np.ndarray, complete_pos: np.ndarray,
     n = len(invoke_pos)
     if n == 0:
         return EdgeList(), 0
-    order = np.argsort(complete_pos, kind="stable")
-    comp_sorted = complete_pos[order]
-    # barrier b has "position" comp_sorted[b]; txn order[b] enters barrier b
-    src: List[np.ndarray] = []
-    dst: List[np.ndarray] = []
-    # txn -> its barrier
-    src.append(order.astype(np.int32))
-    dst.append((node_offset + np.arange(n)).astype(np.int32))
-    # barrier chain
-    if n > 1:
-        src.append((node_offset + np.arange(n - 1)).astype(np.int32))
-        dst.append((node_offset + np.arange(1, n)).astype(np.int32))
-    # barrier -> txn for the latest barrier strictly before each invoke
-    b_idx = np.searchsorted(comp_sorted, invoke_pos, side="left") - 1
-    mask = b_idx >= 0
-    if mask.any():
-        src.append((node_offset + b_idx[mask]).astype(np.int32))
-        dst.append(np.nonzero(mask)[0].astype(np.int32))
-    s = np.concatenate(src)
-    d = np.concatenate(dst)
-    e = EdgeList()
-    e.src, e.dst = s, d
-    e.rel = np.full(len(s), REL_REALTIME, dtype=np.int8)
-    return e, n
+    e, n_b, _ = realtime_edges_subset(invoke_pos, complete_pos,
+                                      np.arange(n), np.ones(n, bool),
+                                      node_offset)
+    return e, n_b
 
 
 def realtime_edges_subset(inv: np.ndarray, comp: np.ndarray,
                           ok_ids: np.ndarray, in_mask: np.ndarray,
-                          n_nodes: int) -> Tuple[EdgeList, int]:
+                          n_nodes: int) -> Tuple[EdgeList, int, np.ndarray]:
     """Barrier-mediated realtime edges where only `ok_ids` complete and
     nodes with `in_mask` receive in-edges (invoked).  Barrier node ids
-    start at n_nodes; returns (edges, n_barriers).  Barrier i corresponds
-    to the i-th completion in completion order (rank 2*comp+1)."""
+    start at n_nodes; returns (edges, n_barriers, barrier_ranks).  Barrier
+    i corresponds to the i-th completion in completion order; its rank
+    (2*comp+1) interleaves with txn ranks 2*comp."""
     ok_comp = comp[ok_ids]
     order = np.argsort(ok_comp, kind="stable")
     comp_sorted = ok_comp[order]
     n_b = len(ok_ids)
     if n_b == 0:
-        return EdgeList(), 0
+        return EdgeList(), 0, np.zeros(0, np.int64)
     src: List[np.ndarray] = [ok_ids[order].astype(np.int32)]
     dst: List[np.ndarray] = [(n_nodes + np.arange(n_b)).astype(np.int32)]
     if n_b > 1:
@@ -146,14 +127,7 @@ def realtime_edges_subset(inv: np.ndarray, comp: np.ndarray,
     e.src = np.concatenate(src)
     e.dst = np.concatenate(dst)
     e.rel = np.full(len(e.src), REL_REALTIME, dtype=np.int8)
-    return e, n_b
-
-
-def barrier_ranks(comp: np.ndarray, ok_ids: np.ndarray) -> np.ndarray:
-    """Ranks for the barrier nodes created by realtime_edges_subset."""
-    ok_comp = comp[ok_ids]
-    order = np.argsort(ok_comp, kind="stable")
-    return (2 * ok_comp[order] + 1).astype(np.int64)
+    return e, n_b, (2 * comp_sorted + 1).astype(np.int64)
 
 
 def process_edges(process: np.ndarray, invoke_pos: np.ndarray) -> EdgeList:

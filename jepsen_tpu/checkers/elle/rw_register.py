@@ -33,7 +33,6 @@ from jepsen_tpu.checkers.elle.graph import (
     REL_WR,
     REL_WW,
     EdgeList,
-    barrier_ranks,
     nontrivial_sccs,
     process_edges,
     realtime_edges_subset,
@@ -50,7 +49,6 @@ from jepsen_tpu.history.soa import (
 )
 
 NO_PREV = -3
-UNKNOWN = -2
 
 
 def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
@@ -84,21 +82,20 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
     mkey = p.mop_key.astype(np.int64)
     mval = p.mop_val.astype(np.int64)
     known = np.where(kind == MOP_READ, p.mop_rd_len >= 0, True)
-    is_write = (kind == MOP_APPEND) & graph_txn[mtxn]
-    is_fail_write = (kind == MOP_APPEND) & (ttype[mtxn] == TXN_FAIL)
-    is_read = (kind == MOP_READ) & known & ok[mtxn]
 
-    # value encodings: real vals [0, V); init(k) = V + k
-    init_of = V + mkey
-    read_val = np.where(mval >= 0, mval, init_of)  # nil read -> init
-
-    # writers (unique by contract; duplicates flagged, first wins)
+    # writers (unique by contract; duplicates flagged).  On a duplicate,
+    # attribute the value to a *committed* writer when one exists (ok over
+    # info over fail) so an aborted duplicate can't fabricate a G1a against
+    # readers of the committed write; the broken contract itself is
+    # reported as duplicate-writes, which invalidates read-uncommitted.
     writer = np.full(V, -1, np.int64)
     wsel = np.nonzero(kind == MOP_APPEND)[0]
     wvals = mval[wsel]
     dup = np.zeros(0, np.int64)
     if len(wsel):
-        order = np.argsort(wvals, kind="stable")
+        prio = np.select([ok[mtxn[wsel]], ttype[mtxn[wsel]] == TXN_INFO],
+                         [0, 1], 2)
+        order = np.lexsort((wsel, prio, wvals))
         sv = wvals[order]
         first = np.concatenate([[True], sv[1:] != sv[:-1]])
         writer[sv[first]] = mtxn[wsel][order][first]
@@ -261,11 +258,10 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
     pe = process_edges(np.where(graph_txn, proc, -10 ** 9 - np.arange(T)),
                        inv)
     ok_ids = np.nonzero(ok)[0]
-    rte, n_b = realtime_edges_subset(inv, comp, ok_ids, graph_txn, T)
+    rte, n_b, b_ranks = realtime_edges_subset(inv, comp, ok_ids, graph_txn, T)
     edges = EdgeList.concat([dep, pe, rte]).dedup()
     n_nodes = T + n_b
-    rank = np.concatenate([2 * comp, barrier_ranks(comp, ok_ids)]) \
-        .astype(np.int32)
+    rank = np.concatenate([2 * comp, b_ranks]).astype(np.int32)
 
     # ---- cycle anomalies --------------------------------------------------
     want = set(consistency.anomalies_for_models(

@@ -165,7 +165,10 @@ def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
         # no backward edges: forward edges strictly increase rank, so the
         # projection is a DAG — nothing to propagate (the common case for
         # valid histories; this skip is the fast path)
-        return (n_back < 0, jnp.zeros((max_k,), jnp.int8), n_back >= 0)
+        # zeros derived from n_back so the varying-axis type matches the
+        # propagate branch under shard_map
+        zeros = jnp.zeros((max_k,), jnp.int8) + (n_back * 0).astype(jnp.int8)
+        return (n_back < 0, zeros, n_back >= 0)
 
     has_cycle, witness, converged = jax.lax.cond(
         n_back > 0, propagate, acyclic, operand=None)

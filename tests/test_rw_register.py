@@ -129,3 +129,28 @@ def test_synth_device_host_same():
                                    use_device=False)
         assert r_dev["valid?"] == r_host["valid?"]
         assert r_dev["anomaly-types"] == r_host["anomaly-types"]
+
+
+def test_duplicate_writes_invalidate():
+    # two committed writes of the same value break the unique-write
+    # contract: the history must be invalid, not just annotated
+    h = concurrent_history(
+        ([["w", "x", 1]], [["w", "x", 1]]),
+        ([["w", "x", 1]], [["w", "x", 1]]),
+    )
+    res = rw_register.check(h, ["serializable"])
+    assert res["valid?"] is False
+    assert "duplicate-writes" in res["anomaly-types"]
+
+
+def test_aborted_duplicate_does_not_fabricate_g1a():
+    # a FAILED duplicate of a committed write must not make readers of the
+    # committed value look like aborted reads
+    h = concurrent_history(
+        ([["w", "x", 1]], "fail"),
+        ([["w", "x", 1]], [["w", "x", 1]]),
+        ([["r", "x", None]], [["r", "x", 1]]),
+    )
+    res = rw_register.check(h, ["serializable"])
+    assert "G1a" not in res["anomaly-types"], res
+    assert "duplicate-writes" in res["anomaly-types"]
