@@ -1,0 +1,146 @@
+"""In-process simulated cluster.
+
+The reference tests `core/run!` without SSH via noop dbs and docker
+(SURVEY.md §4); this module is the equivalent pure-Python strategy: a
+shared in-memory store with a `Client` implementation covering the standard
+workload op shapes, plus optional fault knobs (latency, crash probability)
+so interpreter/core tests can exercise :info paths deterministically.
+
+Supported op :f shapes (matching the workloads in jepsen_tpu.workloads):
+  read / write / cas        — single register ops (linearizable-register)
+  txn                       — list of mops [["append",k,v] | ["r",k,None] |
+                              ["w",k,v]] executed atomically (elle
+                              workloads)
+  add / read                — set workload (add element, read all)
+  enqueue / dequeue         — queue workload
+  transfer / read           — bank workload (value {from,to,amount} /
+                              account->balance map)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.client import Client
+
+
+class MemStore:
+    """The 'cluster': a lock-protected shared state."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv: Dict[Any, Any] = {}
+        self.lists: Dict[Any, List[Any]] = {}
+        self.set_elems: set = set()
+        self.queue: List[Any] = []
+        self.accounts: Dict[Any, int] = {}
+
+
+class MemClient(Client):
+    """Client over a MemStore.
+
+    `latency` sleeps that long per op (seconds); `crash_p` completes ops as
+    :info with that probability *after* applying them (indeterminate but
+    actually-applied — the hard case checkers must handle); `fail_p`
+    completes as :fail *without* applying (clean abort)."""
+
+    def __init__(self, store: Optional[MemStore] = None, *,
+                 latency: float = 0.0, crash_p: float = 0.0,
+                 fail_p: float = 0.0, rng: Optional[random.Random] = None,
+                 txn_kind: str = "list-append"):
+        self.store = store or MemStore()
+        self.latency = latency
+        self.crash_p = crash_p
+        self.fail_p = fail_p
+        self.rng = rng or random.Random(0)
+        self.txn_kind = txn_kind  # "list-append" | "rw-register"
+
+    def open(self, test, node):
+        return self  # connectionless; all "nodes" share the store
+
+    def invoke(self, test, op):
+        if self.latency:
+            time.sleep(self.latency)
+        if self.fail_p and self.rng.random() < self.fail_p:
+            return dict(op, type="fail", error="simulated-abort")
+        s = self.store
+        f = op["f"]
+        v = op.get("value")
+        with s.lock:
+            if f == "read" and not isinstance(v, dict):
+                out = dict(op, type="ok", value=self._read_value(test))
+            elif f == "write":
+                s.kv["x"] = v
+                out = dict(op, type="ok")
+            elif f == "cas":
+                old, new = v
+                if s.kv.get("x") == old:
+                    s.kv["x"] = new
+                    out = dict(op, type="ok")
+                else:
+                    out = dict(op, type="fail")
+            elif f == "txn":
+                out = dict(op, type="ok", value=self._apply_txn(v))
+            elif f == "add":
+                s.set_elems.add(v)
+                out = dict(op, type="ok")
+            elif f == "enqueue":
+                s.queue.append(v)
+                out = dict(op, type="ok")
+            elif f == "dequeue":
+                if s.queue:
+                    out = dict(op, type="ok", value=s.queue.pop(0))
+                else:
+                    out = dict(op, type="fail", error="empty")
+            elif f == "transfer":
+                frm, to, amt = v["from"], v["to"], v["amount"]
+                if s.accounts.get(frm, 0) < amt:
+                    out = dict(op, type="fail", error="insufficient")
+                else:
+                    s.accounts[frm] -= amt
+                    s.accounts[to] = s.accounts.get(to, 0) + amt
+                    out = dict(op, type="ok")
+            else:
+                raise ValueError(f"unknown op f {f!r}")
+        if out["type"] == "ok" and self.crash_p \
+                and self.rng.random() < self.crash_p:
+            return dict(op, type="info", error="simulated-crash")
+        return out
+
+    def _read_value(self, test):
+        s = self.store
+        workload = (test or {}).get("workload-kind", "register")
+        if workload == "set":
+            return sorted(s.set_elems)
+        if workload == "bank":
+            return dict(s.accounts)
+        return s.kv.get("x")
+
+    def _apply_txn(self, mops):
+        s = self.store
+        out = []
+        for mop in mops:
+            kind, k, v = mop[0], mop[1], mop[2] if len(mop) > 2 else None
+            if kind == "append":
+                s.lists.setdefault(k, []).append(v)
+                out.append(["append", k, v])
+            elif kind == "r":
+                if self.txn_kind == "rw-register":
+                    out.append(["r", k, s.kv.get(k)])
+                else:
+                    out.append(["r", k, list(s.lists.get(k, []))])
+            elif kind == "w":
+                s.kv[k] = v
+                out.append(["w", k, v])
+            else:
+                raise ValueError(f"unknown mop kind {kind!r}")
+        return out
+
+
+def bank_store(n_accounts: int = 8, balance: int = 10) -> MemStore:
+    s = MemStore()
+    s.accounts = {i: balance for i in range(n_accounts)}
+    return s

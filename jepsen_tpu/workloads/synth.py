@@ -491,3 +491,52 @@ def rw_history(n_txns: int = 100, n_keys: int = 5, concurrency: int = 5,
         else:
             ops.append(Op(type=OK, process=p, f="txn", value=filled))
     return History(ops)
+
+
+def la_generator(n_keys: int = 5, min_mops: int = 1, max_mops: int = 4,
+                 read_frac: float = 0.5, rng=None):
+    """Live list-append workload generator (the `elle.list-append/gen`
+    equivalent, SURVEY.md §2.3): a generator-DSL function emitting random
+    txn op templates with per-key unique, monotonically increasing append
+    values.  Feed to the interpreter via `generator.core.lift`."""
+    import random as _random
+
+    rng = rng or _random
+    counters: Dict[int, int] = {}
+
+    def gen(test, ctx):
+        mops = []
+        for _ in range(rng.randint(min_mops, max_mops)):
+            k = rng.randrange(n_keys)
+            if rng.random() < read_frac:
+                mops.append(["r", k, None])
+            else:
+                counters[k] = counters.get(k, 0) + 1
+                mops.append(["append", k, counters[k]])
+        return {"f": "txn", "value": mops}
+
+    return gen
+
+
+def rw_generator(n_keys: int = 5, min_mops: int = 1, max_mops: int = 4,
+                 read_frac: float = 0.5, rng=None):
+    """Live rw-register workload generator (`elle.rw-register/gen`
+    equivalent): random [w k v]/[r k nil] txns with globally unique writes
+    per key."""
+    import random as _random
+
+    rng = rng or _random
+    counters: Dict[int, int] = {}
+
+    def gen(test, ctx):
+        mops = []
+        for _ in range(rng.randint(min_mops, max_mops)):
+            k = rng.randrange(n_keys)
+            if rng.random() < read_frac:
+                mops.append(["r", k, None])
+            else:
+                counters[k] = counters.get(k, 0) + 1
+                mops.append(["w", k, counters[k]])
+        return {"f": "txn", "value": mops}
+
+    return gen

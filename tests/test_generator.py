@@ -1,0 +1,244 @@
+"""Generator DSL tests.
+
+Mirrors the reference's generator test strategy (SURVEY.md §4): drive
+generators with a fake context / simulated perfect clock and assert on the
+exact op sequences."""
+
+import random
+
+from jepsen_tpu.generator import core as g
+from jepsen_tpu.generator.context import Context, context
+from jepsen_tpu.generator.sim import completions, invokes, simulate
+
+TEST = {"concurrency": 2}
+
+
+def ops_of(events):
+    return [(e["f"], e["value"]) for e in invokes(events)]
+
+
+# -- lifting ----------------------------------------------------------------
+
+def test_map_is_one_shot():
+    evs = simulate({"f": "read", "value": None}, TEST)
+    assert ops_of(evs) == [("read", None)]
+    # invoke then ok
+    assert [e["type"] for e in evs] == ["invoke", "ok"]
+
+
+def test_map_gets_process_and_time():
+    evs = simulate({"f": "read", "value": None}, TEST)
+    inv = invokes(evs)[0]
+    assert inv["process"] == 0
+    assert inv["time"] >= 0
+
+
+def test_fn_is_infinite_with_limit():
+    counter = {"n": 0}
+
+    def w(test, ctx):
+        counter["n"] += 1
+        return {"f": "write", "value": counter["n"]}
+
+    evs = simulate(g.limit(3, w), TEST)
+    assert ops_of(evs) == [("write", 1), ("write", 2), ("write", 3)]
+
+
+def test_seq_runs_in_order():
+    evs = simulate([{"f": "a", "value": None}, {"f": "b", "value": None}], TEST)
+    assert [f for f, _ in ops_of(evs)] == ["a", "b"]
+
+
+def test_nested_seqs():
+    evs = simulate([[{"f": "a", "value": 1}], [{"f": "b", "value": 2},
+                                               {"f": "c", "value": 3}]], TEST)
+    assert [f for f, _ in ops_of(evs)] == ["a", "b", "c"]
+
+
+# -- cardinality ------------------------------------------------------------
+
+def test_repeat_n():
+    evs = simulate(g.repeat({"f": "r", "value": None}, 4), TEST)
+    assert len(invokes(evs)) == 4
+
+
+def test_once():
+    evs = simulate(g.once(lambda t, c: {"f": "r", "value": None}), TEST)
+    assert len(invokes(evs)) == 1
+
+
+# -- scheduling -------------------------------------------------------------
+
+def test_delay_spaces_ops():
+    evs = simulate(g.delay(1.0, g.repeat({"f": "r", "value": None}, 3)), TEST)
+    times = [e["time"] for e in invokes(evs)]
+    assert times[1] - times[0] >= 1_000_000_000
+    assert times[2] - times[1] >= 1_000_000_000
+
+
+def test_stagger_spaces_ops_on_average():
+    rng = random.Random(0)
+    evs = simulate(
+        g.stagger(0.1, g.repeat({"f": "r", "value": None}, 50), rng=rng), TEST)
+    times = [e["time"] for e in invokes(evs)]
+    span = times[-1] - times[0]
+    # 50 ops averaging 0.1s apart -> ~4.9s; allow wide tolerance
+    assert 2e9 < span < 10e9
+
+
+def test_sleep_then_op():
+    evs = simulate([g.sleep(5.0), {"f": "r", "value": None}], TEST)
+    inv = invokes(evs)[0]
+    assert inv["time"] >= 5_000_000_000
+
+
+def test_time_limit():
+    evs = simulate(
+        g.time_limit(1.0, g.delay(0.3, g.cycle({"f": "r", "value": None}))),
+        TEST)
+    n = len(invokes(evs))
+    assert 2 <= n <= 4  # ops at t=0, .3, .6, .9
+
+
+# -- composition ------------------------------------------------------------
+
+def test_then():
+    evs = simulate(g.then({"f": "a", "value": None}, {"f": "b", "value": None}),
+                   TEST)
+    assert [f for f, _ in ops_of(evs)] == ["a", "b"]
+
+
+def test_mix_draws_from_all():
+    rng = random.Random(42)
+    evs = simulate(
+        g.limit(60, g.mix([lambda t, c: {"f": "a", "value": None},
+                           lambda t, c: {"f": "b", "value": None}], rng=rng)),
+        TEST)
+    fs = [f for f, _ in ops_of(evs)]
+    assert 10 < fs.count("a") < 50
+    assert 10 < fs.count("b") < 50
+
+
+def test_mix_finishes_exhausted_members():
+    rng = random.Random(7)
+    evs = simulate(g.mix([{"f": "a", "value": None},
+                          {"f": "b", "value": None}], rng=rng), TEST)
+    assert sorted(f for f, _ in ops_of(evs)) == ["a", "b"]
+
+
+def test_any_picks_soonest():
+    evs = simulate(g.any_gen([g.sleep(5.0), {"f": "slow", "value": None}],
+                             {"f": "fast", "value": None}), TEST)
+    fs = [f for f, _ in ops_of(evs)]
+    assert fs[0] == "fast"
+
+
+def test_flip_flop():
+    evs = simulate(
+        g.limit(4, g.flip_flop(g.cycle({"f": "a", "value": None}),
+                               g.cycle({"f": "b", "value": None}))), TEST)
+    assert [f for f, _ in ops_of(evs)] == ["a", "b", "a", "b"]
+
+
+def test_filter():
+    ctr = {"n": 0}
+
+    def go(test, ctx):
+        ctr["n"] += 1
+        return {"f": "w", "value": ctr["n"]}
+
+    evs = simulate(g.limit(3, g.filter_gen(lambda op: op["value"] % 2 == 0, go)),
+                   TEST)
+    assert [v for _, v in ops_of(evs)] == [2, 4, 6]
+
+
+def test_f_map():
+    evs = simulate(g.f_map(lambda op: dict(op, value=99),
+                           {"f": "w", "value": 1}), TEST)
+    assert ops_of(evs) == [("w", 99)]
+
+
+def test_until_ok():
+    evs = simulate(g.until_ok(g.cycle({"f": "r", "value": None})), TEST)
+    # first op's ok completion ends the stream; in-flight ops may add a few
+    assert len(invokes(evs)) <= 4
+    assert completions(evs)[0]["type"] == "ok"
+
+
+# -- thread restriction -----------------------------------------------------
+
+def test_clients_excludes_nemesis():
+    evs = simulate(g.clients(g.limit(6, lambda t, c: {"f": "r", "value": None})),
+                   TEST)
+    assert all(isinstance(e["process"], int) for e in invokes(evs))
+
+
+def test_nemesis_only():
+    evs = simulate(g.nemesis(g.limit(2, lambda t, c: {"f": "start", "value": None})),
+                   TEST)
+    assert all(e["process"] == "nemesis" for e in invokes(evs))
+
+
+def test_reserve_partitions_threads():
+    test = {"concurrency": 4}
+    evs = simulate(
+        g.limit(40, g.reserve(2, g.cycle({"f": "a", "value": None}),
+                              g.cycle({"f": "b", "value": None}))), test)
+    for e in invokes(evs):
+        if e["f"] == "a":
+            assert e["process"] in (0, 1)
+        elif e["f"] == "b":
+            assert e["process"] in (2, 3)
+    fs = {f for f, _ in ops_of(evs)}
+    assert fs == {"a", "b"}
+
+
+def test_phases_barrier():
+    test = {"concurrency": 3}
+    evs = simulate(
+        g.phases(g.clients(g.each_thread({"f": "a", "value": None})),
+                 g.clients(g.each_thread({"f": "b", "value": None}))), test)
+    a_completions = [e for e in completions(evs) if e["f"] == "a"]
+    b_invokes = [e for e in invokes(evs) if e["f"] == "b"]
+    assert len(a_completions) == 3 and len(b_invokes) == 3
+    latest_a = max(e["time"] for e in a_completions)
+    earliest_b = min(e["time"] for e in b_invokes)
+    assert latest_a <= earliest_b
+
+
+def test_each_thread():
+    test = {"concurrency": 3}
+    evs = simulate(g.clients(g.each_thread({"f": "w", "value": 1})), test)
+    procs = sorted(e["process"] for e in invokes(evs))
+    assert procs == [0, 1, 2]
+
+
+# -- updates & crashed processes -------------------------------------------
+
+def test_info_crash_bumps_process():
+    test = {"concurrency": 2}
+
+    def complete(op):
+        # process 0's first op crashes
+        if op["process"] == 0:
+            return dict(op, type="info")
+        return dict(op, type="ok")
+
+    evs = simulate(g.limit(4, lambda t, c: {"f": "r", "value": None}),
+                   test, complete=complete)
+    procs = {e["process"] for e in invokes(evs)}
+    # thread 0 reincarnates as process 2 (0 + concurrency), then 4 ...
+    assert 2 in procs or 4 in procs
+
+
+def test_context_basics():
+    ctx = Context.make(3)
+    assert ctx.all_threads() == [0, 1, 2, "nemesis"]
+    assert ctx.some_free_process() == 0
+    ctx2 = ctx.busy_thread(0)
+    assert ctx2.some_free_process() == 1
+    ctx3 = ctx2.with_next_process(0, 3)
+    assert ctx3.process_for_thread(0) == 3
+    sub = ctx.restrict(lambda t: t == "nemesis")
+    assert sub.all_threads() == ["nemesis"]
+    assert sub.free_processes() == ["nemesis"]

@@ -1,0 +1,110 @@
+"""Interpreter tests: real worker threads against the in-process simulated
+cluster (reference core_test.clj / interpreter strategy, SURVEY.md §4)."""
+
+import random
+
+from jepsen_tpu.generator import core as g
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history.ops import INFO, INVOKE, OK
+from jepsen_tpu.workloads.mem import MemClient, MemStore
+
+
+def run_test(gen, *, concurrency=3, client=None, nodes=None, **kw):
+    test = {"concurrency": concurrency,
+            "client": client or MemClient(),
+            "nodes": nodes or ["n1", "n2", "n3"],
+            "generator": gen, **kw}
+    return interpreter.run(test)
+
+
+def test_basic_run_builds_history():
+    h = run_test(g.clients(g.limit(10, lambda t, c: {"f": "read", "value": None})))
+    invokes = [op for op in h if op.type == INVOKE]
+    oks = [op for op in h if op.type == OK]
+    assert len(invokes) == 10
+    assert len(oks) == 10
+    # histories are dense: index == position, invoke/completion paired
+    for op in invokes:
+        comp = h.completion(op)
+        assert comp is not None and comp.f == op.f
+
+
+def test_concurrency_respected():
+    h = run_test(g.clients(g.limit(30, lambda t, c: {"f": "read", "value": None})),
+                 concurrency=2)
+    open_count, worst = 0, 0
+    for op in h:
+        if op.type == INVOKE:
+            open_count += 1
+            worst = max(worst, open_count)
+        else:
+            open_count -= 1
+    assert worst <= 2
+
+
+def test_writes_visible_to_reads():
+    store = MemStore()
+    gen = g.clients([
+        {"f": "write", "value": 7},
+        {"f": "read", "value": None},
+    ])
+    h = run_test(gen, client=MemClient(store), concurrency=1)
+    reads = [op for op in h if op.type == OK and op.f == "read"]
+    assert reads and reads[-1].value == 7
+
+
+def test_info_crashes_bump_process():
+    client = MemClient(crash_p=0.5, rng=random.Random(3))
+    h = run_test(g.clients(g.limit(20, lambda t, c: {"f": "read", "value": None})),
+                 client=client, concurrency=2)
+    infos = [op for op in h if op.type == INFO and op.is_client_op()]
+    assert infos, "crash_p=0.5 over 20 ops should produce infos"
+    procs = {op.process for op in h if op.is_client_op()}
+    assert any(p >= 2 for p in procs), procs
+
+
+def test_time_limit_stops_run():
+    h = run_test(g.clients(g.time_limit(
+        0.3, g.stagger(0.01, g.cycle({"f": "read", "value": None})))))
+    assert len(h) > 0
+    assert max(op.time for op in h) < 2_000_000_000
+
+
+def test_nemesis_ops_complete_info():
+    class Nem:
+        def invoke(self, test, op):
+            return dict(op, type="info", value="partitioned")
+
+    gen = g.any_gen(
+        g.clients(g.limit(5, lambda t, c: {"f": "read", "value": None})),
+        g.nemesis(g.limit(1, {"f": "start", "value": None})))
+    h = run_test(gen, nemesis=Nem())
+    nem_ops = [op for op in h if op.process == "nemesis"]
+    assert len(nem_ops) == 2  # invoke + info completion
+    assert nem_ops[-1].type == INFO
+    assert nem_ops[-1].value == "partitioned"
+
+
+def test_end_to_end_list_append_valid():
+    """Full slice: generator -> interpreter -> mem cluster -> Elle checker."""
+    from jepsen_tpu.checkers.elle import oracle
+    from jepsen_tpu.workloads.synth import la_generator
+
+    rng = random.Random(11)
+    store = MemStore()
+    gen = g.clients(g.limit(120, la_generator(n_keys=4, rng=rng)))
+    h = run_test(gen, client=MemClient(store), concurrency=4)
+    res = oracle.check(h, ["strict-serializable"])
+    assert res["valid?"] is True, res
+
+
+def test_exception_becomes_info():
+    class Boom(MemClient):
+        def invoke(self, test, op):
+            raise RuntimeError("kaput")
+
+    h = run_test(g.clients(g.limit(3, lambda t, c: {"f": "read", "value": None})),
+                 client=Boom(), concurrency=1)
+    infos = [op for op in h if op.type == INFO and op.is_client_op()]
+    assert len(infos) == 3
+    assert "kaput" in str(infos[0].error)

@@ -1,0 +1,102 @@
+"""Tests for jepsen_tpu.utils.core (reference util.clj semantics)."""
+
+import random
+import time
+
+import pytest
+
+from jepsen_tpu.history.ops import Op
+from jepsen_tpu.utils import core as u
+
+
+def test_majority_minority():
+    assert u.majority(1) == 1
+    assert u.majority(2) == 2
+    assert u.majority(3) == 2
+    assert u.majority(5) == 3
+    assert u.minority(5) == 2
+    assert u.minority(4) == 1
+
+
+def test_relative_time_monotonic():
+    u.init_time_origin()
+    a = u.relative_time_nanos()
+    b = u.relative_time_nanos()
+    assert 0 <= a <= b
+
+
+def test_timeout_completes():
+    assert u.timeout(5.0, lambda: 42) == 42
+
+
+def test_timeout_fires():
+    with pytest.raises(u.TimeoutError_):
+        u.timeout(0.05, lambda: time.sleep(5))
+
+
+def test_timeout_value_on_timeout():
+    assert u.timeout(0.05, lambda: time.sleep(5), on_timeout="late") == "late"
+
+
+def test_fcatch():
+    def boom():
+        raise ValueError("x")
+
+    res = u.fcatch(boom)()
+    assert isinstance(res, ValueError)
+    assert u.fcatch(lambda: 7)() == 7
+
+
+def test_rand_distribution():
+    rng = random.Random(0)
+    assert u.rand_distribution({"distribution": "constant", "value": 3}) == 3
+    for _ in range(100):
+        x = u.rand_distribution(
+            {"distribution": "uniform", "min": 1, "max": 2}, rng)
+        assert 1 <= x <= 2
+        z = u.rand_distribution({"distribution": "zipf", "n": 10}, rng)
+        assert 0 <= z < 10
+        e = u.rand_distribution({"distribution": "exponential", "mean": 5}, rng)
+        assert e >= 0
+
+
+def test_zipf_is_skewed():
+    rng = random.Random(1)
+    draws = [u.rand_distribution({"distribution": "zipf", "n": 100, "skew": 1.5},
+                                 rng) for _ in range(2000)]
+    assert draws.count(0) > draws.count(50)
+
+
+def test_with_retry():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("nope")
+        return "ok"
+
+    assert u.with_retry(flaky, retries=5, backoff=0.001) == "ok"
+    assert len(calls) == 3
+
+    with pytest.raises(OSError):
+        u.with_retry(lambda: (_ for _ in ()).throw(OSError("always")),
+                     retries=2, backoff=0.001)
+
+
+def test_nemesis_intervals():
+    ops = [
+        Op(type="info", process=-1, f="start", value=None, time=1),
+        Op(type="info", process=-1, f="stop", value=None, time=2),
+        Op(type="info", process=-1, f="start", value=None, time=3),
+    ]
+    ivs = u.nemesis_intervals(ops)
+    assert len(ivs) == 2
+    assert ivs[0][0].time == 1 and ivs[0][1].time == 2
+    assert ivs[1][0].time == 3 and ivs[1][1] is None
+
+
+def test_coll():
+    assert u.coll(None) == []
+    assert u.coll(3) == [3]
+    assert u.coll([1, 2]) == [1, 2]
