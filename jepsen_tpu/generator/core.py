@@ -23,6 +23,7 @@ nanosecond internals.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import random as _random
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -160,12 +161,21 @@ class _FnGen(Generator):
 
     def __init__(self, f: Callable):
         self.f = f
+        try:
+            sig = inspect.signature(f)
+            self._nullary = (
+                len([p for p in sig.parameters.values()
+                     if p.default is p.empty and p.kind in
+                     (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]) == 0
+                and not any(p.kind is p.VAR_POSITIONAL
+                            for p in sig.parameters.values()))
+        except (TypeError, ValueError):  # builtins without signatures
+            self._nullary = False
 
     def _call(self, test, ctx):
-        try:
-            return self.f(test, ctx)
-        except TypeError:
+        if self._nullary:
             return self.f()
+        return self.f(test, ctx)
 
     def op(self, test, ctx):
         if ctx.some_free_process() is None:
@@ -478,7 +488,9 @@ class _Any(Generator):
                 best = (t, i, op_, gen2)
         if best is not None:
             _, i, op_, gen2 = best
-            chosen = list(self.gens)
+            # build on `out`, not self.gens: pending successors recorded in
+            # out (e.g. _Sleep's fixed end time) must survive this poll
+            chosen = list(out)
             chosen[i] = gen2
             return (op_, _Any(chosen))
         if alive:
@@ -731,6 +743,9 @@ class _EachThread(Generator):
                 continue
             op_, gen2 = res
             if is_pending(op_):
+                # keep the pending successor: e.g. _Sleep fixes its end time
+                # there, and it must not be recomputed on the next poll
+                copies[t] = gen2
                 alive = True
                 if pend is None or (op_.time or 0) < (pend.time or 0):
                     pend = op_

@@ -9,7 +9,6 @@ perf plots).
 
 from __future__ import annotations
 
-import concurrent.futures
 import math
 import random
 import threading
@@ -56,17 +55,28 @@ def timeout(seconds: float, fn: Callable[[], Any], *,
     interrupts), the worker may linger; we abandon it.  If `on_timeout` is an
     exception class it is raised; otherwise it is returned as the value.
     """
-    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    fut = pool.submit(fn)
-    try:
-        return fut.result(timeout=seconds)
-    except concurrent.futures.TimeoutError:
-        fut.cancel()
+    done = threading.Event()
+    result: list = [None]
+    error: list = [None]
+
+    def run():
+        try:
+            result[0] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            error[0] = e
+        finally:
+            done.set()
+
+    # daemon thread: an abandoned (hung) worker must not block process exit
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    if not done.wait(timeout=seconds):
         if isinstance(on_timeout, type) and issubclass(on_timeout, BaseException):
             raise on_timeout(f"timed out after {seconds}s")
         return on_timeout
-    finally:
-        pool.shutdown(wait=False)
+    if error[0] is not None:
+        raise error[0]
+    return result[0]
 
 
 # ---------------------------------------------------------------------------

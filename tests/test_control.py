@@ -1,0 +1,187 @@
+"""Control plane tests: escaping, exec DSL, loopback/sim remotes,
+on_nodes fan-out, retry remote, daemon utils."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.control.core import (Action, CmdResult, ConnectionError_,
+                                     Remote, RetryRemote, Session, escape,
+                                     join_cmd, lit)
+from jepsen_tpu.control.local import LoopbackRemote
+from jepsen_tpu.control.sim import SimRemote
+
+
+# ---------------------------------------------------------------- escaping
+
+def test_escape_plain():
+    assert escape("ls") == "ls"
+    assert escape("/var/log/x.log") == "/var/log/x.log"
+
+
+def test_escape_quoting():
+    assert escape("hello world") == "'hello world'"
+    assert escape("a'b") == "'a'\\''b'"
+    assert escape("") == "''"
+    assert escape(lit("a | b")) == "a | b"
+
+
+def test_join_cmd():
+    assert join_cmd(["echo", "hi there", lit(">"), "f"]) == \
+        "echo 'hi there' > f"
+
+
+def test_action_wrapping():
+    a = Action(cmd="ls", dir="/tmp", sudo="root", env={"A": "1"})
+    w = a.wrapped_cmd()
+    assert "cd /tmp" in w and "sudo -S -u root" in w and "env A=1" in w
+
+
+# ---------------------------------------------------------------- loopback
+
+def test_loopback_exec_and_exit(tmp_path):
+    r = LoopbackRemote(base_dir=str(tmp_path))
+    s = r.connect("n1")
+    with control.with_session("n1", s):
+        assert control.exec_("echo", "hello world") == "hello world"
+        res = control.exec_result("bash", "-c", "exit 3")
+        assert res.exit_status == 3
+        with pytest.raises(control.RemoteError):
+            control.exec_("false")
+
+
+def test_loopback_sandbox_isolation(tmp_path):
+    r = LoopbackRemote(base_dir=str(tmp_path))
+    for node in ("n1", "n2"):
+        with control.with_session(node, r.connect(node)):
+            control.exec_("bash", "-c", f"echo {node} > who.txt")
+    with control.with_session("n1", r.connect("n1")):
+        assert control.exec_("cat", "who.txt") == "n1"
+    with control.with_session("n2", r.connect("n2")):
+        assert control.exec_("cat", "who.txt") == "n2"
+
+
+def test_loopback_upload_download(tmp_path):
+    src = tmp_path / "src.txt"
+    src.write_text("payload")
+    r = LoopbackRemote(base_dir=str(tmp_path / "nodes"))
+    s = r.connect("n1")
+    with control.with_session("n1", s):
+        control.upload(str(src), "data/up.txt")
+        assert control.exec_("cat", "data/up.txt") == "payload"
+        dl = tmp_path / "dl"
+        control.download("data/up.txt", str(dl))
+        assert (dl / "up.txt").read_text() == "payload"
+
+
+def test_cd_and_env_scoping(tmp_path):
+    r = LoopbackRemote(base_dir=str(tmp_path))
+    with control.with_session("n1", r.connect("n1")):
+        control.exec_("mkdir", "-p", "sub")
+        with control.cd("sub"):
+            control.exec_("touch", "inner.txt")
+        assert control.exec_("ls", "sub") == "inner.txt"
+        with control.with_env(MYVAR="42"):
+            assert control.exec_("bash", "-c", "echo $MYVAR") == "42"
+
+
+# ---------------------------------------------------------------- on_nodes
+
+def test_on_nodes_parallel(tmp_path):
+    test = {"nodes": ["n1", "n2", "n3"],
+            "remote": LoopbackRemote(base_dir=str(tmp_path))}
+
+    def fn(t, node):
+        return control.exec_("bash", "-c", "echo $JEPSEN_NODE")
+
+    res = control.on_nodes(test, fn)
+    assert res == {"n1": "n1", "n2": "n2", "n3": "n3"}
+
+
+def test_on_nodes_subset(tmp_path):
+    test = {"nodes": ["n1", "n2", "n3"],
+            "remote": LoopbackRemote(base_dir=str(tmp_path))}
+    res = control.on_nodes(test, lambda t, n: control.host(), nodes=["n2"])
+    assert res == {"n2": "n2"}
+
+
+def test_exec_without_session_raises():
+    with pytest.raises(control.RemoteError):
+        control.exec_("ls")
+
+
+# ---------------------------------------------------------------- sim
+
+def test_sim_remote_records_and_responds():
+    r = SimRemote()
+    r.node("n1").respond("uname*", "Linux")
+    s = r.connect("n1")
+    with control.with_session("n1", s):
+        assert control.exec_("uname", "-a") == "Linux"
+        control.exec_("iptables", "-A", "INPUT", "-j", "DROP")
+    cmds = r.node("n1").cmds()
+    assert cmds[0].startswith("uname")
+    assert "iptables -A INPUT -j DROP" in cmds[1]
+
+
+# ---------------------------------------------------------------- retry
+
+class FlakySession(Session):
+    def __init__(self, fail_times):
+        self.fails_left = fail_times
+
+    def execute(self, action):
+        if self.fails_left > 0:
+            self.fails_left -= 1
+            raise ConnectionError_("transient")
+        return CmdResult(cmd=action.cmd, out="ok", err="", exit_status=0)
+
+    def disconnect(self):
+        pass
+
+
+class FlakyRemote(Remote):
+    def __init__(self):
+        self.connects = 0
+
+    def connect(self, host, opts=None):
+        self.connects += 1
+        # first session fails twice, reconnected sessions succeed
+        return FlakySession(2 if self.connects == 1 else 0)
+
+
+def test_retry_remote_reconnects():
+    rr = RetryRemote(FlakyRemote(), retries=3, backoff_s=0.01)
+    s = rr.connect("n1")
+    res = s.execute(Action(cmd="x"))
+    assert res.out == "ok"
+
+
+# ---------------------------------------------------------------- util
+
+def test_daemon_lifecycle(tmp_path):
+    r = LoopbackRemote(base_dir=str(tmp_path))
+    with control.with_session("n1", r.connect("n1")):
+        cu.start_daemon("sleep", "30", logfile="d.log", pidfile="d.pid")
+        assert cu.daemon_running("d.pid")
+        cu.stop_daemon("d.pid", wait_s=1.0)
+        assert not cu.daemon_running("d.pid")
+        assert not cu.exists("d.pid")
+
+
+def test_util_exists_ls_tmpdir(tmp_path):
+    r = LoopbackRemote(base_dir=str(tmp_path))
+    with control.with_session("n1", r.connect("n1")):
+        assert not cu.exists("nope")
+        control.exec_("touch", "yes.txt")
+        assert cu.exists("yes.txt")
+        assert "yes.txt" in cu.ls(".")
+
+
+def test_write_and_read_file(tmp_path):
+    r = LoopbackRemote(base_dir=str(tmp_path))
+    with control.with_session("n1", r.connect("n1")):
+        control.write_file("conf/app.cfg", "key=value\n")
+        assert control.file_contents("conf/app.cfg") == "key=value"

@@ -242,3 +242,31 @@ def test_context_basics():
     sub = ctx.restrict(lambda t: t == "nemesis")
     assert sub.all_threads() == ["nemesis"]
     assert sub.free_processes() == ["nemesis"]
+
+
+# -- regression: pending successors must survive polls ----------------------
+
+def test_any_sleep_deadline_does_not_drift():
+    # any(sleep ; op-after-sleep, fast ops): the sleep side's end time must
+    # be fixed at the first poll, even while the other side keeps emitting.
+    evs = simulate(
+        g.any_gen([g.sleep(1.0), {"f": "late", "value": None}],
+                  g.limit(30, g.stagger(0.1, g.cycle({"f": "fast",
+                                                      "value": None})))),
+        TEST)
+    late = [e for e in invokes(evs) if e["f"] == "late"]
+    assert late, "sleep side never fired — its deadline drifted"
+    assert late[0]["time"] <= 2_000_000_000
+
+
+def test_each_thread_sleep_deadline_does_not_drift():
+    test = {"concurrency": 3}
+    evs = simulate(
+        g.any_gen(g.clients(g.each_thread([g.sleep(1.0),
+                                           {"f": "late", "value": None}])),
+                  g.limit(30, g.stagger(0.1, g.cycle({"f": "fast",
+                                                      "value": None})))),
+        test)
+    late = [e for e in invokes(evs) if e["f"] == "late"]
+    assert len(late) == 3, "per-thread sleeps never fired"
+    assert all(e["time"] <= 2_000_000_000 for e in late)
