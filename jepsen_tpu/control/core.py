@@ -62,8 +62,9 @@ class Action:
         if self.dir:
             c = f"cd {escape(self.dir)} && {c}"
         if self.sudo:
-            # -S: read password from stdin if needed; -u user
-            c = f"sudo -S -u {escape(self.sudo)} bash -c {escape(c)}"
+            # -n: never prompt — stdin belongs to the command (`in_`), not
+            # to sudo; passworded sudo fails fast with a clear error
+            c = f"sudo -n -u {escape(self.sudo)} bash -c {escape(c)}"
         return c
 
 

@@ -11,8 +11,7 @@ from __future__ import annotations
 import os
 import shutil
 import subprocess
-import tempfile
-from typing import Optional
+from typing import List, Optional
 
 from jepsen_tpu.control.core import (Action, CmdResult, ConnectionError_,
                                      Remote, Session)
@@ -26,13 +25,11 @@ class _ExecSession(Session):
         self.host = host
         self.timeout_s = timeout_s
 
-    def _exec_argv(self, cmd: str):
+    def _exec_argv(self, cmd: str) -> List[str]:
         raise NotImplementedError
 
-    def _cp_to(self, local: str, remote: str):
-        raise NotImplementedError
-
-    def _cp_from(self, remote: str, local: str):
+    def _cp_argv(self, src: str, dst: str) -> List[str]:
+        """argv for copying src -> dst, where one side is host:path."""
         raise NotImplementedError
 
     def execute(self, action: Action) -> CmdResult:
@@ -46,39 +43,37 @@ class _ExecSession(Session):
         return CmdResult(cmd=cmd, out=proc.stdout, err=proc.stderr,
                          exit_status=proc.returncode)
 
+    def _cp(self, src: str, dst: str) -> None:
+        argv = self._cp_argv(src, dst)
+        try:
+            proc = subprocess.run(argv, capture_output=True, text=True,
+                                  timeout=self.timeout_s)
+        except subprocess.TimeoutExpired as e:
+            raise ConnectionError_(f"{argv[0]} cp timed out") from e
+        if proc.returncode != 0:
+            raise ConnectionError_(f"{argv[0]} cp failed: {proc.stderr}")
+
     def upload(self, local_paths, remote_path: str) -> None:
         if isinstance(local_paths, (str, os.PathLike)):
             local_paths = [local_paths]
         for lp in local_paths:
-            self._cp_to(str(lp), remote_path)
+            self._cp(str(lp), f"{self.host}:{remote_path}")
 
     def download(self, remote_paths, local_dir: str) -> None:
         if isinstance(remote_paths, (str, os.PathLike)):
             remote_paths = [remote_paths]
         os.makedirs(local_dir, exist_ok=True)
         for rp in remote_paths:
-            self._cp_from(str(rp),
-                          os.path.join(local_dir, os.path.basename(str(rp))))
+            self._cp(f"{self.host}:{rp}",
+                     os.path.join(local_dir, os.path.basename(str(rp))))
 
 
 class DockerSession(_ExecSession):
-    def _exec_argv(self, cmd: str):
+    def _exec_argv(self, cmd):
         return ["docker", "exec", "-i", self.host, "bash", "-c", cmd]
 
-    def _cp_to(self, local, remote):
-        r = subprocess.run(["docker", "cp", local,
-                            f"{self.host}:{remote}"],
-                           capture_output=True, text=True,
-                           timeout=self.timeout_s)
-        if r.returncode != 0:
-            raise ConnectionError_(f"docker cp failed: {r.stderr}")
-
-    def _cp_from(self, remote, local):
-        r = subprocess.run(["docker", "cp", f"{self.host}:{remote}", local],
-                           capture_output=True, text=True,
-                           timeout=self.timeout_s)
-        if r.returncode != 0:
-            raise ConnectionError_(f"docker cp failed: {r.stderr}")
+    def _cp_argv(self, src, dst):
+        return ["docker", "cp", src, dst]
 
 
 class DockerRemote(Remote):
@@ -98,31 +93,14 @@ class K8sSession(_ExecSession):
         self.namespace = namespace
         self.container = container
 
-    def _kc(self):
-        base = ["kubectl", "-n", self.namespace]
-        return base
-
-    def _exec_argv(self, cmd: str):
-        argv = [*self._kc(), "exec", "-i", self.host]
+    def _exec_argv(self, cmd):
+        argv = ["kubectl", "-n", self.namespace, "exec", "-i", self.host]
         if self.container:
             argv += ["-c", self.container]
         return [*argv, "--", "bash", "-c", cmd]
 
-    def _cp_to(self, local, remote):
-        r = subprocess.run([*self._kc(), "cp", local,
-                            f"{self.host}:{remote}"],
-                           capture_output=True, text=True,
-                           timeout=self.timeout_s)
-        if r.returncode != 0:
-            raise ConnectionError_(f"kubectl cp failed: {r.stderr}")
-
-    def _cp_from(self, remote, local):
-        r = subprocess.run([*self._kc(), "cp", f"{self.host}:{remote}",
-                            local],
-                           capture_output=True, text=True,
-                           timeout=self.timeout_s)
-        if r.returncode != 0:
-            raise ConnectionError_(f"kubectl cp failed: {r.stderr}")
+    def _cp_argv(self, src, dst):
+        return ["kubectl", "-n", self.namespace, "cp", src, dst]
 
 
 class K8sRemote(Remote):

@@ -37,19 +37,27 @@ class SshSession(Session):
         self.user = opts.get("username", "root")
         self.port = int(opts.get("port", 22))
         self.timeout_s = float(opts.get("timeout_s", 60.0))
+        if opts.get("password"):
+            raise ConnectionError_(
+                "password auth is not supported by the OpenSSH-CLI remote "
+                "(no TTY); use private_key_path / an ssh agent instead")
         self._ctl_dir = tempfile.mkdtemp(prefix="jepsen-ssh-")
         self._ctl = os.path.join(self._ctl_dir, "ctl")
+        # options shared by ssh and scp; NOTE ssh takes -p <port> but scp
+        # takes -P <port>, so the port flag is added per-command below
         self._base = ["-o", "StrictHostKeyChecking=" +
                       ("yes" if opts.get("strict_host_key_checking")
                        else "no"),
                       "-o", "UserKnownHostsFile=/dev/null",
                       "-o", "LogLevel=ERROR",
+                      "-o", "BatchMode=yes",
                       "-o", f"ControlPath={self._ctl}",
                       "-o", "ControlMaster=auto",
-                      "-o", "ControlPersist=120",
-                      "-p", str(self.port)]
+                      "-o", "ControlPersist=120"]
         if opts.get("private_key_path"):
             self._base += ["-i", opts["private_key_path"]]
+        self._ssh_base = [*self._base, "-p", str(self.port)]
+        self._scp_base = [*self._base, "-P", str(self.port)]
         # Open the master connection eagerly so connect errors surface here.
         r = self._run_ssh("true")
         if r.exit_status != 0:
@@ -57,7 +65,7 @@ class SshSession(Session):
                 f"ssh to {self.user}@{host}:{self.port} failed: {r.err}")
 
     def _run_ssh(self, cmd: str, in_: Optional[str] = None) -> CmdResult:
-        argv = ["ssh", *self._base, f"{self.user}@{self.host}", cmd]
+        argv = ["ssh", *self._ssh_base, f"{self.user}@{self.host}", cmd]
         try:
             proc = subprocess.run(argv, input=in_, text=True,
                                   capture_output=True,
@@ -73,7 +81,7 @@ class SshSession(Session):
     def upload(self, local_paths, remote_path: str) -> None:
         if isinstance(local_paths, (str, os.PathLike)):
             local_paths = [local_paths]
-        argv = ["scp", *self._base, "-r", *map(str, local_paths),
+        argv = ["scp", *self._scp_base, "-r", *map(str, local_paths),
                 f"{self.user}@{self.host}:{remote_path}"]
         try:
             proc = subprocess.run(argv, capture_output=True, text=True,
@@ -90,7 +98,7 @@ class SshSession(Session):
         srcs = [f"{self.user}@{self.host}:{p}" for p in remote_paths]
         try:
             proc = subprocess.run(
-                ["scp", *self._base, "-r", *srcs, local_dir],
+                ["scp", *self._scp_base, "-r", *srcs, local_dir],
                 capture_output=True, text=True, timeout=self.timeout_s)
         except subprocess.TimeoutExpired as e:
             raise ConnectionError_("scp download timed out") from e
@@ -99,7 +107,7 @@ class SshSession(Session):
 
     def disconnect(self) -> None:
         try:
-            subprocess.run(["ssh", *self._base, "-O", "exit",
+            subprocess.run(["ssh", *self._ssh_base, "-O", "exit",
                             f"{self.user}@{self.host}"],
                            capture_output=True, timeout=10)
         except Exception:

@@ -93,7 +93,17 @@ def install_archive(url: str, dest_dir: str, *,
     c.exec_("mkdir", "-p", dest_dir)
     name = os.path.basename(url)
     if name.endswith(".zip"):
-        c.exec_("unzip", "-o", cache, "-d", dest_dir)
+        # match the tar branch's layout: strip a single top-level dir
+        c.exec_("unzip", "-q", "-o", cache, "-d", dest_dir + ".unzip")
+        c.exec_("bash", "-c",
+                f"src={escape(dest_dir + '.unzip')}; "
+                f"dst={escape(dest_dir)}; "
+                "entries=$(ls -1 \"$src\" | wc -l); "
+                "if [ \"$entries\" = 1 ] && "
+                "[ -d \"$src/$(ls -1 \"$src\")\" ]; then "
+                "mv \"$src\"/*/* \"$dst\"/ 2>/dev/null; "
+                "mv \"$src\"/*/.[!.]* \"$dst\"/ 2>/dev/null; true; "
+                "else mv \"$src\"/* \"$dst\"/; fi; rm -rf \"$src\"")
     else:
         c.exec_("tar", "-xf", cache, "-C", dest_dir,
                 "--strip-components", "1")

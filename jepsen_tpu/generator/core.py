@@ -161,14 +161,17 @@ class _FnGen(Generator):
 
     def __init__(self, f: Callable):
         self.f = f
+        # Call f(test, ctx) whenever f *can take* two positionals (required
+        # or defaulted), like the reference's 2-arity preference; f() only
+        # when it can't.
         try:
             sig = inspect.signature(f)
-            self._nullary = (
-                len([p for p in sig.parameters.values()
-                     if p.default is p.empty and p.kind in
-                     (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]) == 0
-                and not any(p.kind is p.VAR_POSITIONAL
-                            for p in sig.parameters.values()))
+            params = list(sig.parameters.values())
+            can_take_2 = (
+                any(p.kind is p.VAR_POSITIONAL for p in params)
+                or len([p for p in params if p.kind in
+                        (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]) >= 2)
+            self._nullary = not can_take_2
         except (TypeError, ValueError):  # builtins without signatures
             self._nullary = False
 

@@ -1,0 +1,266 @@
+"""Composable fault packages: nemesis + generator + perf metadata.
+
+Equivalent of the reference's `jepsen/nemesis/combined.clj` (SURVEY.md
+§2.1): `nemesis_package(opts)` assembles fault packages — partition,
+kill, pause, clock, file corruption, custom — each a dict
+
+    {"nemesis":  Nemesis,
+     "generator": fault-op generator (already nemesis-thread scoped),
+     "final_generator": heal/recover ops run at test end,
+     "perf": {"name", "start", "stop", "fs"}}  # for plot shading
+
+and composes the requested ones into a single package whose nemesis is a
+`compose` over sub-nemeses and whose generator interleaves fault
+schedules (interval-driven: sleep -> start -> sleep -> stop -> ...).
+
+`opts["faults"]` picks packages: any of {"partition", "kill", "pause",
+"clock", "file"}; `opts["interval"]` (seconds, default 10) spaces fault
+start/stop pairs; `opts["db"]` supplies Process/Pause facets for
+kill/pause; `opts["file"]` the corruption target.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from jepsen_tpu import db as db_
+from jepsen_tpu import generator as gen
+from jepsen_tpu.control import on_nodes
+from jepsen_tpu.nemesis import core as nc
+from jepsen_tpu.nemesis.file import FileCorruptionNemesis
+from jepsen_tpu.nemesis.time import ClockNemesis
+
+DEFAULT_INTERVAL = 10.0
+
+
+# ---------------------------------------------------------------- partition
+
+def partition_package(opts: dict) -> Optional[dict]:
+    if "partition" not in opts.get("faults", ()):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    rng = opts.get("rng") or _random
+    targets = opts.get("partition_targets") or [
+        nc.partition_random_halves, nc.partition_random_node,
+        nc.partition_majorities_ring]
+
+    def start(test, ctx):
+        grudge_fn = rng.choice(targets)
+        return {"f": "start-partition",
+                "value": grudge_fn(test["nodes"])}
+
+    return {
+        "nemesis": nc.partitioner(),
+        "generator": gen.cycle([gen.sleep(interval), gen.once(start),
+                                gen.sleep(interval),
+                                {"f": "stop-partition", "value": None}]),
+        "final_generator": {"f": "stop-partition", "value": None},
+        "perf": {"name": "partition", "start": {"start-partition"},
+                 "stop": {"stop-partition"}, "fs": set()},
+    }
+
+
+# ---------------------------------------------------------------- kill/pause
+
+def _db_nodes_targeter(rng, targeting: str = "one"):
+    def targeter(test, nodes):
+        if targeting == "all":
+            return list(nodes)
+        if targeting == "majority":
+            from jepsen_tpu.utils.core import majority
+            k = majority(len(nodes))
+            return rng.sample(list(nodes), k)
+        if targeting == "minority":
+            from jepsen_tpu.utils.core import minority
+            k = max(1, minority(len(nodes)))
+            return rng.sample(list(nodes), k)
+        return [rng.choice(list(nodes))]
+    return targeter
+
+
+class DBNemesis(nc.Nemesis):
+    """Kill/restart or pause/resume the db via its Process/Pause facets
+    (reference `nemesis.combined/db-nemesis`)."""
+
+    def __init__(self, db, targeter, *, mode: str = "kill"):
+        self.db = db
+        self.targeter = targeter
+        self.mode = mode
+        self.affected: List[str] = []
+
+    def invoke(self, test, op):
+        f = op["f"]
+        db = self.db
+        if f in ("kill", "pause"):
+            targets = list(op.get("value") or
+                           self.targeter(test, test["nodes"]))
+            fn = db.kill if f == "kill" else db.pause
+            res = on_nodes(test, lambda t, n: fn(t, n), nodes=targets)
+            self.affected = targets
+            return dict(op, type="info", value=targets)
+        if f in ("start", "resume"):
+            targets = self.affected or test["nodes"]
+            fn = db.start if f == "start" else db.resume
+            res = on_nodes(test, lambda t, n: fn(t, n), nodes=targets)
+            self.affected = []
+            return dict(op, type="info", value=targets)
+        raise ValueError(f"db nemesis can't handle f={f!r}")
+
+    def teardown(self, test):
+        if self.affected:
+            fn = self.db.start if self.mode == "kill" else self.db.resume
+            try:
+                on_nodes(test, lambda t, n: fn(t, n), nodes=self.affected)
+            except Exception:
+                pass
+            self.affected = []
+
+
+def kill_package(opts: dict) -> Optional[dict]:
+    if "kill" not in opts.get("faults", ()):
+        return None
+    db = opts.get("db")
+    if not db_.supports(db, db_.Process):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    rng = opts.get("rng") or _random
+    targeter = _db_nodes_targeter(rng, opts.get("kill_targeting", "one"))
+    return {
+        "nemesis": DBNemesis(db, targeter, mode="kill"),
+        "generator": gen.cycle([gen.sleep(interval),
+                                {"f": "kill", "value": None},
+                                gen.sleep(interval),
+                                {"f": "start", "value": None}]),
+        "final_generator": {"f": "start", "value": None},
+        "perf": {"name": "kill", "start": {"kill"}, "stop": {"start"},
+                 "fs": set()},
+    }
+
+
+def pause_package(opts: dict) -> Optional[dict]:
+    if "pause" not in opts.get("faults", ()):
+        return None
+    db = opts.get("db")
+    if not db_.supports(db, db_.Pause):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    rng = opts.get("rng") or _random
+    targeter = _db_nodes_targeter(rng, opts.get("pause_targeting", "one"))
+    return {
+        "nemesis": DBNemesis(db, targeter, mode="pause"),
+        "generator": gen.cycle([gen.sleep(interval),
+                                {"f": "pause", "value": None},
+                                gen.sleep(interval),
+                                {"f": "resume", "value": None}]),
+        "final_generator": {"f": "resume", "value": None},
+        "perf": {"name": "pause", "start": {"pause"}, "stop": {"resume"},
+                 "fs": set()},
+    }
+
+
+# ---------------------------------------------------------------- clock
+
+def clock_package(opts: dict) -> Optional[dict]:
+    if "clock" not in opts.get("faults", ()):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    rng = opts.get("rng") or _random
+
+    def bump(test, ctx):
+        node = rng.choice(test["nodes"])
+        ms = rng.choice([-1, 1]) * rng.choice([100, 1000, 10_000, 60_000])
+        return {"f": "bump-clock", "value": {node: ms}}
+
+    def strobe(test, ctx):
+        return {"f": "strobe-clock",
+                "value": {"delta_ms": rng.choice([50, 200, 1000]),
+                          "period_ms": rng.choice([2, 10, 50]),
+                          "duration_ms": 1000,
+                          "nodes": [rng.choice(test["nodes"])]}}
+
+    return {
+        "nemesis": ClockNemesis(),
+        "generator": gen.cycle([gen.sleep(interval),
+                                gen.once(gen.mix([bump, strobe], rng=rng)),
+                                gen.sleep(interval),
+                                {"f": "reset-clock", "value": None}]),
+        "final_generator": {"f": "reset-clock", "value": None},
+        "perf": {"name": "clock", "start": {"bump-clock", "strobe-clock"},
+                 "stop": {"reset-clock"}, "fs": set()},
+    }
+
+
+# ---------------------------------------------------------------- file
+
+def file_package(opts: dict) -> Optional[dict]:
+    if "file" not in opts.get("faults", ()):
+        return None
+    path = opts.get("file")
+    if not path:
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    rng = opts.get("rng") or _random
+
+    def corrupt(test, ctx):
+        node = rng.choice(test["nodes"])
+        f = rng.choice(["bitflip-file", "truncate-file"])
+        return {"f": f, "value": {"file": path, "nodes": [node]}}
+
+    return {
+        "nemesis": FileCorruptionNemesis(path),
+        "generator": gen.cycle([gen.sleep(interval), gen.once(corrupt)]),
+        "final_generator": None,
+        "perf": {"name": "file",
+                 "start": {"bitflip-file", "truncate-file"},
+                 "stop": set(), "fs": set()},
+    }
+
+
+# ---------------------------------------------------------------- compose
+
+PACKAGE_FNS = [partition_package, kill_package, pause_package,
+               clock_package, file_package]
+
+
+def _fs_of(pkg: dict) -> set:
+    perf = pkg.get("perf") or {}
+    return (set(perf.get("start", ())) | set(perf.get("stop", ()))
+            | set(perf.get("fs", ())))
+
+
+def compose_packages(pkgs: Sequence[dict]) -> dict:
+    """Combine packages: compose nemeses by their op fs; interleave
+    generators with `any_gen`; chain final generators
+    (reference `nemesis.combined/compose-packages`)."""
+    pkgs = [p for p in pkgs if p]
+    if not pkgs:
+        return {"nemesis": nc.Noop(), "generator": None,
+                "final_generator": None, "perf": []}
+    dispatch = {}
+    for p in pkgs:
+        fs = _fs_of(p)
+        if not fs:
+            continue
+        dispatch[tuple(sorted(fs))] = p["nemesis"]
+    gens = [p["generator"] for p in pkgs if p.get("generator")]
+    finals = [p["final_generator"] for p in pkgs
+              if p.get("final_generator")]
+    return {
+        "nemesis": nc.compose(dispatch),
+        "generator": gen.any_gen(*gens) if gens else None,
+        "final_generator": finals or None,
+        "perf": [p.get("perf") for p in pkgs if p.get("perf")],
+    }
+
+
+def nemesis_package(opts: dict) -> dict:
+    """Build the combined fault package for `opts` (reference
+    `nemesis.combined/nemesis-package`).
+
+    The returned package's generator is nemesis-thread scoped — drop it
+    into `test["nemesis_generator"]` or `gen.nemesis(...)` yourself.
+    """
+    extra = opts.get("extra_packages") or []
+    pkgs = [fn(opts) for fn in PACKAGE_FNS] + list(extra)
+    return compose_packages(pkgs)

@@ -1,0 +1,81 @@
+"""Membership-change nemesis: grow/shrink the cluster during a test.
+
+Equivalent of the reference's `jepsen/nemesis/membership.clj` (SURVEY.md
+§2.1): a state-machine nemesis.  The db-specific logic lives in a
+`MembershipState` — what the current view is, which ops are possible,
+how to apply one, and when the cluster has converged after a change.
+The nemesis polls the view, generates join/leave ops, applies them, and
+blocks op completion until convergence (or times out to `info`).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any, List, Optional
+
+from jepsen_tpu.nemesis.core import Nemesis
+
+
+class MembershipState:
+    """Db-specific membership protocol (reference: the `State` protocol)."""
+
+    def view(self, test: dict) -> Any:
+        """Current cluster view (e.g. member list), from the db's pov."""
+        raise NotImplementedError
+
+    def possible_ops(self, test: dict, view: Any) -> List[dict]:
+        """Ops applicable now, e.g. [{"f": "leave-node", "value": "n3"}]."""
+        raise NotImplementedError
+
+    def apply_op(self, test: dict, op: dict) -> Any:
+        """Perform the change; return a result for the completion value."""
+        raise NotImplementedError
+
+    def converged(self, test: dict, view: Any, op: dict) -> bool:
+        """Has the change from `op` taken effect in `view`?"""
+        return True
+
+
+class MembershipNemesis(Nemesis):
+    """Drives a MembershipState (reference
+    `nemesis.membership/nemesis-for-state`).
+
+    Ops:
+    - any f the state's possible_ops produce (join/leave/grow/shrink...)
+    - ``membership-view``: report the current view
+    """
+
+    def __init__(self, state: MembershipState, *,
+                 converge_timeout_s: float = 60.0,
+                 poll_interval_s: float = 0.5):
+        self.state = state
+        self.converge_timeout_s = converge_timeout_s
+        self.poll_interval_s = poll_interval_s
+
+    def setup(self, test):
+        return self
+
+    def invoke(self, test, op):
+        if op["f"] == "membership-view":
+            return dict(op, type="info", value=self.state.view(test))
+        result = self.state.apply_op(test, op)
+        deadline = _time.monotonic() + self.converge_timeout_s
+        converged = False
+        while _time.monotonic() < deadline:
+            view = self.state.view(test)
+            if self.state.converged(test, view, op):
+                converged = True
+                break
+            _time.sleep(self.poll_interval_s)
+        return dict(op, type="info",
+                    value={"result": result, "converged": converged})
+
+    def teardown(self, test):
+        pass
+
+
+def possible_op(state: MembershipState, test: dict) -> Optional[dict]:
+    """Generator helper: pick the next membership op, or None if the view
+    offers nothing (used as `lambda t, ctx: possible_op(state, t)`)."""
+    ops = state.possible_ops(test, state.view(test))
+    return ops[0] if ops else None

@@ -1,0 +1,80 @@
+"""OS protocol: prepare a node's operating system.
+
+Equivalent of the reference's `jepsen/os.clj` + `os/debian.clj` /
+`os/ubuntu.clj` / `os/centos.clj` (SURVEY.md §2.1): an `OS` with
+`setup`/`teardown` run on every node before/after the db, typically
+installing packages and disabling time sync so clock nemeses work.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from jepsen_tpu import control
+from jepsen_tpu.control.core import RemoteError
+
+
+class OS:
+    def setup(self, test: dict, node: str) -> None:
+        pass
+
+    def teardown(self, test: dict, node: str) -> None:
+        pass
+
+
+class Noop(OS):
+    """No OS preparation (reference: `os/noop`)."""
+
+
+noop = Noop()
+
+
+class Debian(OS):
+    """Debian/Ubuntu setup (reference: `os/debian.clj`): apt package
+    install, NTP/timesyncd disable (so clock nemeses own the clock)."""
+
+    def __init__(self, packages: Sequence[str] = (),
+                 disable_time_sync: bool = True):
+        self.packages = list(packages)
+        self.disable_time_sync = disable_time_sync
+
+    def install(self, pkgs: Sequence[str]) -> None:
+        if not pkgs:
+            return
+        control.exec_("env", "DEBIAN_FRONTEND=noninteractive",
+                      "apt-get", "install", "-y", "--no-install-recommends",
+                      *pkgs)
+
+    def setup(self, test, node):
+        try:
+            control.exec_("apt-get", "update", "-q")
+        except RemoteError:
+            pass  # stale mirrors shouldn't kill the run; install will retry
+        self.install(self.packages)
+        if self.disable_time_sync:
+            for svc in ("ntp", "systemd-timesyncd", "chrony"):
+                control.exec_result("systemctl", "stop", svc)
+                control.exec_result("systemctl", "disable", svc)
+
+    def teardown(self, test, node):
+        pass
+
+
+class Centos(OS):
+    """CentOS/RHEL setup (reference: `os/centos.clj`)."""
+
+    def __init__(self, packages: Sequence[str] = (),
+                 disable_time_sync: bool = True):
+        self.packages = list(packages)
+        self.disable_time_sync = disable_time_sync
+
+    def setup(self, test, node):
+        if self.packages:
+            control.exec_("yum", "install", "-y", *self.packages)
+        if self.disable_time_sync:
+            for svc in ("ntpd", "chronyd"):
+                control.exec_result("systemctl", "stop", svc)
+                control.exec_result("systemctl", "disable", svc)
+
+    def teardown(self, test, node):
+        pass

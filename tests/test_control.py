@@ -36,7 +36,7 @@ def test_join_cmd():
 def test_action_wrapping():
     a = Action(cmd="ls", dir="/tmp", sudo="root", env={"A": "1"})
     w = a.wrapped_cmd()
-    assert "cd /tmp" in w and "sudo -S -u root" in w and "env A=1" in w
+    assert "cd /tmp" in w and "sudo -n -u root" in w and "env A=1" in w
 
 
 # ---------------------------------------------------------------- loopback
@@ -185,3 +185,22 @@ def test_write_and_read_file(tmp_path):
     with control.with_session("n1", r.connect("n1")):
         control.write_file("conf/app.cfg", "key=value\n")
         assert control.file_contents("conf/app.cfg") == "key=value"
+
+
+def test_install_archive_zip_strips_top_dir(tmp_path):
+    # build app-1.0.zip containing app-1.0/bin/run
+    import zipfile
+    src = tmp_path / "app-1.0"
+    (src / "bin").mkdir(parents=True)
+    (src / "bin" / "run").write_text("#!/bin/sh\n")
+    zpath = tmp_path / "app-1.0.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.write(src / "bin" / "run", "app-1.0/bin/run")
+    r = LoopbackRemote(base_dir=str(tmp_path / "nodes"))
+    with control.with_session("n1", r.connect("n1")):
+        # pre-seed the wget cache so no network is needed
+        control.exec_("mkdir", "-p", "/tmp/jepsen/cache")
+        control.upload(str(zpath), "/tmp/jepsen/cache/app-1.0.zip")
+        cu.install_archive("http://example.com/app-1.0.zip", "opt/app")
+        assert cu.exists("opt/app/bin/run"), \
+            "zip should match tar layout (top dir stripped)"

@@ -1,0 +1,176 @@
+"""Nemesis core, net, and db protocol tests over sim/loopback remotes."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import control, db as db_, net as net_
+from jepsen_tpu.control.local import LoopbackRemote
+from jepsen_tpu.control.sim import SimRemote
+from jepsen_tpu.nemesis import (Noop, bridge, complete_grudge, compose,
+                                majorities_ring, partition_halves,
+                                partition_random_halves,
+                                partition_random_node, partitioner)
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def sim_test(**extra):
+    t = {"nodes": list(NODES), "remote": SimRemote(),
+         "net": net_.SimNet()}
+    t.update(extra)
+    return t
+
+
+# ---------------------------------------------------------------- grudges
+
+def test_complete_grudge():
+    g = complete_grudge([["n1", "n2"], ["n3", "n4", "n5"]])
+    assert g["n1"] == {"n3", "n4", "n5"}
+    assert g["n3"] == {"n1", "n2"}
+
+
+def test_bridge():
+    g = bridge(NODES)
+    # n3 is the bridge: blocks nothing, nobody blocks it
+    assert g["n3"] == set()
+    assert g["n1"] == {"n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+    for n in ("n1", "n2", "n4", "n5"):
+        assert "n3" not in g[n]
+
+
+def test_majorities_ring():
+    rng = random.Random(5)
+    g = majorities_ring(NODES, rng=rng)
+    # every node sees a majority (itself + 2 neighbors of 5)
+    for n in NODES:
+        visible = set(NODES) - g[n]
+        assert n in visible
+        assert len(visible) >= 3
+
+
+def test_partition_halves():
+    g = partition_halves(["a", "b", "c", "d"])
+    assert g["a"] == {"c", "d"} and g["c"] == {"a", "b"}
+
+
+def test_partition_random_node_isolates_one():
+    g = partition_random_node(NODES, rng=random.Random(1))
+    isolated = [n for n in NODES if len(g[n]) == len(NODES) - 1]
+    assert len(isolated) == 1
+
+
+# ---------------------------------------------------------------- partitioner
+
+def test_partitioner_applies_and_heals():
+    t = sim_test()
+    nem = partitioner(partition_random_halves).setup(t)
+    comp = nem.invoke(t, {"f": "start-partition", "value": None,
+                          "type": "invoke"})
+    assert comp["type"] == "info"
+    net = t["net"]
+    assert net.blocked, "partition applied"
+    comp2 = nem.invoke(t, {"f": "stop-partition", "value": None,
+                           "type": "invoke"})
+    assert comp2["value"] == "network healed"
+    assert not net.blocked
+
+
+def test_partitioner_iptables_cmds():
+    t = {"nodes": ["n1", "n2"], "remote": SimRemote(),
+         "net": net_.IptablesNet()}
+    nem = partitioner(lambda nodes: {"n1": {"n2"}, "n2": {"n1"}}).setup(t)
+    nem.invoke(t, {"f": "start-partition", "value": None, "type": "invoke"})
+    cmds = t["remote"].all_cmds()
+    assert any("iptables -A INPUT -s n2 -j DROP" in c for c in cmds["n1"])
+    assert any("iptables -A INPUT -s n1 -j DROP" in c for c in cmds["n2"])
+    nem.invoke(t, {"f": "stop-partition", "value": None, "type": "invoke"})
+    assert any("iptables -F" in c for c in cmds["n1"] +
+               t["remote"].node("n1").cmds())
+
+
+def test_netem_shaping_cmds():
+    t = {"nodes": ["n1"], "remote": SimRemote(), "net": net_.IptablesNet()}
+    t["net"].slow(t, mean_ms=100.0, variance_ms=5.0)
+    cmds = t["remote"].node("n1").cmds()
+    assert any("tc qdisc replace dev eth0 root netem delay 100.0ms" in c
+               for c in cmds)
+    t["net"].fast(t)
+    assert any("tc qdisc del" in c for c in t["remote"].node("n1").cmds())
+
+
+# ---------------------------------------------------------------- compose
+
+def test_compose_routes_and_raises():
+    t = sim_test()
+    seen = []
+
+    class Rec(Noop):
+        def __init__(self, name):
+            self.nm = name
+
+        def invoke(self, test, op):
+            seen.append((self.nm, op["f"]))
+            return dict(op, type="info")
+
+    nem = compose({("start-partition", "stop-partition"): Rec("part"),
+                   ("kill",): Rec("kill")}).setup(t)
+    nem.invoke(t, {"f": "kill", "type": "invoke", "value": None})
+    nem.invoke(t, {"f": "start-partition", "type": "invoke", "value": None})
+    assert seen == [("kill", "kill"), ("part", "start-partition")]
+    with pytest.raises(ValueError):
+        nem.invoke(t, {"f": "mystery", "type": "invoke", "value": None})
+
+
+# ---------------------------------------------------------------- db facets
+
+class FakeDB(db_.DB, db_.LogFiles, db_.Primary):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, test, node):
+        self.events.append(("setup", node))
+
+    def teardown(self, test, node):
+        self.events.append(("teardown", node))
+
+    def log_files(self, test, node):
+        return ["db.log"]
+
+    def primaries(self, test):
+        return [test["nodes"][0]]
+
+
+def test_db_facets():
+    d = FakeDB()
+    assert db_.supports(d, db_.LogFiles)
+    assert db_.supports(d, db_.Primary)
+    assert not db_.supports(d, db_.Pause)
+    assert db_.supports(db_.noop, db_.DB)
+
+
+def test_process_db_lifecycle(tmp_path):
+    t = {"nodes": ["n1"], "remote": LoopbackRemote(base_dir=str(tmp_path))}
+    d = db_.ProcessDB("sleep", ["60"], logfile="s.log", pidfile="s.pid")
+
+    def up(test, node):
+        d.setup(test, node)
+        from jepsen_tpu.control import util as cu
+        assert cu.daemon_running("s.pid")
+        d.kill(test, node)
+        assert not cu.daemon_running("s.pid")
+        d.teardown(test, node)
+
+    control.on_nodes(t, up)
+
+
+def test_hammer_time_cmds():
+    from jepsen_tpu.nemesis import hammer_time
+    t = sim_test()
+    nem = hammer_time("mydb", targeter=lambda test, nodes: ["n2"]).setup(t)
+    nem.invoke(t, {"f": "start-pause", "type": "invoke", "value": None})
+    cmds = t["remote"].node("n2").cmds()
+    assert any("pgrep -f -- mydb" in c and "STOP" in c for c in cmds)
+    nem.invoke(t, {"f": "stop-pause", "type": "invoke", "value": None})
+    assert any("CONT" in c for c in t["remote"].node("n2").cmds())
