@@ -1,0 +1,176 @@
+"""Persistent test store.
+
+Equivalent of `jepsen/src/jepsen/store.clj` (SURVEY.md §2.1): each run gets a
+directory ``store/<test-name>/<timestamp>/`` containing
+
+- ``test.jepsen``  — the block-structured binary file (test + chunked
+  history + results; see :mod:`jepsen_tpu.store.format`),
+- ``history.json`` / ``results.json`` — human-readable mirrors,
+- ``jepsen.log``   — the run log (wired by `core.run`),
+- downloaded node logs under ``<node>/``.
+
+Two-phase writes, exactly as the reference: :func:`save_0` persists the test
+and history *before* analysis (so a crashed checker loses nothing), and
+:func:`save_1` appends results afterwards without rewriting history blocks.
+A ``latest`` symlink per test name and a global ``current`` symlink track the
+most recent run.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any, Iterator, List, Optional
+
+from ..history.ops import History
+from . import codec
+from .format import JepsenFile, LazyHistory
+
+BASE = "store"
+TEST_FILE = "test.jepsen"
+
+
+def _base(test_or_opts: Optional[dict] = None) -> str:
+    if test_or_opts and test_or_opts.get("store-dir"):
+        return test_or_opts["store-dir"]
+    return BASE
+
+
+def sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_. " else "_" for c in name) or "test"
+
+
+def timestamp(t: Optional[float] = None) -> str:
+    # UTC so directory names sort chronologically even across DST shifts.
+    t = time.time() if t is None else t
+    return time.strftime("%Y%m%dT%H%M%S", time.gmtime(t)) + f".{int(t * 1000) % 1000:03d}Z"
+
+
+def test_dir(test: dict) -> str:
+    """The run directory for a test, creating it (and the timestamp) on
+    first use; cached in the test map under "start-time-str"."""
+    name = sanitize(test.get("name", "test"))
+    ts = test.get("start-time-str")
+    if ts is None:
+        ts = timestamp(test.get("start-time"))
+        test["start-time-str"] = ts
+    d = os.path.join(_base(test), name, ts)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def path(test: dict, *components: str) -> str:
+    return os.path.join(test_dir(test), *components)
+
+
+def _relink(link: str, target: str) -> None:
+    tmp = link + ".tmp"
+    try:
+        if os.path.lexists(tmp):
+            os.remove(tmp)
+        os.symlink(target, tmp)
+        os.replace(tmp, link)
+    except OSError:
+        pass  # symlinks unsupported (exotic fs); non-fatal
+
+
+def update_symlinks(test: dict) -> None:
+    d = test_dir(test)
+    name = sanitize(test.get("name", "test"))
+    _relink(os.path.join(_base(test), name, "latest"), os.path.basename(d))
+    _relink(os.path.join(_base(test), "current"), os.path.join(name, os.path.basename(d)))
+
+
+def _normalized_history(test: dict) -> Optional[History]:
+    hist = test.get("history")
+    if hist is not None and not isinstance(hist, History):
+        hist = History([op if hasattr(op, "to_dict") else _op_from(op) for op in hist],
+                       reindex=False)
+    return hist
+
+
+def _op_from(d: dict):
+    from ..history.ops import Op
+
+    return Op.from_dict(d)
+
+
+def save_0(test: dict) -> dict:
+    """Phase 0: persist test map + history before analysis."""
+    d = test_dir(test)
+    hist = _normalized_history(test)
+    JepsenFile(os.path.join(d, TEST_FILE)).write_test(test, hist)
+    if hist is not None:
+        with open(os.path.join(d, "history.json"), "w") as f:
+            for op in hist:
+                f.write(codec.dumps(op.to_dict()).decode() + "\n")
+    update_symlinks(test)
+    return test
+
+
+def save_1(test: dict) -> dict:
+    """Phase 1: append results after analysis; history blocks untouched."""
+    d = test_dir(test)
+    results = test.get("results", {})
+    jf = JepsenFile(os.path.join(d, TEST_FILE))
+    if not os.path.exists(jf.path):
+        jf.write_test(test, _normalized_history(test))
+    jf.append_results(results)
+    with open(os.path.join(d, "results.json"), "w") as f:
+        f.write(codec.dumps(results).decode())
+    update_symlinks(test)
+    return test
+
+
+def load(name_or_dir: str, ts: Optional[str] = None, *, base: Optional[str] = None) -> dict:
+    """Load a stored test.  `load(dir)` or `load(name, timestamp)`;
+    timestamp defaults to "latest".  History comes back lazy."""
+    if ts is None and os.path.isdir(name_or_dir):
+        d = name_or_dir
+    else:
+        d = os.path.join(base or BASE, sanitize(name_or_dir), ts or "latest")
+        if (ts is None or ts == "latest") and not os.path.isdir(d):
+            # symlinks unavailable on this fs — fall back to the dir scan
+            found = latest(name_or_dir, base=base)
+            if found is None:
+                raise FileNotFoundError(f"no stored runs for {name_or_dir!r}")
+            d = found
+    d = os.path.realpath(d)
+    return JepsenFile(os.path.join(d, TEST_FILE)).read()
+
+
+def load_results(name: str, ts: Optional[str] = None, *, base: Optional[str] = None) -> Optional[dict]:
+    t = load(name, ts, base=base)
+    return t.get("results")
+
+
+def tests(name: Optional[str] = None, *, base: Optional[str] = None) -> List[str]:
+    """List run directories, newest first (lazy dir scan, as jepsen.web)."""
+    b = base or BASE
+    out: List[str] = []
+    if not os.path.isdir(b):
+        return out
+    names = [sanitize(name)] if name else sorted(os.listdir(b))
+    for n in names:
+        nd = os.path.join(b, n)
+        if not os.path.isdir(nd):
+            continue
+        for ts in os.listdir(nd):
+            d = os.path.join(nd, ts)
+            if ts != "latest" and os.path.isdir(d) and not os.path.islink(d):
+                out.append(d)
+    return sorted(out, reverse=True)
+
+
+def latest(name: Optional[str] = None, *, base: Optional[str] = None) -> Optional[str]:
+    ds = tests(name, base=base)
+    return ds[0] if ds else None
+
+
+def delete(name: str, ts: Optional[str] = None, *, base: Optional[str] = None) -> None:
+    """Delete one run, or all runs of a test name."""
+    b = base or BASE
+    d = os.path.join(b, sanitize(name)) if ts is None else os.path.join(b, sanitize(name), ts)
+    if os.path.isdir(d):
+        shutil.rmtree(d)

@@ -1,0 +1,194 @@
+"""Store layer tests: codec round-trips, binary format, two-phase save."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import store
+from jepsen_tpu.store import codec
+from jepsen_tpu.store.format import CHUNK_SIZE, FormatError, JepsenFile
+from jepsen_tpu.history.ops import History, Op, invoke, ok
+
+
+# -- codec ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "v",
+    [
+        None,
+        42,
+        3.5,
+        "hi",
+        [1, 2, 3],
+        ("append", 3, 7),
+        [("append", 1, 2), ("r", 1, [1, 2])],
+        {"a": 1, "b": [True, False]},
+        {1: "x", (2, 3): "y"},
+        {"§t": "literal-key"},
+        {1, 2, 3},
+        b"\x00\xffbytes",
+        {"nested": {"deep": [({"k": (1,)},)]}},
+    ],
+)
+def test_codec_roundtrip(v):
+    assert codec.loads(codec.dumps(v)) == v
+
+
+def test_codec_unserializable_placeholder():
+    class Weird:
+        pass
+
+    out = codec.loads(codec.dumps({"db": Weird()}))
+    assert "Weird" in out["db"]["§obj"]
+
+
+# -- binary format --------------------------------------------------------
+
+
+def _mk_history(n):
+    ops = []
+    for i in range(n // 2):
+        ops.append(invoke(i % 5, "txn", [("append", 1, i)]))
+        ops.append(ok(i % 5, "txn", [("append", 1, i)]))
+    return History(ops)
+
+
+def test_format_roundtrip(tmp_path):
+    p = str(tmp_path / "t.jepsen")
+    h = _mk_history(100)
+    test = {"name": "fmt", "nodes": ["n1"], "concurrency": 5}
+    jf = JepsenFile(p)
+    jf.write_test(test, h)
+
+    t2 = jf.read_test()
+    assert t2["name"] == "fmt"
+    assert "history" not in t2
+
+    h2 = jf.read_history()
+    assert len(h2) == 100
+    assert h2[0].type == "invoke"
+    assert h2[0].value == [("append", 1, 0)]  # tuples survive
+    assert h2[99].index == 99
+    assert jf.read_results() is None
+
+
+def test_format_append_results_preserves_history(tmp_path):
+    p = str(tmp_path / "t.jepsen")
+    jf = JepsenFile(p)
+    jf.write_test({"name": "x"}, _mk_history(10))
+    size0 = os.path.getsize(p)
+    jf.append_results({"valid?": True, "count": 10})
+    # results appended, not rewritten in place
+    assert os.path.getsize(p) > size0
+    assert jf.read_results() == {"valid?": True, "count": 10}
+    assert len(jf.read_history()) == 10
+    # append again (re-analysis) overrides
+    jf.append_results({"valid?": False})
+    assert jf.read_results() == {"valid?": False}
+
+
+def test_format_multi_chunk_lazy(tmp_path):
+    p = str(tmp_path / "big.jepsen")
+    n = CHUNK_SIZE * 2 + 10
+    h = _mk_history(n)
+    JepsenFile(p).write_test({"name": "big"}, h)
+    lh = JepsenFile(p).read_history()
+    assert len(lh) == n
+    assert len(lh._chunks) == 3
+    # random access hits the right chunk
+    assert lh[CHUNK_SIZE].index == CHUNK_SIZE
+    assert lh[-1].index == n - 1
+    # chunk streaming yields everything in order
+    seen = 0
+    for chunk in lh.iter_chunks():
+        for op in chunk:
+            assert op.index == seen
+            seen += 1
+    assert seen == n
+    assert len(lh.materialize()) == n
+
+
+def test_format_corruption_detected(tmp_path):
+    p = str(tmp_path / "c.jepsen")
+    JepsenFile(p).write_test({"name": "c"}, _mk_history(4))
+    with open(p, "r+b") as f:
+        f.seek(40)
+        f.write(b"\xde\xad\xbe\xef")
+    with pytest.raises(FormatError):
+        JepsenFile(p).read()
+
+
+# -- store dirs + two-phase ------------------------------------------------
+
+
+def test_store_two_phase(tmp_path):
+    base = str(tmp_path / "store")
+    test = {"name": "demo", "store-dir": base, "history": _mk_history(20)}
+    store.save_0(test)
+    d = store.test_dir(test)
+    assert os.path.exists(os.path.join(d, "test.jepsen"))
+    assert os.path.exists(os.path.join(d, "history.json"))
+
+    test["results"] = {"valid?": True}
+    store.save_1(test)
+    assert os.path.exists(os.path.join(d, "results.json"))
+
+    loaded = store.load(d)
+    assert loaded["name"] == "demo"
+    assert loaded["results"]["valid?"] is True
+    assert len(loaded["history"]) == 20
+    assert loaded["history"][3].value == [("append", 1, 1)]
+
+
+def test_store_listing_and_latest(tmp_path):
+    base = str(tmp_path / "store")
+    for i in range(2):
+        t = {"name": "lst", "store-dir": base, "start-time": 1000.0 + i * 61,
+             "history": _mk_history(2), "results": {"valid?": True, "i": i}}
+        store.save_0(t)
+        store.save_1(t)
+    runs = store.tests("lst", base=base)
+    assert len(runs) == 2
+    assert runs[0] > runs[1]
+    assert store.latest("lst", base=base) == runs[0]
+    # latest symlink resolves via load(name, "latest")
+    loaded = store.load("lst", "latest", base=base)
+    assert loaded["results"]["i"] == 1
+    store.delete("lst", base=base)
+    assert store.tests("lst", base=base) == []
+
+
+# -- review regressions ----------------------------------------------------
+
+
+def test_save0_with_exception_error_and_numpy(tmp_path):
+    import numpy as np
+
+    ops = [invoke(0, "r", None),
+           Op(type="info", process=0, f="r", value=None,
+              error=RuntimeError("boom"))]
+    t = {"name": "err", "store-dir": str(tmp_path / "s"),
+         "history": History(ops), "results": {"valid?": np.True_}}
+    store.save_0(t)  # must not raise on unserializable error values
+    store.save_1(t)
+    loaded = store.load(store.test_dir(t))
+    assert loaded["results"]["valid?"] is True  # np.bool_ round-trips
+
+
+def test_save1_without_save0_dict_ops(tmp_path):
+    t = {"name": "dicts", "store-dir": str(tmp_path / "s"),
+         "history": [{"type": "invoke", "process": 0, "f": "r", "value": None},
+                     {"type": "ok", "process": 0, "f": "r", "value": 1}],
+         "results": {"valid?": True}}
+    store.save_1(t)
+    assert store.load(store.test_dir(t))["results"]["valid?"] is True
+
+
+def test_listing_with_unsanitized_name(tmp_path):
+    base = str(tmp_path / "s")
+    t = {"name": "my test!", "store-dir": base, "history": _mk_history(2)}
+    store.save_0(t)
+    assert len(store.tests("my test!", base=base)) == 1
+    assert store.latest("my test!", base=base) is not None
+    assert store.load("my test!", "latest", base=base)["name"] == "my test!"
