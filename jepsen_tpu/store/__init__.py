@@ -38,7 +38,10 @@ def _base(test_or_opts: Optional[dict] = None) -> str:
 
 
 def sanitize(name: str) -> str:
-    return "".join(c if c.isalnum() or c in "-_. " else "_" for c in name) or "test"
+    s = "".join(c if c.isalnum() or c in "-_. " else "_" for c in name)
+    if not s or set(s) <= {"."}:  # "." / ".." would escape the store root
+        return "test"
+    return s
 
 
 def timestamp(t: Optional[float] = None) -> str:
@@ -154,7 +157,9 @@ def tests(name: Optional[str] = None, *, base: Optional[str] = None) -> List[str
     names = [sanitize(name)] if name else sorted(os.listdir(b))
     for n in names:
         nd = os.path.join(b, n)
-        if not os.path.isdir(nd):
+        # skip the base-level "current" symlink (and anything like it):
+        # only real per-name directories hold runs
+        if os.path.islink(nd) or not os.path.isdir(nd):
             continue
         for ts in os.listdir(nd):
             d = os.path.join(nd, ts)

@@ -21,6 +21,7 @@ import numpy as np
 _TUPLE = "§t"
 _DICT = "§d"  # dict with non-string keys, as [[k, v], ...]
 _SET = "§s"
+_FSET = "§fs"
 _BYTES = "§b"
 _ESCAPE = "§§"  # literal dict whose keys start with §
 
@@ -28,7 +29,9 @@ _ESCAPE = "§§"  # literal dict whose keys start with §
 def _encode(v: Any) -> Any:
     if isinstance(v, tuple):
         return {_TUPLE: [_encode(x) for x in v]}
-    if isinstance(v, (set, frozenset)):
+    if isinstance(v, frozenset):
+        return {_FSET: [_encode(x) for x in sorted(v, key=repr)]}
+    if isinstance(v, set):
         return {_SET: [_encode(x) for x in sorted(v, key=repr)]}
     if isinstance(v, (bytes, bytearray)):
         return {_BYTES: bytes(v).hex()}
@@ -59,6 +62,8 @@ def _decode(v: Any) -> Any:
                 return tuple(_decode(x) for x in payload)
             if tag == _SET:
                 return set(_decode(x) for x in payload)
+            if tag == _FSET:
+                return frozenset(_decode(x) for x in payload)
             if tag == _BYTES:
                 return bytes.fromhex(payload)
             if tag == _DICT:

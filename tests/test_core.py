@@ -1,0 +1,166 @@
+"""Orchestration tests: core.run against the in-process sim cluster
+(reference core_test.clj strategy, SURVEY.md §4)."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import core, db, store
+from jepsen_tpu import control as control_api
+from jepsen_tpu.checkers import api as checker_api
+from jepsen_tpu.control.sim import SimRemote
+from jepsen_tpu.generator import core as g
+from jepsen_tpu.history.ops import INVOKE, OK
+from jepsen_tpu.nemesis.core import Noop as NoopNemesis
+from jepsen_tpu.workloads.mem import MemClient, MemStore
+
+
+class RecordingDB(db.DB, db.LogFiles):
+    """A db that records lifecycle calls and runs a setup command."""
+
+    def __init__(self):
+        self.calls = []
+
+    def setup(self, test, node):
+        self.calls.append(("setup", node))
+        control_api.exec_("install-db", "--version", "1")
+
+    def teardown(self, test, node):
+        self.calls.append(("teardown", node))
+
+    def log_files(self, test, node):
+        return []
+
+
+def _base_test(tmp_path, **kw):
+    remote = SimRemote()
+    for n in ("n1", "n2", "n3"):
+        remote.node(n).respond("*", "")
+    t = dict(
+        name="core-test",
+        nodes=["n1", "n2", "n3"],
+        remote=remote,
+        db=RecordingDB(),
+        client=MemClient(),
+        concurrency=3,
+        generator=g.clients(g.limit(
+            12, lambda t, c: {"f": "read", "value": None})),
+        checker=checker_api.Stats(),
+        **{"store-dir": str(tmp_path / "store")},
+    )
+    t.update(kw)
+    return t
+
+
+def test_run_full_lifecycle(tmp_path):
+    t = _base_test(tmp_path)
+    rdb = t["db"]
+    done = core.run(t)
+
+    # history produced and complete
+    h = done["history"]
+    assert len([o for o in h if o.type == INVOKE]) == 12
+    assert len([o for o in h if o.type == OK]) == 12
+    # results from the checker
+    assert done["results"]["valid?"] is True
+    assert done["results"]["count"] == 12
+    # db setup and teardown ran on every node
+    assert {("setup", n) for n in t["nodes"]} <= set(rdb.calls)
+    assert {("teardown", n) for n in t["nodes"]} <= set(rdb.calls)
+    # setup command actually went through the control plane
+    assert any("install-db" in c
+               for c in t["remote"].all_cmds()["n1"])
+    # store artifacts written
+    d = store.test_dir(done)
+    for f in ("test.jepsen", "history.json", "results.json", "jepsen.log"):
+        assert os.path.exists(os.path.join(d, f)), f
+    # sessions were closed and scrubbed from the map
+    assert "sessions" not in done
+
+
+def test_run_noop_no_nodes(tmp_path):
+    done = core.run({"name": "noop", "store-dir": str(tmp_path / "s")})
+    assert done["results"]["valid?"] is True
+    assert len(done["history"]) == 0
+
+
+def test_run_with_nemesis_lifecycle(tmp_path):
+    events = []
+
+    class TrackingNemesis(NoopNemesis):
+        def setup(self, test):
+            events.append("setup")
+            return self
+
+        def invoke(self, test, op):
+            events.append(op["f"])
+            return dict(op, type="info")
+
+        def teardown(self, test):
+            events.append("teardown")
+
+    gen = g.any_gen(
+        g.clients(g.limit(4, lambda t, c: {"f": "read", "value": None})),
+        g.nemesis(g.limit(1, {"f": "start-partition", "value": None})),
+    )
+    t = _base_test(tmp_path, nemesis=TrackingNemesis(), generator=gen)
+    done = core.run(t)
+    assert events[0] == "setup" and events[-1] == "teardown"
+    assert "start-partition" in events
+    nem_ops = [o for o in done["history"] if o.process == "nemesis"]
+    assert nem_ops
+
+
+def test_checker_crash_is_captured_not_raised(tmp_path):
+    class Exploder(checker_api.Checker):
+        def check(self, test, history, opts=None):
+            raise RuntimeError("kaboom")
+
+    t = _base_test(tmp_path, checker=Exploder())
+    done = core.run(t)
+    assert done["results"]["valid?"] == "unknown"
+    assert "kaboom" in str(done["results"].get("error", ""))
+    # phase-0 artifacts survived the checker crash
+    assert os.path.exists(os.path.join(store.test_dir(done), "history.json"))
+
+
+def test_analyze_recheck_from_store(tmp_path):
+    t = _base_test(tmp_path)
+    done = core.run(t)
+    d = store.test_dir(done)
+    re = core.analyze(d, checker=checker_api.Stats())
+    assert re["results"]["valid?"] is True
+    assert re["results"]["count"] == 12
+    # results were re-saved
+    assert store.load(d)["results"]["count"] == 12
+
+
+def test_analyze_requires_checker(tmp_path):
+    t = _base_test(tmp_path)
+    done = core.run(t)
+    with pytest.raises(ValueError):
+        core.analyze(store.test_dir(done))
+
+
+def test_leave_db_running(tmp_path):
+    t = _base_test(tmp_path, **{"leave-db-running": True})
+    rdb = t["db"]
+    core.run(t)
+    assert not any(c[0] == "teardown" for c in rdb.calls)
+
+
+def test_teardown_runs_when_workload_crashes(tmp_path):
+    t = _base_test(tmp_path, client=MemClient())
+    rdb = t["db"]
+
+    # crash during db setup on one node
+    orig_setup = rdb.setup
+    def bad_setup(test, node):
+        orig_setup(test, node)
+        if node == "n2":
+            raise RuntimeError("node 2 is on fire")
+    rdb.setup = bad_setup
+    with pytest.raises(Exception):
+        core.run(t)
+    # teardown still ran on all nodes despite the setup crash
+    assert {("teardown", n) for n in t["nodes"]} <= set(rdb.calls)

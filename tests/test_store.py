@@ -192,3 +192,26 @@ def test_listing_with_unsanitized_name(tmp_path):
     assert len(store.tests("my test!", base=base)) == 1
     assert store.latest("my test!", base=base) is not None
     assert store.load("my test!", "latest", base=base)["name"] == "my test!"
+
+
+def test_codec_frozenset_roundtrip():
+    v = {frozenset({1, 2}): "x"}
+    assert codec.loads(codec.dumps(v)) == v
+    fs = codec.loads(codec.dumps(frozenset({1})))
+    assert isinstance(fs, frozenset)
+
+
+def test_sanitize_dotdot():
+    assert store.sanitize("..") == "test"
+    assert store.sanitize(".") == "test"
+    assert store.sanitize("a..b") == "a..b"
+
+
+def test_listing_skips_current_symlink(tmp_path):
+    base = str(tmp_path / "s")
+    t = {"name": "demo", "store-dir": base, "history": _mk_history(2)}
+    store.save_0(t)
+    os.makedirs(os.path.join(store.test_dir(t), "n1"))  # node-log dir
+    runs = store.tests(base=base)
+    assert len(runs) == 1
+    assert "current" not in os.path.relpath(runs[0], base)
