@@ -33,6 +33,7 @@ from . import os_setup, store
 from .checkers import api as checker_api
 from .control import api as control
 from .control.core import Remote, Session
+from .generator import core as gen_core
 from .generator import interpreter
 from .history.ops import History
 
@@ -159,7 +160,7 @@ def run(test: dict) -> dict:
         sessions = _open_sessions(test)
         test["sessions"] = sessions
         try:
-            if test.get("nodes"):
+            if test.get("nodes") and test.get("remote") is not None:
                 os_ = test.get("os") or os_setup.noop
                 control.on_nodes(test, os_.setup)
                 _db_setup(test)
@@ -167,6 +168,12 @@ def run(test: dict) -> dict:
                 test["nemesis"] = nemesis = nemesis.setup(test) or nemesis
 
             logger.info("Starting workload")
+            fg = test.get("final-generator")
+            if fg is not None:
+                # quiesce, then the final phase (reference: run! drives
+                # :generator then :final-generator once clients settle)
+                test["generator"] = gen_core.phases(
+                    test.get("generator"), fg)
             hist = interpreter.run(test)
             test["history"] = hist
             logger.info("Workload complete: %d ops", len(hist))
@@ -179,7 +186,7 @@ def run(test: dict) -> dict:
             # way, and node logs are most valuable for crashed runs.
             if nemesis is not None:
                 _quietly("nemesis teardown", lambda: nemesis.teardown(test))
-            if test.get("nodes"):
+            if test.get("nodes") and test.get("remote") is not None:
                 _quietly("log download", lambda: _download_logs(test))
                 _quietly("db teardown", lambda: _db_teardown(test))
                 os_ = test.get("os") or os_setup.noop

@@ -1,1 +1,13 @@
-"""Workloads (L5, SURVEY.md §2.6): test suites the framework expresses."""
+"""Workloads (L5, SURVEY.md §2.6): test suites the framework expresses.
+
+Each workload module exposes ``workload(**opts) -> dict`` with
+``generator`` / ``checker`` (and optionally ``final-generator`` plus extra
+test-map keys), mirroring the reference's `{:generator :client :checker
+:final-generator}` workload maps.  Clients come from the db-specific suite
+(or `jepsen_tpu.workloads.mem` for in-process runs).
+"""
+
+from . import append, bank, linearizable_register, long_fork, queue, sets, wr
+
+__all__ = ["append", "bank", "linearizable_register", "long_fork",
+           "queue", "sets", "wr"]

@@ -1,0 +1,107 @@
+"""Bank workload.
+
+Equivalent of the reference's `jepsen/src/jepsen/tests/bank.clj`
+(SURVEY.md §2.6): concurrent transfers between accounts plus whole-state
+reads; under snapshot isolation the total balance must be invariant, and
+read skew shows up as reads whose balances don't sum to the expected total.
+Negative balances are flagged unless the test allows them.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..checkers import api as checker_api
+from ..history.ops import OK
+
+
+class _BankGen:
+    def __init__(self, *, accounts=(0, 1, 2, 3, 4, 5, 6, 7),
+                 max_transfer: int = 5, read_frac: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        self.accounts = list(accounts)
+        self.max_transfer = max_transfer
+        self.read_frac = read_frac
+        self.rng = rng or random.Random()
+
+    def __call__(self, test, ctx):
+        if self.rng.random() < self.read_frac:
+            return {"f": "read", "value": None}
+        frm, to = self.rng.sample(self.accounts, 2)
+        return {"f": "transfer",
+                "value": {"from": frm, "to": to,
+                          "amount": 1 + self.rng.randrange(self.max_transfer)}}
+
+
+def gen(**opts) -> Any:
+    return _BankGen(**opts)
+
+
+class BankChecker(checker_api.Checker):
+    """Total-balance invariant over all reads (vectorised: reads become a
+    dense [n_reads, n_accounts] matrix; row sums and sign checks are one
+    numpy pass — the same shape the device fold would use).
+
+    Reference `bank/checker`: :bad-reads = reads with wrong total or
+    (unless negative-balances?) any negative balance."""
+
+    def __init__(self, *, negative_balances_ok: bool = False):
+        self.negative_ok = negative_balances_ok
+
+    def check(self, test, history, opts=None):
+        total = test.get("total-amount")
+        if total is None:
+            accounts = test.get("accounts")
+            if isinstance(accounts, dict) and accounts:
+                total = sum(accounts.values())
+        reads = [op for op in history
+                 if op.type == OK and op.f == "read"
+                 and isinstance(op.value, dict)]
+        if not reads:
+            return {"valid?": "unknown", "read-count": 0}
+        accts = sorted({a for op in reads for a in op.value})
+        mat = np.array([[op.value.get(a, 0) for a in accts] for op in reads],
+                       dtype=np.int64)
+        sums = mat.sum(axis=1)
+        if total is None:
+            # no configured total: use the modal sum, so a single
+            # anomalous read can't become the baseline
+            vals, counts = np.unique(sums, return_counts=True)
+            total = int(vals[np.argmax(counts)])
+        wrong_total = sums != total
+        negative = (mat < 0).any(axis=1) if not self.negative_ok \
+            else np.zeros(len(reads), dtype=bool)
+        bad = wrong_total | negative
+        bad_reads = [
+            {"op-index": reads[i].index, "total": int(sums[i]),
+             "expected-total": total,
+             "negative": [accts[j] for j in np.nonzero(mat[i] < 0)[0]]}
+            for i in np.nonzero(bad)[0][:8]
+        ]
+        return {
+            "valid?": not bad.any(),
+            "read-count": len(reads),
+            "bad-read-count": int(bad.sum()),
+            "bad-reads": bad_reads,
+        }
+
+
+def workload(*, n_accounts: int = 8, total: int = 80, max_transfer: int = 5,
+             negative_balances_ok: bool = False,
+             rng: Optional[random.Random] = None) -> dict:
+    """Also returns the test-map keys the checker needs (accounts/total),
+    like the reference workload's extra test keys."""
+    accounts = {i: total // n_accounts for i in range(n_accounts)}
+    return {
+        "generator": gen(accounts=range(n_accounts),
+                         max_transfer=max_transfer, rng=rng),
+        "checker": BankChecker(negative_balances_ok=negative_balances_ok),
+        "accounts": accounts,
+        # derived from the actual initial balances, so a non-divisible
+        # `total` can't make every read look invalid
+        "total-amount": sum(accounts.values()),
+        "workload-kind": "bank",
+    }

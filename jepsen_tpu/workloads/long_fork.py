@@ -1,0 +1,106 @@
+"""Long-fork workload.
+
+Equivalent of the reference's `jepsen/src/jepsen/tests/long_fork.clj`
+(SURVEY.md §2.6): writers insert distinct values into distinct keys (one
+write per txn); readers read a whole key group in one txn.  Under snapshot
+isolation all reads must observe the writes in a single order; a **long
+fork** is two reads that order two writes oppositely:
+
+    read A sees w1 but not w2;  read B sees w2 but not w1.
+"""
+
+from __future__ import annotations
+
+import random
+from itertools import combinations
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..checkers import api as checker_api
+from ..history.ops import OK
+
+
+class _LongForkGen:
+    """Writes cycle through key groups; each key is written at most once
+    (value = a global counter), reads cover one whole group."""
+
+    def __init__(self, *, group_size: int = 3, read_frac: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        self.n = group_size
+        self.read_frac = read_frac
+        self.rng = rng or random.Random()
+        self.next_write = 0
+
+    def _group_of(self, k: int) -> List[int]:
+        g = k // self.n
+        return list(range(g * self.n, (g + 1) * self.n))
+
+    def __call__(self, test, ctx):
+        if self.rng.random() < self.read_frac and self.next_write > 0:
+            k = self.rng.randrange(self.next_write)
+            return {"f": "txn",
+                    "value": [("r", k2, None) for k2 in self._group_of(k)]}
+        k = self.next_write
+        self.next_write += 1
+        return {"f": "txn", "value": [("w", k, k)]}
+
+
+def gen(**opts) -> Any:
+    return _LongForkGen(**opts)
+
+
+class LongForkChecker(checker_api.Checker):
+    """Finds long-fork read pairs (reference `long-fork/checker`).
+
+    For each pair of committed group reads over the same keys, and each
+    pair of written keys (k1, k2) both covered: if read A has k1 written
+    and k2 missing while read B has k2 written and k1 missing, the two
+    reads disagree on the write order — G2 long fork."""
+
+    def check(self, test, history, opts=None):
+        reads: List[Any] = []
+        for op in history:
+            if op.type != OK or op.f != "txn":
+                continue
+            mops = op.value or []
+            if mops and all(m[0] == "r" for m in mops):
+                reads.append(op)
+        if not reads:
+            return {"valid?": "unknown", "read-count": 0}
+        forks = []
+        # Bucket reads by their key set first: reads over different key
+        # groups can never witness a fork together, so pairing is
+        # O(sum per-group n^2), not O(total-reads^2).
+        buckets: Dict[frozenset, List[int]] = {}
+        obs = [{m[1]: m[2] for m in op.value} for op in reads]
+        for i, o in enumerate(obs):
+            buckets.setdefault(frozenset(o), []).append(i)
+        pairs = (p for idxs in buckets.values()
+                 for p in combinations(idxs, 2))
+        for ia, ib in pairs:
+            a, b = reads[ia], reads[ib]
+            shared = [k for k in obs[ia] if k in obs[ib]]
+            for k1, k2 in combinations(shared, 2):
+                a1, a2 = obs[ia][k1], obs[ia][k2]
+                b1, b2 = obs[ib][k1], obs[ib][k2]
+                if a1 is not None and a2 is None \
+                        and b1 is None and b2 is not None:
+                    forks.append({"reads": [a.index, b.index],
+                                  "keys": [k1, k2]})
+                elif a1 is None and a2 is not None \
+                        and b1 is not None and b2 is None:
+                    forks.append({"reads": [a.index, b.index],
+                                  "keys": [k2, k1]})
+        return {
+            "valid?": not forks,
+            "read-count": len(reads),
+            "long-forks": forks[:8],
+            "fork-count": len(forks),
+        }
+
+
+def workload(*, group_size: int = 3,
+             rng: Optional[random.Random] = None) -> dict:
+    return {
+        "generator": gen(group_size=group_size, rng=rng),
+        "checker": LongForkChecker(),
+    }

@@ -1,0 +1,51 @@
+"""Queue workload: enqueue/dequeue with a final drain.
+
+Equivalent of the reference's queue workloads (SURVEY.md §2.6, built-in
+`checker/queue` and `total-queue`): clients enqueue unique values and
+dequeue concurrently; the final generator drains.  `total-queue` semantics:
+every enqueued value should be dequeued exactly once (lost = enqueued-ok
+never dequeued, duplicated = dequeued twice, phantom = dequeued but never
+enqueued).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Optional
+
+from ..checkers import api as checker_api
+from ..generator import core as g
+
+
+class _QueueGen:
+    def __init__(self, *, dequeue_frac: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        self.counter = itertools.count()
+        self.dequeue_frac = dequeue_frac
+        self.rng = rng or random.Random()
+
+    def __call__(self, test, ctx):
+        if self.rng.random() < self.dequeue_frac:
+            return {"f": "dequeue", "value": None}
+        return {"f": "enqueue", "value": next(self.counter)}
+
+
+def gen(**opts) -> Any:
+    return _QueueGen(**opts)
+
+
+def drain(n: int = 32) -> Any:
+    """Final drain: keep dequeuing until empty (bounded; a bare map
+    generator emits once, so repeat it)."""
+    return g.clients(g.limit(n, g.repeat({"f": "dequeue", "value": None})))
+
+
+def workload(*, total: bool = True, drain_ops: int = 64,
+             rng: Optional[random.Random] = None) -> dict:
+    return {
+        "generator": gen(rng=rng),
+        "final-generator": drain(drain_ops),
+        "checker": (checker_api.TotalQueueChecker() if total
+                    else checker_api.QueueChecker()),
+    }

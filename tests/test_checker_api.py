@@ -1,7 +1,7 @@
 """Built-in checker tests (reference checker_test.clj style)."""
 
 from jepsen_tpu.checkers.api import (
-    CounterChecker, QueueChecker, SetChecker, Stats, UniqueIds,
+    CounterChecker, TotalQueueChecker, SetChecker, Stats, UniqueIds,
     check_safe, compose,
 )
 from jepsen_tpu.history import history, invoke, ok, fail, info
@@ -13,7 +13,7 @@ def test_queue_info_enqueue_not_lost():
         invoke(0, "enqueue", 1),
         info(0, "enqueue", 1),
     ])
-    res = QueueChecker().check({}, h)
+    res = TotalQueueChecker().check({}, h)
     assert res["valid?"] is True
     assert res["lost-count"] == 0
 
@@ -23,7 +23,7 @@ def test_queue_lost_and_unexpected():
         invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
         invoke(1, "dequeue", None), ok(1, "dequeue", 7),
     ])
-    res = QueueChecker().check({}, h)
+    res = TotalQueueChecker().check({}, h)
     assert res["valid?"] is False
     assert res["lost"] == {1: 1}
     assert res["unexpected"] == {7: 1}

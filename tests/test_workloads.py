@@ -1,0 +1,279 @@
+"""Workload package tests: each workload runs end-to-end on the sim
+cluster and its checker reaches the right verdict (SURVEY.md §2.6/§4)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import core, independent
+from jepsen_tpu.generator import core as g
+from jepsen_tpu.history.ops import History, history, invoke, ok
+from jepsen_tpu.workloads import (append, bank, linearizable_register,
+                                  long_fork, queue, sets, wr)
+from jepsen_tpu.workloads.mem import MemClient, MemStore, bank_store
+
+
+def run_workload(tmp_path, wl, client, *, n_ops=30, concurrency=4, **kw):
+    t = {
+        "name": "wl-test",
+        "nodes": ["n1", "n2"],
+        "client": client,
+        "concurrency": concurrency,
+        "store-dir": str(tmp_path / "store"),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "checker", "final-generator")},
+        "generator": g.clients(g.limit(n_ops, wl["generator"])),
+        "checker": wl["checker"],
+        **kw,
+    }
+    if "final-generator" in wl:
+        t["final-generator"] = wl["final-generator"]
+    return core.run(t)
+
+
+# ---------------------------------------------------------------- append
+
+def test_append_workload_valid(tmp_path):
+    wl = append.workload(rng=random.Random(1))
+    done = run_workload(tmp_path, wl, MemClient())
+    assert done["results"]["valid?"] is True
+
+
+def test_append_gen_unique_appends():
+    gen = append.gen(key_count=3, max_writes_per_key=4,
+                     rng=random.Random(2))
+    seen = set()
+    for _ in range(200):
+        op = gen({}, None)
+        for kind, k, v in op["value"]:
+            if kind == "append":
+                assert (k, v) not in seen, "duplicate append"
+                seen.add((k, v))
+
+
+def test_append_key_rotation():
+    gen = append.gen(key_count=2, max_writes_per_key=3, read_frac=0.0,
+                     rng=random.Random(3))
+    keys = set()
+    for _ in range(100):
+        for kind, k, v in gen({}, None)["value"]:
+            keys.add(k)
+    assert len(keys) > 2  # retired keys were replaced with fresh ones
+
+
+# ---------------------------------------------------------------- wr
+
+def test_wr_workload_valid(tmp_path):
+    wl = wr.workload(rng=random.Random(4))
+    done = run_workload(tmp_path, wl,
+                        MemClient(txn_kind="rw-register"))
+    assert done["results"]["valid?"] in (True, "unknown")
+
+
+# ----------------------------------------------------- linearizable register
+
+def test_linearizable_register_valid(tmp_path):
+    wl = linearizable_register.workload(rng=random.Random(5))
+    done = run_workload(tmp_path, wl, MemClient(), n_ops=20, concurrency=3)
+    assert done["results"]["valid?"] is True
+
+
+# ---------------------------------------------------------------- bank
+
+def test_bank_workload_valid(tmp_path):
+    wl = bank.workload(n_accounts=4, total=40, rng=random.Random(6))
+    store = MemStore()
+    store.accounts = dict(wl["accounts"])
+    done = run_workload(tmp_path, wl, MemClient(store))
+    assert done["results"]["valid?"] is True
+    assert done["results"]["read-count"] > 0
+
+
+def test_bank_checker_catches_bad_total():
+    h = history([
+        invoke(0, "read", None), ok(0, "read", {0: 10, 1: 10}),
+        invoke(0, "read", None), ok(0, "read", {0: 10, 1: 5}),
+    ])
+    res = bank.BankChecker().check({"total-amount": 20}, h)
+    assert res["valid?"] is False
+    assert res["bad-read-count"] == 1
+
+
+def test_bank_checker_catches_negative():
+    h = history([
+        invoke(0, "read", None), ok(0, "read", {0: 25, 1: -5}),
+    ])
+    res = bank.BankChecker().check({"total-amount": 20}, h)
+    assert res["valid?"] is False
+    res2 = bank.BankChecker(negative_balances_ok=True).check(
+        {"total-amount": 20}, h)
+    assert res2["valid?"] is True
+
+
+# ---------------------------------------------------------------- long fork
+
+def test_long_fork_valid(tmp_path):
+    wl = long_fork.workload(rng=random.Random(7))
+    done = run_workload(tmp_path, wl,
+                        MemClient(txn_kind="rw-register"), n_ops=40)
+    assert done["results"]["valid?"] in (True, "unknown")
+
+
+def test_long_fork_detected():
+    # reads order w(0) and w(1) oppositely
+    h = history([
+        invoke(0, "txn", [("w", 0, 0)]), ok(0, "txn", [("w", 0, 0)]),
+        invoke(1, "txn", [("w", 1, 1)]), ok(1, "txn", [("w", 1, 1)]),
+        invoke(2, "txn", [("r", 0, None), ("r", 1, None)]),
+        ok(2, "txn", [("r", 0, 0), ("r", 1, None)]),
+        invoke(3, "txn", [("r", 0, None), ("r", 1, None)]),
+        ok(3, "txn", [("r", 0, None), ("r", 1, 1)]),
+    ])
+    res = long_fork.LongForkChecker().check({}, h)
+    assert res["valid?"] is False
+    assert res["fork-count"] >= 1
+
+
+# ---------------------------------------------------------------- set
+
+def test_set_workload_valid(tmp_path):
+    wl = sets.workload(rng=random.Random(8))
+    done = run_workload(tmp_path, wl, MemClient(), n_ops=20)
+    assert done["results"]["valid?"] is True
+
+
+def test_set_full_workload(tmp_path):
+    wl = sets.workload(full=True, rng=random.Random(9))
+    done = run_workload(tmp_path, wl, MemClient(), n_ops=30)
+    assert done["results"]["valid?"] in (True, "unknown")
+
+
+# ---------------------------------------------------------------- queue
+
+def test_queue_workload_valid(tmp_path):
+    wl = queue.workload(rng=random.Random(10))
+    done = run_workload(tmp_path, wl, MemClient(), n_ops=30)
+    assert done["results"]["valid?"] is True
+
+
+# ---------------------------------------------------------------- independent
+
+def test_independent_sequential(tmp_path):
+    keys = ["a", "b"]
+    gen = independent.sequential_generator(
+        keys, lambda k: g.limit(4, lambda t, c: {"f": "read", "value": None}))
+    # values get wrapped as (k, v) tuples
+    done = core.run({
+        "name": "indep", "client": MemClient(), "concurrency": 2,
+        "nodes": ["n1"], "generator": g.clients(gen),
+        "store-dir": str(tmp_path / "s"),
+    })
+    vals = [op.value for op in done["history"] if op.type == "invoke"]
+    assert all(independent.is_tuple(v) for v in vals)
+    assert {v[0] for v in vals} == {"a", "b"}
+
+
+def test_independent_concurrent_groups(tmp_path):
+    keys = [0, 1, 2, 3]
+    gen = independent.concurrent_generator(
+        2, keys, lambda k: g.limit(3, lambda t, c: {"f": "read", "value": None}))
+    done = core.run({
+        "name": "indep-c", "client": MemClient(), "concurrency": 4,
+        "nodes": ["n1"], "generator": g.clients(gen),
+        "store-dir": str(tmp_path / "s"),
+    })
+    invs = [op for op in done["history"] if op.type == "invoke"]
+    assert len(invs) == 12  # 4 keys x 3 ops
+    assert {op.value[0] for op in invs} == set(keys)
+    # group 0 (threads 0-1) and group 1 (threads 2-3) touch disjoint keys
+    for op in invs:
+        group = 0 if op.process % 4 in (0, 1) else 1
+        assert op.value[0] in (keys[:2] if group == 0 else keys[2:])
+
+
+def test_independent_checker_splits_and_merges():
+    from jepsen_tpu.checkers.api import Stats
+
+    h = history([
+        invoke(0, "read", ("k1", None)), ok(0, "read", ("k1", 1)),
+        invoke(1, "read", ("k2", None)), ok(1, "read", ("k2", 2)),
+    ])
+    res = independent.checker(Stats).check({}, h)
+    assert res["valid?"] is True
+    assert res["key-count"] == 2
+
+
+def test_independent_checker_reports_failing_key():
+    from jepsen_tpu.checkers.api import Checker
+
+    class _FailK2(Checker):
+        def check(self, test, history, opts=None):
+            bad = any(op.value == "poison" for op in history)
+            return {"valid?": not bad}
+
+    h = history([
+        invoke(0, "w", ("k1", 1)), ok(0, "w", ("k1", 1)),
+        invoke(1, "w", ("k2", "poison")), ok(1, "w", ("k2", "poison")),
+    ])
+    res = independent.checker(_FailK2).check({}, h)
+    assert res["valid?"] is False
+    assert res["failures"] == ["k2"]
+
+
+# -- review regressions ----------------------------------------------------
+
+
+def test_bank_workload_nondivisible_total(tmp_path):
+    wl = bank.workload(n_accounts=3, total=10, rng=random.Random(11))
+    assert wl["total-amount"] == sum(wl["accounts"].values())
+    store = MemStore()
+    store.accounts = dict(wl["accounts"])
+    done = run_workload(tmp_path, wl, MemClient(store))
+    assert done["results"]["valid?"] is True
+
+
+def test_bank_checker_modal_total_inference():
+    # 2 good reads, 1 skewed: majority sum wins, skewed read flagged
+    h = history([
+        invoke(0, "read", None), ok(0, "read", {0: 10, 1: 10}),
+        invoke(0, "read", None), ok(0, "read", {0: 15, 1: 10}),
+        invoke(0, "read", None), ok(0, "read", {0: 10, 1: 10}),
+    ])
+    res = bank.BankChecker().check({}, h)
+    assert res["valid?"] is False
+    assert res["bad-read-count"] == 1
+    assert res["bad-reads"][0]["total"] == 25
+
+
+def test_workloads_import_without_jax(monkeypatch):
+    # host-only workloads must not drag jax in at import time
+    import importlib, subprocess, sys
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"  # poison: any import jax explodes
+        "import jepsen_tpu.workloads.bank, jepsen_tpu.workloads.queue\n"
+        "import jepsen_tpu.workloads.append\n"
+        "print('ok')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, cwd="/root/repo")
+    assert "ok" in out.stdout, out.stderr
+
+
+def test_independent_checker_copies_stateful_instances():
+    from jepsen_tpu.checkers.api import Checker
+
+    class Stateful(Checker):
+        def __init__(self):
+            self.seen = []
+
+        def check(self, test, history, opts=None):
+            self.seen.extend(op.value for op in history)
+            return {"valid?": len(self.seen) <= 2}
+
+    h = history([
+        invoke(0, "w", ("k1", 1)), ok(0, "w", ("k1", 1)),
+        invoke(1, "w", ("k2", 2)), ok(1, "w", ("k2", 2)),
+    ])
+    res = independent.checker(Stateful()).check({}, h)
+    assert res["valid?"] is True  # no cross-key contamination
