@@ -1,0 +1,76 @@
+"""`python -m jepsen_tpu` — the framework's own CLI.
+
+Ships a self-contained demo suite over the in-process sim cluster (so the
+zero-to-aha path needs no real nodes), plus `serve` and `analyze`
+(SURVEY.md §2.1 L7).  A real db suite builds its own CLI with
+`jepsen_tpu.cli.single_test_cmd`.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+from . import cli
+from .generator import core as g
+
+
+def _wl(name: str, opts: Dict[str, Any]):
+    from .workloads import (append, bank, linearizable_register, long_fork,
+                            queue, sets, wr)
+    from .workloads.mem import MemClient, MemStore
+
+    rng = random.Random(opts.get("seed"))
+    if name == "append":
+        return append.workload(rng=rng), MemClient()
+    if name == "wr":
+        return wr.workload(rng=rng), MemClient(txn_kind="rw-register")
+    if name == "lin-register":
+        return (linearizable_register.workload(rng=rng), MemClient())
+    if name == "bank":
+        wl = bank.workload(rng=rng)
+        s = MemStore()
+        s.accounts = dict(wl["accounts"])
+        return wl, MemClient(s)
+    if name == "long-fork":
+        return long_fork.workload(rng=rng), MemClient(txn_kind="rw-register")
+    if name == "set":
+        return sets.workload(rng=rng), MemClient()
+    if name == "queue":
+        return queue.workload(rng=rng), MemClient()
+    raise ValueError(f"unknown workload {name!r}")
+
+
+def _demo_test(name: str):
+    def test_fn(opts: Dict[str, Any]) -> Dict[str, Any]:
+        wl, client = _wl(name, opts)
+        nodes = opts.get("nodes") or ["n1", "n2", "n3"]
+        # re-derive concurrency from the raw spec against the *defaulted*
+        # node list, so "1n" with no -n flags means 3 workers, not 1
+        spec = opts.get("concurrency-spec")
+        concurrency = (cli.parse_concurrency(spec, len(nodes)) if spec
+                       else opts.get("concurrency") or 5)
+        t = dict(opts)
+        t.update({
+            "name": f"demo-{name}",
+            "nodes": nodes,
+            "concurrency": concurrency,
+            "client": client,
+            **{k: v for k, v in wl.items()
+               if k not in ("generator", "checker", "final-generator")},
+            "generator": g.clients(wl["generator"]),
+            "checker": wl["checker"],
+        })
+        if "final-generator" in wl:
+            t["final-generator"] = wl["final-generator"]
+        return t
+
+    return test_fn
+
+
+DEMOS = {n: _demo_test(n) for n in
+         ("append", "wr", "lin-register", "bank", "long-fork", "set",
+          "queue")}
+
+if __name__ == "__main__":
+    cli.main(cli.test_all_cmd(DEMOS, prog="python -m jepsen_tpu"))

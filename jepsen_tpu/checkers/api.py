@@ -85,6 +85,22 @@ def compose(checkers: Dict[str, Checker]) -> Checker:
     return Compose(checkers)
 
 
+def output_path(test: dict, opts: Optional[dict], filename: str) -> str:
+    """Resolve (and create) the artifact path for a checker's output file
+    in the store dir, honoring opts["subdirectory"] (reference checkers'
+    :subdirectory opt).  Shared by perf/timeline/clock."""
+    import os
+
+    from .. import store
+
+    d = store.test_dir(test)
+    sub = (opts or {}).get("subdirectory")
+    if sub:
+        d = os.path.join(d, str(sub))
+        os.makedirs(d, exist_ok=True)
+    return os.path.join(d, filename)
+
+
 class NoopChecker(Checker):
     def check(self, test, history, opts=None):
         return {"valid?": True}

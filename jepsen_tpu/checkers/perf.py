@@ -1,0 +1,184 @@
+"""Performance graphs: latency and rate over time.
+
+Equivalent of the reference's `jepsen/src/jepsen/checker/perf.clj`
+(SURVEY.md §2.1): extracts latency/rate point series from the history with
+vectorised folds (numpy — the same SoA shape the device folds use) and
+renders PNGs with matplotlib (replacing the reference's external gnuplot,
+§2.5 #8), with nemesis activity windows shaded behind the series.
+
+Checkers: :class:`LatencyGraph`, :class:`RateGraph`, and :func:`perf`
+composing both — always valid; their value is the artifacts written into
+the store directory.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..history.ops import FAIL, INFO, INVOKE, OK
+from .api import Checker, output_path as _output_path_shared
+
+logger = logging.getLogger("jepsen.checker.perf")
+
+_TYPE_COLOR = {OK: "#81F749", FAIL: "#E9A4A0", INFO: "#FFAA26"}
+_NS = 1e9
+
+
+def latency_points(history) -> Dict[str, np.ndarray]:
+    """Per completed client op: invoke time (s), latency (ms), completion
+    type code, and an interned :f id.  One pass, SoA output."""
+    t_inv: List[float] = []
+    lat: List[float] = []
+    typ: List[str] = []
+    fs: List[Any] = []
+    for op in history:
+        if not op.is_client_op() or op.type == INVOKE:
+            continue
+        inv = history.invocation(op) if hasattr(history, "invocation") else None
+        if inv is None:
+            continue
+        t_inv.append(inv.time / _NS)
+        lat.append(max(op.time - inv.time, 0) / 1e6)
+        typ.append(op.type)
+        fs.append(op.f)
+    return {"time": np.asarray(t_inv), "latency_ms": np.asarray(lat),
+            "type": np.asarray(typ, dtype=object),
+            "f": np.asarray(fs, dtype=object)}
+
+
+def rate_points(history, dt: float = 1.0) -> Dict[Tuple[Any, str], Tuple[np.ndarray, np.ndarray]]:
+    """Ops/sec per (f, completion-type), bucketed into dt-second windows."""
+    pts = latency_points(history)
+    out: Dict[Tuple[Any, str], Tuple[np.ndarray, np.ndarray]] = {}
+    if len(pts["time"]) == 0:
+        return out
+    t_end = float(pts["time"].max()) + dt
+    edges = np.arange(0.0, t_end + dt, dt)
+    for f in sorted(set(pts["f"]), key=repr):
+        for typ in (OK, FAIL, INFO):
+            sel = (pts["f"] == f) & (pts["type"] == typ)
+            if not sel.any():
+                continue
+            counts, _ = np.histogram(pts["time"][sel], bins=edges)
+            out[(f, typ)] = (edges[:-1], counts / dt)
+    return out
+
+
+def nemesis_intervals(history) -> List[Tuple[float, float, Any]]:
+    """(start, end, f) windows of nemesis activity, for plot shading
+    (reference `util/nemesis-intervals` + perf's shaded regions)."""
+    out = []
+    open_at: Optional[float] = None
+    open_f = None
+    for op in history:
+        if op.process != "nemesis" or op.type == INVOKE:
+            # windows open/close on completions, when the fault has
+            # actually taken effect
+            continue
+        f = str(op.f or "")
+        is_start = f.startswith("start") or f in ("partition", "kill", "pause")
+        is_stop = f.startswith("stop") or f.startswith("heal") \
+            or f in ("resume", "restart")
+        t = op.time / _NS
+        if is_start and open_at is None:
+            open_at, open_f = t, op.f
+        elif is_stop and open_at is not None:
+            out.append((open_at, t, open_f))
+            open_at, open_f = None, None
+    if open_at is not None:
+        last = history[len(history) - 1].time / _NS if len(history) else open_at
+        out.append((open_at, last, open_f))
+    return out
+
+
+_output_path = _output_path_shared
+
+
+def _shade(ax, history):
+    for (t0, t1, f) in nemesis_intervals(history):
+        ax.axvspan(t0, t1, color="#FF8B8B", alpha=0.2, lw=0)
+
+
+class LatencyGraph(Checker):
+    """Scatter of op latencies over time, colored by completion type,
+    one marker style per :f; nemesis windows shaded (reference
+    `latency-graph`, rendered with matplotlib instead of gnuplot)."""
+
+    def __init__(self, filename: str = "latency-raw.png"):
+        self.filename = filename
+
+    def check(self, test, history, opts=None):
+        pts = latency_points(history)
+        if len(pts["time"]) == 0:
+            return {"valid?": True, "points": 0}
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(10, 5))
+        _shade(ax, history)
+        markers = "ox+sd^v*"
+        for i, f in enumerate(sorted(set(pts["f"]), key=repr)):
+            for typ in (OK, FAIL, INFO):
+                sel = (pts["f"] == f) & (pts["type"] == typ)
+                if not sel.any():
+                    continue
+                ax.scatter(pts["time"][sel], pts["latency_ms"][sel],
+                           s=8, marker=markers[i % len(markers)],
+                           c=_TYPE_COLOR[typ], label=f"{f} {typ}",
+                           alpha=0.7, linewidths=0.5, edgecolors="none")
+        ax.set_yscale("log")
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("latency (ms)")
+        ax.set_title(test.get("name", "test"))
+        ax.legend(fontsize=6, loc="upper right", ncol=2)
+        path = _output_path(test, opts, self.filename)
+        fig.savefig(path, dpi=110)
+        plt.close(fig)
+        return {"valid?": True, "points": int(len(pts["time"])),
+                "file": path}
+
+
+class RateGraph(Checker):
+    """Throughput (ops/sec per :f × outcome) over time (reference
+    `rate-graph`)."""
+
+    def __init__(self, filename: str = "rate.png", dt: float = 1.0):
+        self.filename = filename
+        self.dt = dt
+
+    def check(self, test, history, opts=None):
+        series = rate_points(history, self.dt)
+        if not series:
+            return {"valid?": True, "points": 0}
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(10, 5))
+        _shade(ax, history)
+        for (f, typ), (t, rate) in sorted(series.items(),
+                                          key=lambda kv: repr(kv[0])):
+            ax.plot(t, rate, drawstyle="steps-post",
+                    color=_TYPE_COLOR[typ], alpha=0.8, lw=1.2,
+                    label=f"{f} {typ}")
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("ops / s")
+        ax.set_title(test.get("name", "test"))
+        ax.legend(fontsize=6, loc="upper right", ncol=2)
+        path = _output_path(test, opts, self.filename)
+        fig.savefig(path, dpi=110)
+        plt.close(fig)
+        return {"valid?": True, "points": sum(len(t) for t, _ in
+                                              series.values()),
+                "file": path}
+
+
+def perf() -> Checker:
+    """Both graphs (reference `checker/perf`)."""
+    from .api import compose
+    return compose({"latency-graph": LatencyGraph(),
+                    "rate-graph": RateGraph()})

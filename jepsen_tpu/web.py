@@ -1,0 +1,180 @@
+"""Web UI for browsing the test store.
+
+Equivalent of the reference's `jepsen/src/jepsen/web.clj` (SURVEY.md §2.1,
+§3.5): a small threaded HTTP server over the store directory — a run table
+(name, timestamp, verdict), per-run file browsing, and zip download of a
+whole run.  Stdlib-only (http.server), replacing the reference's http-kit.
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import logging
+import os
+import threading
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import quote, unquote, urlparse
+
+from . import store
+
+logger = logging.getLogger("jepsen.web")
+
+
+def _run_summary(d: str) -> Dict[str, Any]:
+    """Cheap summary of one run dir: verdict comes from results.json (fast
+    path) or the .jepsen results block."""
+    out: Dict[str, Any] = {
+        "dir": d,
+        "name": os.path.basename(os.path.dirname(d)),
+        "timestamp": os.path.basename(d),
+        "valid?": "?",
+    }
+    rj = os.path.join(d, "results.json")
+    try:
+        if os.path.exists(rj):
+            with open(rj) as f:
+                out["valid?"] = json.load(f).get("valid?", "?")
+        else:
+            res = store.load(d).get("results")
+            if res:
+                out["valid?"] = res.get("valid?", "?")
+    except Exception:  # noqa: BLE001 — a corrupt run still gets listed
+        out["valid?"] = "corrupt"
+    return out
+
+
+def _verdict_cell(v: Any) -> str:
+    color = {"True": "#9ce29c", "False": "#f2a3a3",
+             "unknown": "#ffd37a"}.get(str(v), "#ddd")
+    return f'<td style="background:{color};text-align:center">{html.escape(str(v))}</td>'
+
+
+class _Handler(BaseHTTPRequestHandler):
+    base: str = store.BASE  # overridden per-server
+
+    # -- helpers ----------------------------------------------------------
+
+    def _send(self, code: int, content: bytes,
+              ctype: str = "text/html; charset=utf-8",
+              extra: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(content)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(content)
+
+    def _safe_path(self, rel: str) -> Optional[str]:
+        """Resolve a store-relative path, refusing traversal outside it."""
+        base = os.path.realpath(self.base)
+        p = os.path.realpath(os.path.join(base, rel))
+        if p == base or p.startswith(base + os.sep):
+            return p
+        return None
+
+    # -- routes -----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        try:
+            path = unquote(urlparse(self.path).path)
+            if path in ("/", "/index.html"):
+                return self._index()
+            if path.startswith("/files/"):
+                return self._files(path[len("/files/"):])
+            if path.startswith("/zip/"):
+                return self._zip(path[len("/zip/"):])
+            self._send(404, b"not found", "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.exception("web handler error")
+            self._send(500, f"error: {e}".encode(), "text/plain")
+
+    def _index(self):
+        rows = []
+        for d in store.tests(base=self.base):
+            s = _run_summary(d)
+            rel = os.path.relpath(d, self.base)
+            rows.append(
+                "<tr>"
+                f'<td><a href="/files/{quote(rel)}/">{html.escape(s["name"])}</a></td>'
+                f'<td><a href="/files/{quote(rel)}/">{html.escape(s["timestamp"])}</a></td>'
+                f"{_verdict_cell(s['valid?'])}"
+                f'<td><a href="/zip/{quote(rel)}">zip</a></td>'
+                "</tr>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>jepsen-tpu</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+</style></head><body>
+<h1>jepsen-tpu runs</h1>
+<table><tr><th>test</th><th>time</th><th>valid?</th><th>download</th></tr>
+{"".join(rows)}</table></body></html>"""
+        self._send(200, doc.encode())
+
+    def _files(self, rel: str):
+        p = self._safe_path(rel.rstrip("/"))
+        if p is None or not os.path.exists(p):
+            return self._send(404, b"not found", "text/plain")
+        if os.path.isdir(p):
+            entries = sorted(os.listdir(p))
+            items = "".join(
+                f'<li><a href="/files/{quote(os.path.join(rel.rstrip("/"), e))}'
+                f'{"/" if os.path.isdir(os.path.join(p, e)) else ""}">'
+                f"{html.escape(e)}</a></li>" for e in entries)
+            doc = (f"<html><body><h2>{html.escape(rel)}</h2>"
+                   f'<p><a href="/">&larr; runs</a></p><ul>{items}</ul>'
+                   f"</body></html>")
+            return self._send(200, doc.encode())
+        ctype = {
+            ".html": "text/html; charset=utf-8",
+            ".json": "application/json",
+            ".png": "image/png",
+            ".svg": "image/svg+xml",
+            ".log": "text/plain; charset=utf-8",
+            ".edn": "text/plain; charset=utf-8",
+        }.get(os.path.splitext(p)[1], "application/octet-stream")
+        with open(p, "rb") as f:
+            self._send(200, f.read(), ctype)
+
+    def _zip(self, rel: str):
+        p = self._safe_path(rel)
+        if p is None or not os.path.isdir(p):
+            return self._send(404, b"not found", "text/plain")
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for root, _dirs, files in os.walk(p):
+                for fn in files:
+                    full = os.path.join(root, fn)
+                    z.write(full, os.path.relpath(full, os.path.dirname(p)))
+        name = rel.replace(os.sep, "-") + ".zip"
+        self._send(200, buf.getvalue(), "application/zip",
+                   {"Content-Disposition": f'attachment; filename="{name}"'})
+
+    def log_message(self, fmt, *args):  # quiet by default
+        logger.debug("web: " + fmt, *args)
+
+
+def serve(port: int = 8080, base: Optional[str] = None, *,
+          background: bool = False) -> ThreadingHTTPServer:
+    """Serve the store dir (reference `web/serve!`).  With background=True,
+    runs in a daemon thread and returns the server (tests use this)."""
+    handler = type("Handler", (_Handler,), {"base": base or store.BASE})
+    srv = ThreadingHTTPServer(("", port), handler)
+    logger.info("serving store %s on port %d", base or store.BASE, port)
+    if background:
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+    return srv

@@ -35,13 +35,45 @@ def gen(**opts) -> Any:
     return _QueueGen(**opts)
 
 
-def drain(n: int = 32) -> Any:
-    """Final drain: keep dequeuing until empty (bounded; a bare map
-    generator emits once, so repeat it)."""
-    return g.clients(g.limit(n, g.repeat({"f": "dequeue", "value": None})))
+def _is_empty_fail(event: dict) -> bool:
+    """Did this completion signal queue-empty?  Only an explicit empty
+    error counts — an aborted/transient failed dequeue must NOT end the
+    drain (items would be falsely reported lost)."""
+    return (event.get("type") == "fail" and event.get("f") == "dequeue"
+            and str(event.get("error", "")).lower() in ("empty", "exhausted"))
 
 
-def workload(*, total: bool = True, drain_ops: int = 64,
+class _Drain(g.Generator):
+    """Dequeue until this thread observes empty.  With no producers left,
+    first-empty implies drained."""
+
+    def __init__(self, inner=None, done: bool = False):
+        self.inner = inner if inner is not None \
+            else g.lift(g.repeat({"f": "dequeue", "value": None}))
+        self.done = done
+
+    def op(self, test, ctx):
+        if self.done:
+            return None
+        res = g.next_op(self.inner, test, ctx)
+        if res is None:
+            return None
+        op_, gen2 = res
+        return (op_, _Drain(gen2, False))
+
+    def update(self, test, ctx, event):
+        if _is_empty_fail(event):
+            return _Drain(self.inner, True)
+        return _Drain(g.gen_update(self.inner, test, ctx, event), self.done)
+
+
+def drain(n: int = 10_000) -> Any:
+    """Final drain: every thread dequeues until it sees empty (n is a
+    runaway bound, not the expected drain size)."""
+    return g.clients(g.each_thread(g.limit(n, _Drain())))
+
+
+def workload(*, total: bool = True, drain_ops: int = 10_000,
              rng: Optional[random.Random] = None) -> dict:
     return {
         "generator": gen(rng=rng),

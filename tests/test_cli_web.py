@@ -1,0 +1,193 @@
+"""CLI + web UI tests (reference cli/web layer, SURVEY.md §2.1 L7/§3.5)."""
+
+import json
+import os
+import urllib.request
+import zipfile
+
+import pytest
+
+from jepsen_tpu import cli, core, store, web
+from jepsen_tpu.checkers.api import Stats
+from jepsen_tpu.generator import core as g
+from jepsen_tpu.workloads.mem import MemClient
+
+
+# ---------------------------------------------------------------- cli bits
+
+def test_parse_concurrency():
+    assert cli.parse_concurrency("30", 5) == 30
+    assert cli.parse_concurrency("10n", 5) == 50
+    assert cli.parse_concurrency("3n", 0) == 3
+    with pytest.raises(ValueError):
+        cli.parse_concurrency("x2", 3)
+
+
+def test_parse_nodes(tmp_path):
+    f = tmp_path / "nodes.txt"
+    f.write_text("n4\nn5\n")
+    assert cli.parse_nodes(["n1,n2", "n3"], str(f)) == \
+        ["n1", "n2", "n3", "n4", "n5"]
+    assert cli.parse_nodes(None, None) == []
+
+
+def _test_fn(opts):
+    return {
+        **opts,
+        "name": "cli-test",
+        "nodes": opts.get("nodes") or ["n1"],
+        "concurrency": 2,
+        "client": MemClient(),
+        "generator": g.clients(g.limit(
+            6, lambda t, c: {"f": "read", "value": None})),
+        "checker": Stats(),
+    }
+
+
+def test_cli_run_test(tmp_path, capsys):
+    rc = cli.run(cli.single_test_cmd(_test_fn),
+                 ["--store-dir", str(tmp_path / "s"),
+                  "test", "--time-limit", "10", "--test-count", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "run 1/2" in out and "run 2/2" in out
+    assert "valid? = True" in out
+    assert len(store.tests("cli-test", base=str(tmp_path / "s"))) == 2
+
+
+def test_cli_analyze(tmp_path, capsys):
+    rc = cli.run(cli.single_test_cmd(_test_fn, checker_fn=Stats),
+                 ["--store-dir", str(tmp_path / "s"),
+                  "test", "--time-limit", "5"])
+    assert rc == 0
+    d = store.latest("cli-test", base=str(tmp_path / "s"))
+    rc = cli.run(cli.single_test_cmd(_test_fn, checker_fn=Stats),
+                 ["analyze", d])
+    assert rc == 0
+    assert "valid? = True" in capsys.readouterr().out
+
+
+def test_cli_test_all(tmp_path, capsys):
+    fns = {"a": _test_fn, "b": _test_fn}
+    rc = cli.run(cli.test_all_cmd(fns),
+                 ["--store-dir", str(tmp_path / "s"),
+                  "test-all", "--time-limit", "5"])
+    assert rc == 0
+    assert capsys.readouterr().out.count("valid? = True") == 2
+
+
+def test_cli_demo_suite(tmp_path, capsys):
+    from jepsen_tpu.__main__ import DEMOS
+    rc = cli.run(cli.test_all_cmd(DEMOS),
+                 ["--store-dir", str(tmp_path / "s"),
+                  "test-all", "--only", "bank", "--time-limit", "2"])
+    assert rc == 0
+    assert "demo-bank" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------- web
+
+@pytest.fixture
+def served_store(tmp_path):
+    base = str(tmp_path / "s")
+    t = core.run(_test_fn({"store-dir": base}))
+    srv = web.serve(port=0, base=base, background=True)
+    port = srv.server_address[1]
+    yield base, port, t
+    srv.shutdown()
+    srv.server_close()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_web_index_and_files(served_store):
+    base, port, t = served_store
+    status, ctype, body = _get(port, "/")
+    assert status == 200 and b"cli-test" in body
+    # run dir listing
+    rel = os.path.relpath(store.test_dir(t), base)
+    status, _, body = _get(port, f"/files/{rel}/")
+    assert status == 200 and b"results.json" in body
+    # file fetch
+    status, ctype, body = _get(port, f"/files/{rel}/results.json")
+    assert status == 200 and json.loads(body)["valid?"] is True
+
+
+def test_web_zip_download(served_store, tmp_path):
+    base, port, t = served_store
+    rel = os.path.relpath(store.test_dir(t), base)
+    status, ctype, body = _get(port, f"/zip/{rel}")
+    assert status == 200 and ctype == "application/zip"
+    zp = tmp_path / "run.zip"
+    zp.write_bytes(body)
+    names = zipfile.ZipFile(zp).namelist()
+    assert any(n.endswith("results.json") for n in names)
+
+
+def test_web_traversal_blocked(served_store):
+    base, port, _ = served_store
+    import urllib.error
+    # encoded traversal out of the store dir must 404
+    try:
+        status, _, _ = _get(port, "/files/..%2f..%2fetc%2fpasswd")
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 404
+
+
+# -- review regressions ----------------------------------------------------
+
+def test_cli_extra_opts_reach_test_fn(tmp_path):
+    seen = {}
+
+    def fn(opts):
+        seen.update(opts)
+        return _test_fn(opts)
+
+    rc = cli.run(cli.single_test_cmd(
+        fn, extra_opts=lambda p: p.add_argument("--rate", type=int)),
+        ["--store-dir", str(tmp_path / "s"), "test", "--rate", "7",
+         "--time-limit", "5"])
+    assert rc == 0
+    assert seen.get("rate") == 7
+
+
+def test_cli_analyze_without_checker_clean_error(tmp_path, capsys):
+    cli.run(cli.single_test_cmd(_test_fn),
+            ["--store-dir", str(tmp_path / "s"), "test", "--time-limit", "5"])
+    d = store.latest("cli-test", base=str(tmp_path / "s"))
+    rc = cli.run(cli.single_test_cmd(_test_fn), ["analyze", d])
+    assert rc == 2
+    assert "checker" in capsys.readouterr().err
+
+
+def test_cli_test_all_unknown_name(capsys):
+    rc = cli.run(cli.test_all_cmd({"a": _test_fn}),
+                 ["test-all", "--only", "bogus"])
+    assert rc == 2
+    assert "bogus" in capsys.readouterr().err
+
+
+def test_json_log_formatter_escapes():
+    import logging
+    rec = logging.LogRecord("x", logging.INFO, "f", 1,
+                            'he said "boom"\nline2', (), None)
+    out = cli._JsonFormatter().format(rec)
+    assert json.loads(out)["msg"] == 'he said "boom"\nline2'
+
+
+def test_drain_survives_transient_fails():
+    from jepsen_tpu.workloads.queue import _Drain, _is_empty_fail
+    assert not _is_empty_fail({"type": "fail", "f": "dequeue",
+                               "error": "simulated-abort"})
+    assert _is_empty_fail({"type": "fail", "f": "dequeue", "error": "empty"})
+    d = _Drain()
+    d2 = d.update({}, None, {"type": "fail", "f": "dequeue",
+                             "error": "timeout"})
+    assert not d2.done
+    d3 = d2.update({}, None, {"type": "fail", "f": "dequeue",
+                              "error": "empty"})
+    assert d3.done

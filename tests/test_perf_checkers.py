@@ -1,0 +1,117 @@
+"""Perf/timeline/clock checker tests: synthetic histories -> artifacts
+written, point extraction correct (reference checker_test.clj style)."""
+
+import os
+
+import numpy as np
+
+from jepsen_tpu.checkers import clock, perf, timeline
+from jepsen_tpu.history.ops import History, Op, history, info, invoke, ok
+
+S = 1_000_000_000  # ns
+
+
+def _mk_history():
+    ops = []
+    # two processes, reads at 1s intervals, one nemesis window 2s..4s
+    ops.append(Op(type="invoke", process="nemesis", f="start-partition",
+                  time=2 * S))
+    ops.append(Op(type="info", process="nemesis", f="start-partition",
+                  time=2 * S + S // 10))
+    for i in range(8):
+        t0 = i * S
+        p = i % 2
+        ops.append(Op(type="invoke", process=p, f="read", value=None,
+                      time=t0))
+        typ = "ok" if i % 3 != 2 else "fail"
+        ops.append(Op(type=typ, process=p, f="read", value=i,
+                      time=t0 + 50_000_000))  # 50ms latency
+    ops.append(Op(type="invoke", process="nemesis", f="stop-partition",
+                  time=4 * S))
+    ops.append(Op(type="info", process="nemesis", f="stop-partition",
+                  time=4 * S + S // 10))
+    ops.sort(key=lambda o: o.time)
+    return history(ops)
+
+
+def test_latency_points():
+    pts = perf.latency_points(_mk_history())
+    assert len(pts["time"]) == 8
+    assert np.allclose(pts["latency_ms"], 50.0)
+    assert (pts["type"] == "ok").sum() == 5 + 1  # i=0,1,3,4,6,7 -> 6 oks
+    assert (pts["type"] == "fail").sum() == 2
+
+
+def test_rate_points():
+    series = perf.rate_points(_mk_history(), dt=1.0)
+    t, rate = series[("read", "ok")]
+    assert rate.max() <= 1.0 + 1e-9  # one op per second max
+    assert ("read", "fail") in series
+
+
+def test_nemesis_intervals():
+    iv = perf.nemesis_intervals(_mk_history())
+    assert len(iv) == 1
+    t0, t1, f = iv[0]
+    assert abs(t0 - 2.1) < 0.2 and abs(t1 - 4.1) < 0.2
+
+
+def test_latency_and_rate_graphs_write_files(tmp_path):
+    test = {"name": "perfy", "store-dir": str(tmp_path / "s")}
+    h = _mk_history()
+    r1 = perf.LatencyGraph().check(test, h)
+    r2 = perf.RateGraph().check(test, h)
+    assert r1["valid?"] is True and os.path.exists(r1["file"])
+    assert r2["valid?"] is True and os.path.exists(r2["file"])
+    assert os.path.getsize(r1["file"]) > 1000
+
+
+def test_perf_compose(tmp_path):
+    test = {"name": "perfy2", "store-dir": str(tmp_path / "s")}
+    res = perf.perf().check(test, _mk_history())
+    assert res["valid?"] is True
+
+
+def test_empty_history_graphs():
+    assert perf.LatencyGraph().check({"name": "e"}, history([]))["valid?"] \
+        is True
+    assert perf.RateGraph().check({"name": "e"}, history([]))["valid?"] \
+        is True
+
+
+def test_timeline_html(tmp_path):
+    test = {"name": "tl", "store-dir": str(tmp_path / "s")}
+    res = timeline.Timeline().check(test, _mk_history())
+    assert res["valid?"] is True
+    content = open(res["file"]).read()
+    assert "timeline" in content and "read" in content
+    assert res["op-count"] == 10  # 8 client + 2 nemesis invokes
+
+
+def test_timeline_unpaired_invoke(tmp_path):
+    test = {"name": "tl2", "store-dir": str(tmp_path / "s")}
+    h = history([invoke(0, "read", None)])  # never completes
+    res = timeline.Timeline().check(test, h)
+    assert res["valid?"] is True and res["op-count"] == 1
+
+
+def test_clock_plot(tmp_path):
+    test = {"name": "ck", "store-dir": str(tmp_path / "s")}
+    ops = []
+    for i in range(4):
+        ops.append(Op(type="invoke", process="nemesis",
+                      f="check-clock-offsets", time=i * S))
+        ops.append(Op(type="info", process="nemesis",
+                      f="check-clock-offsets",
+                      value={"n1": float(i * 10), "n2": -5.0},
+                      time=i * S + 1000))
+    res = clock.ClockPlot().check(test, history(ops))
+    assert res["valid?"] is True and res["nodes"] == 2
+    assert os.path.exists(res["file"])
+
+
+def test_clock_series_extraction():
+    ops = [Op(type="info", process="nemesis", f="check-clock-offsets",
+              value={"n1": 5.0, "n2": None}, time=S)]
+    series = clock.offset_series(history(ops))
+    assert series == {"n1": [(1.0, 5.0)]}
