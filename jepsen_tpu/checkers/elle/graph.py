@@ -157,8 +157,17 @@ def tarjan_scc(n: int, src: np.ndarray, dst: np.ndarray) -> np.ndarray:
     """Iterative Tarjan SCC.  Returns component label per node (arbitrary ids).
 
     Host equivalent of bifurcan `Graphs.stronglyConnectedComponents`
-    (SURVEY.md §2.5 #1).  Iterative to survive deep graphs.
+    (SURVEY.md §2.5 #1).  Iterative to survive deep graphs.  Uses the C++
+    native implementation (`jepsen_tpu.native`) when available — the
+    Python body below is the semantic anchor it is differentially tested
+    against (and the fallback when no compiler exists).
     """
+    import os
+    if n and not os.environ.get("JT_NO_NATIVE"):
+        from jepsen_tpu import native
+        comp_native = native.scc(n, src, dst)
+        if comp_native is not None:
+            return comp_native
     adj_dst, starts, ends, _ = _adjacency(n, src, dst)
     UNVISITED = -1
     index = np.full(n, UNVISITED, dtype=np.int64)

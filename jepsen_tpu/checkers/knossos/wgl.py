@@ -140,6 +140,33 @@ def _search_direct(ops: Sequence[LinOp], model: Model,
     return False, {"op-count": n}
 
 
+def _search_native(ops: Sequence[LinOp], memo: Memo, max_configs: int):
+    """C++ WGL (jepsen_tpu.native, SURVEY.md §2.5 #2) when available;
+    returns (NotImplemented, None) to fall back to the Python anchor."""
+    import os
+    if os.environ.get("JT_NO_NATIVE"):
+        return NotImplemented, None
+    from jepsen_tpu import native
+    res = native.wgl(memo.op_sym,
+                     [op.invoke_pos for op in ops],
+                     [op.return_pos for op in ops],
+                     NEVER, memo.table, memo.init_state, max_configs)
+    if res is None:
+        return NotImplemented, None
+    ok, explored = res
+    if ok is None:
+        return None, {"reason": "config budget exhausted",
+                      "explored": explored}
+    if ok is False:
+        # Re-run the Python search for the final-info diagnostics
+        # (max-linearized, witness configs) when cheap; keep the summary
+        # shape when the config space is too big to redo.
+        if explored <= 200_000:
+            return _search_memo(ops, memo, max_configs)
+        return False, {"op-count": len(ops), "explored": explored}
+    return True, None
+
+
 def check(history: History | Sequence[LinOp], model: Model,
           max_configs: int = 5_000_000) -> Dict[str, Any]:
     """Check linearizability of a single-object history against a model."""
@@ -148,7 +175,9 @@ def check(history: History | Sequence[LinOp], model: Model,
         return {"valid?": "unknown", "op-count": 0}
     try:
         memo = memoize(model, ops)
-        ok, info = _search_memo(ops, memo, max_configs)
+        ok, info = _search_native(ops, memo, max_configs)
+        if ok is NotImplemented:
+            ok, info = _search_memo(ops, memo, max_configs)
     except StateExplosion:
         ok, info = _search_direct(ops, model, max_configs)
     if ok is None:

@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..history.ops import FAIL, INFO, INVOKE, OK
-from .api import Checker, output_path as _output_path_shared
+from .api import Checker, output_path as _output_path
 
 logger = logging.getLogger("jepsen.checker.perf")
 
@@ -67,38 +67,71 @@ def rate_points(history, dt: float = 1.0) -> Dict[Tuple[Any, str], Tuple[np.ndar
     return out
 
 
-def nemesis_intervals(history) -> List[Tuple[float, float, Any]]:
+_DEFAULT_STARTS = frozenset({"partition", "kill", "pause", "bump-clock",
+                             "strobe-clock"})
+_DEFAULT_STOPS = frozenset({"resume", "restart", "reset-clock", "start"})
+
+
+def _perf_specs(test: Optional[dict]) -> List[Tuple[frozenset, frozenset]]:
+    """(start-fs, stop-fs) pairs.  Prefers the nemesis packages' exact perf
+    metadata on the test map (`nemesis/combined.py` exports
+    {"perf": {"start": {...}, "stop": {...}}}); falls back to name
+    heuristics.  Note the kill package's *recovery* op is f="start", which
+    is why metadata beats heuristics."""
+    t = test or {}
+    metas = list((t.get("plot") or {}).get("nemeses") or ())
+    for pkg in t.get("nemesis-packages", ()) or ():
+        if (pkg or {}).get("perf"):
+            metas.append(pkg["perf"])
+    specs = []
+    for perf_meta in metas:
+        if perf_meta.get("start") or perf_meta.get("stop"):
+            specs.append((frozenset(perf_meta.get("start", ())),
+                          frozenset(perf_meta.get("stop", ()))))
+    if not specs:
+        specs.append((_DEFAULT_STARTS, _DEFAULT_STOPS))
+    return specs
+
+
+def nemesis_intervals(history, test: Optional[dict] = None
+                      ) -> List[Tuple[float, float, Any]]:
     """(start, end, f) windows of nemesis activity, for plot shading
-    (reference `util/nemesis-intervals` + perf's shaded regions)."""
+    (reference `util/nemesis-intervals` + perf's shaded regions).  Windows
+    open/close on completions, when the fault has actually taken effect."""
     out = []
-    open_at: Optional[float] = None
-    open_f = None
+    specs = _perf_specs(test)
+    open_at: List[Optional[float]] = [None] * len(specs)
+    open_f: List[Any] = [None] * len(specs)
+    last_t = 0.0
     for op in history:
         if op.process != "nemesis" or op.type == INVOKE:
-            # windows open/close on completions, when the fault has
-            # actually taken effect
             continue
         f = str(op.f or "")
-        is_start = f.startswith("start") or f in ("partition", "kill", "pause")
-        is_stop = f.startswith("stop") or f.startswith("heal") \
-            or f in ("resume", "restart")
         t = op.time / _NS
-        if is_start and open_at is None:
-            open_at, open_f = t, op.f
-        elif is_stop and open_at is not None:
-            out.append((open_at, t, open_f))
-            open_at, open_f = None, None
-    if open_at is not None:
-        last = history[len(history) - 1].time / _NS if len(history) else open_at
-        out.append((open_at, last, open_f))
-    return out
+        last_t = max(last_t, t)
+        for si, (starts, stops) in enumerate(specs):
+            generic = starts is _DEFAULT_STARTS
+            is_start = f in starts or (generic and f.startswith("start"))
+            is_stop = f in stops or (generic and (f.startswith("stop")
+                                                  or f.startswith("heal")))
+            # metadata start/stop sets can overlap name-wise with other
+            # packages; exact membership wins over the generic heuristic
+            if is_start and not (generic and is_stop) \
+                    and open_at[si] is None:
+                open_at[si], open_f[si] = t, op.f
+            elif is_stop and open_at[si] is not None:
+                out.append((open_at[si], t, open_f[si]))
+                open_at[si], open_f[si] = None, None
+    for si in range(len(specs)):
+        if open_at[si] is not None:
+            end = (history[len(history) - 1].time / _NS
+                   if len(history) else open_at[si])
+            out.append((open_at[si], end, open_f[si]))
+    return sorted(out)
 
 
-_output_path = _output_path_shared
-
-
-def _shade(ax, history):
-    for (t0, t1, f) in nemesis_intervals(history):
+def _shade(ax, history, test: Optional[dict] = None):
+    for (t0, t1, f) in nemesis_intervals(history, test):
         ax.axvspan(t0, t1, color="#FF8B8B", alpha=0.2, lw=0)
 
 
@@ -119,7 +152,7 @@ class LatencyGraph(Checker):
         import matplotlib.pyplot as plt
 
         fig, ax = plt.subplots(figsize=(10, 5))
-        _shade(ax, history)
+        _shade(ax, history, test)
         markers = "ox+sd^v*"
         for i, f in enumerate(sorted(set(pts["f"]), key=repr)):
             for typ in (OK, FAIL, INFO):
@@ -159,7 +192,7 @@ class RateGraph(Checker):
         import matplotlib.pyplot as plt
 
         fig, ax = plt.subplots(figsize=(10, 5))
-        _shade(ax, history)
+        _shade(ax, history, test)
         for (f, typ), (t, rate) in sorted(series.items(),
                                           key=lambda kv: repr(kv[0])):
             ax.plot(t, rate, drawstyle="steps-post",

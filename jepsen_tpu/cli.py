@@ -137,7 +137,7 @@ def analyze_cmd(opts: argparse.Namespace,
     chk = checker_fn() if checker_fn else None
     try:
         t = core.analyze(opts.dir, checker=chk)
-    except ValueError as e:
+    except (ValueError, FileNotFoundError) as e:
         print(f"analyze: {e}", file=sys.stderr)
         return 2
     valid = t.get("results", {}).get("valid?")

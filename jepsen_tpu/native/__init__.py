@@ -1,0 +1,175 @@
+"""Native C++ host oracles, loaded via ctypes.
+
+TPU-native equivalents of the reference's JVM-native pieces (SURVEY.md
+§2.5 #1/#2): Tarjan SCC (bifurcan's `Graphs.stronglyConnectedComponents`)
+and the WGL packed-bitset search (Knossos `wgl.clj` + `BitSet` configs),
+compiled from ``src/jepsen_native.cpp`` with g++ on first use (no
+pybind11 in this image — plain C ABI + ctypes, per the environment
+contract).
+
+Degrades gracefully: if no compiler is available or the build fails,
+:func:`available` returns False and callers fall back to the pure-Python
+implementations (`elle.graph.tarjan_scc`, `knossos.wgl`), which remain
+the semantic source of truth (differential tests pin C++ == Python).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("jepsen.native")
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "src", "jepsen_native.cpp")
+_LIB = os.path.join(_DIR, "libjepsen_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    """Compile the shared library if missing/stale.  Returns success."""
+    global _build_failed
+    try:
+        if (os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return True
+        # build into a temp file then atomically replace, so concurrent
+        # processes never load a half-written .so
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+        os.close(fd)
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               "-o", tmp, _SRC]
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=120)
+        if res.returncode != 0:
+            logger.warning("native build failed:\n%s", res.stderr[-2000:])
+            os.unlink(tmp)
+            _build_failed = True
+            return False
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native build unavailable: %s", e)
+        _build_failed = True
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_LIB)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.jt_scc.restype = ctypes.c_int64
+        lib.jt_scc.argtypes = [ctypes.c_int64, i64p, i64p, i64p]
+        lib.jt_bfs_cycle.restype = ctypes.c_int64
+        lib.jt_bfs_cycle.argtypes = [ctypes.c_int64, i64p, i64p, u8p,
+                                     ctypes.c_int64, i64p, ctypes.c_int64]
+        lib.jt_wgl.restype = ctypes.c_int32
+        lib.jt_wgl.argtypes = [ctypes.c_int64, i32p, i64p, i64p,
+                               ctypes.c_int64, i32p, ctypes.c_int64,
+                               ctypes.c_int64, ctypes.c_int32,
+                               ctypes.c_int64, i64p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i64(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def _as(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _csr(n: int, src: np.ndarray, dst: np.ndarray
+         ) -> Tuple[np.ndarray, np.ndarray]:
+    src = _i64(src)
+    dst = _i64(dst)
+    order = np.argsort(src, kind="stable")
+    indices = dst[order]
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(indptr, src + 1, 1)
+    np.cumsum(indptr, out=indptr)
+    return indptr, indices
+
+
+def scc(n: int, src, dst) -> Optional[np.ndarray]:
+    """Component label per node via C++ Tarjan, or None if unavailable.
+    Same contract as `elle.graph.tarjan_scc`."""
+    lib = _load()
+    if lib is None:
+        return None
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    indptr, indices = _csr(n, src, dst)
+    comp = np.empty(n, dtype=np.int64)
+    lib.jt_scc(n, _as(indptr, ctypes.c_int64), _as(indices, ctypes.c_int64),
+               _as(comp, ctypes.c_int64))
+    return comp
+
+
+def bfs_cycle(n: int, src, dst, start: int,
+              mask: Optional[np.ndarray] = None,
+              max_len: int = 4096) -> Optional[np.ndarray]:
+    """Shortest cycle through `start` (node list, closed: path[0] ==
+    path[-1] == start), or None if no cycle / native unavailable."""
+    lib = _load()
+    if lib is None or n == 0:
+        return None
+    indptr, indices = _csr(n, src, dst)
+    out = np.empty(max_len, dtype=np.int64)
+    m = (np.ascontiguousarray(mask, dtype=np.uint8)
+         if mask is not None else None)
+    ln = lib.jt_bfs_cycle(
+        n, _as(indptr, ctypes.c_int64), _as(indices, ctypes.c_int64),
+        _as(m, ctypes.c_uint8) if m is not None else None,
+        start, _as(out, ctypes.c_int64), max_len)
+    if ln <= 0:
+        return None
+    return out[:ln].copy()
+
+
+def wgl(op_sym, invokes, returns, never: int, table: np.ndarray,
+        init_state: int, max_configs: int = 5_000_000
+        ) -> Optional[Tuple[Optional[bool], int]]:
+    """Memoized WGL search.  Returns (verdict, explored) where verdict is
+    True/False/None (budget exhausted), or None if native unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    op_sym = np.ascontiguousarray(op_sym, dtype=np.int32)
+    invokes = _i64(invokes)
+    returns = _i64(returns)
+    table = np.ascontiguousarray(table, dtype=np.int32)
+    n_states, n_syms = table.shape
+    explored = np.zeros(1, dtype=np.int64)
+    rc = lib.jt_wgl(len(op_sym), _as(op_sym, ctypes.c_int32),
+                    _as(invokes, ctypes.c_int64),
+                    _as(returns, ctypes.c_int64), never,
+                    _as(table, ctypes.c_int32), n_states, n_syms,
+                    init_state, max_configs,
+                    _as(explored, ctypes.c_int64))
+    verdict = {1: True, 0: False, -1: None}[int(rc)]
+    return verdict, int(explored[0])

@@ -165,10 +165,15 @@ class JepsenFile:
 
     # -- writing -----------------------------------------------------------
 
+    # Never persisted: credentials would otherwise be readable by anyone
+    # with store access (incl. the web UI's file browser).
+    SECRET_KEYS = ("password", "private_key_path")
+
     def write_test(self, test: dict, history: Optional[History]) -> None:
         """Phase-0 write: partial test + chunked history + root."""
         partial = {
-            k: v for k, v in test.items() if k not in ("history", "results")
+            k: v for k, v in test.items()
+            if k not in ("history", "results") and k not in self.SECRET_KEYS
         }
         with open(self.path, "w+b") as f:
             f.write(MAGIC)

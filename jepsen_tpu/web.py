@@ -162,11 +162,14 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 
 
 def serve(port: int = 8080, base: Optional[str] = None, *,
+          host: str = "127.0.0.1",
           background: bool = False) -> ThreadingHTTPServer:
-    """Serve the store dir (reference `web/serve!`).  With background=True,
-    runs in a daemon thread and returns the server (tests use this)."""
+    """Serve the store dir (reference `web/serve!`).  Binds localhost by
+    default — stored test maps can hold cluster details; pass
+    host="0.0.0.0" explicitly to expose.  With background=True, runs in a
+    daemon thread and returns the server (tests use this)."""
     handler = type("Handler", (_Handler,), {"base": base or store.BASE})
-    srv = ThreadingHTTPServer(("", port), handler)
+    srv = ThreadingHTTPServer((host, port), handler)
     logger.info("serving store %s on port %d", base or store.BASE, port)
     if background:
         threading.Thread(target=srv.serve_forever, daemon=True).start()

@@ -1,0 +1,164 @@
+"""Native C++ oracle tests: differential against the pure-Python anchors
+(SURVEY.md §4's parallel-vs-serial fold pattern, here C++-vs-Python)."""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import native
+from jepsen_tpu.checkers.elle import graph
+from jepsen_tpu.checkers.knossos import wgl
+from jepsen_tpu.checkers.knossos.memo import memoize
+from jepsen_tpu.checkers.knossos.prep import prepare
+from jepsen_tpu.history.ops import history, invoke, ok, info
+from jepsen_tpu.models import cas_register, register
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain")
+
+
+def _relabel(comp):
+    """Canonical relabeling: component id by first occurrence."""
+    out = np.empty_like(comp)
+    seen = {}
+    for i, c in enumerate(comp):
+        out[i] = seen.setdefault(int(c), len(seen))
+    return out
+
+
+def _py_scc(n, src, dst):
+    os.environ["JT_NO_NATIVE"] = "1"
+    try:
+        return graph.tarjan_scc(n, np.asarray(src), np.asarray(dst))
+    finally:
+        del os.environ["JT_NO_NATIVE"]
+
+
+def test_scc_simple_cycle():
+    src = np.array([0, 1, 2, 3])
+    dst = np.array([1, 2, 0, 3])
+    comp = native.scc(4, src, dst)
+    assert comp[0] == comp[1] == comp[2]
+    assert comp[3] != comp[0]
+
+
+def test_scc_differential_random():
+    rng = random.Random(42)
+    for trial in range(25):
+        n = rng.randint(1, 60)
+        m = rng.randint(0, 3 * n)
+        src = np.array([rng.randrange(n) for _ in range(m)], dtype=np.int64)
+        dst = np.array([rng.randrange(n) for _ in range(m)], dtype=np.int64)
+        c_native = native.scc(n, src, dst)
+        c_py = _py_scc(n, src, dst)
+        assert np.array_equal(_relabel(c_native), _relabel(c_py)), \
+            f"trial {trial}: SCC mismatch"
+
+
+def test_scc_big_path_no_recursion_limit():
+    # a 100k-node path + back edge = one giant SCC; must not blow stacks
+    n = 100_000
+    src = np.arange(n, dtype=np.int64)
+    dst = np.roll(src, -1)
+    comp = native.scc(n, src, dst)
+    assert (comp == comp[0]).all()
+
+
+def test_bfs_cycle():
+    # 0->1->2->0 plus a dead-end 2->3
+    src = np.array([0, 1, 2, 2])
+    dst = np.array([1, 2, 0, 3])
+    cyc = native.bfs_cycle(4, src, dst, 0)
+    assert cyc is not None
+    assert cyc[0] == cyc[-1] == 0
+    assert len(cyc) == 4  # 0 1 2 0
+
+
+def test_bfs_cycle_none():
+    src = np.array([0, 1])
+    dst = np.array([1, 2])
+    assert native.bfs_cycle(3, src, dst, 0) is None
+
+
+def test_bfs_cycle_mask_restricts():
+    # two cycles through 0: short via 1, long via 2,3; mask out node 1
+    src = np.array([0, 1, 0, 2, 3])
+    dst = np.array([1, 0, 2, 3, 0])
+    mask = np.array([1, 0, 1, 1], dtype=np.uint8)
+    cyc = native.bfs_cycle(4, src, dst, 0, mask=mask)
+    assert cyc is not None and 1 not in cyc[1:-1]
+    assert len(cyc) == 4  # 0 2 3 0
+
+
+# --------------------------------------------------------------- WGL
+
+def _wgl_both(h, model):
+    """Run native and pure-Python WGL on the same history."""
+    res_native = wgl.check(h, model)
+    os.environ["JT_NO_NATIVE"] = "1"
+    try:
+        res_py = wgl.check(h, model)
+    finally:
+        del os.environ["JT_NO_NATIVE"]
+    return res_native, res_py
+
+
+def test_wgl_valid_register():
+    h = history([
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "read", None), ok(1, "read", 1),
+    ])
+    rn, rp = _wgl_both(h, register())
+    assert rn["valid?"] is True and rp["valid?"] is True
+
+
+def test_wgl_invalid_register():
+    h = history([
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(0, "read", None), ok(0, "read", 2),  # never written
+    ])
+    rn, rp = _wgl_both(h, register())
+    assert rn["valid?"] is False and rp["valid?"] is False
+
+
+def test_wgl_info_op_may_not_linearize():
+    h = history([
+        invoke(0, "write", 1), info(0, "write", 1),  # crashed write
+        invoke(1, "read", None), ok(1, "read", None),  # reads initial
+    ])
+    rn, rp = _wgl_both(h, register())
+    assert rn["valid?"] is True and rp["valid?"] is True
+
+
+def test_wgl_differential_random_histories():
+    rng = random.Random(7)
+    agree = 0
+    for trial in range(30):
+        # random concurrent cas-register history (2-3 procs, 6-10 ops)
+        ops = []
+        vals = [None, 0, 1, 2]
+        state = {p: None for p in range(3)}
+        events = []
+        for p in range(3):
+            for _ in range(rng.randint(1, 3)):
+                kind = rng.choice(["read", "write", "cas"])
+                if kind == "read":
+                    v = rng.choice(vals)
+                elif kind == "write":
+                    v = rng.choice([0, 1, 2])
+                else:
+                    v = [rng.choice([0, 1, 2]), rng.choice([0, 1, 2])]
+                events.append((p, kind, v))
+        rng.shuffle(events)
+        for p, kind, v in events:
+            ops.append(invoke(p, kind, v))
+            typ = rng.choice([ok, ok, ok, info])
+            ops.append(typ(p, kind, v))
+        # interleave completions realistically: keep as alternating pairs
+        h = history(ops)
+        rn, rp = _wgl_both(h, cas_register())
+        assert rn["valid?"] == rp["valid?"], f"trial {trial} diverged"
+        agree += 1
+    assert agree == 30

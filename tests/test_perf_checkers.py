@@ -115,3 +115,19 @@ def test_clock_series_extraction():
               value={"n1": 5.0, "n2": None}, time=S)]
     series = clock.offset_series(history(ops))
     assert series == {"n1": [(1.0, 5.0)]}
+
+
+def test_nemesis_intervals_kill_package_metadata():
+    # the kill package's recovery op is f="start" — metadata must close
+    # the window that the name heuristic would keep open
+    ops = []
+    for (t, f) in [(1, "kill"), (2, "start"), (3, "kill"), (4, "start")]:
+        ops.append(Op(type="invoke", process="nemesis", f=f, time=t * S))
+        ops.append(Op(type="info", process="nemesis", f=f,
+                      time=t * S + 1000))
+    test = {"plot": {"nemeses": [{"name": "kill", "start": {"kill"},
+                                  "stop": {"start"}}]}}
+    iv = perf.nemesis_intervals(history(ops), test)
+    assert len(iv) == 2
+    assert abs(iv[0][0] - 1.0) < 0.1 and abs(iv[0][1] - 2.0) < 0.1
+    assert abs(iv[1][0] - 3.0) < 0.1 and abs(iv[1][1] - 4.0) < 0.1

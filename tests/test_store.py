@@ -215,3 +215,15 @@ def test_listing_skips_current_symlink(tmp_path):
     runs = store.tests(base=base)
     assert len(runs) == 1
     assert "current" not in os.path.relpath(runs[0], base)
+
+
+def test_password_not_persisted(tmp_path):
+    t = {"name": "sec", "store-dir": str(tmp_path / "s"),
+         "password": "s3cret", "private_key_path": "/root/.ssh/id",
+         "username": "admin", "history": _mk_history(2)}
+    store.save_0(t)
+    loaded = store.load(store.test_dir(t))
+    assert "password" not in loaded and "private_key_path" not in loaded
+    assert loaded["username"] == "admin"
+    raw = open(os.path.join(store.test_dir(t), "test.jepsen"), "rb").read()
+    assert b"s3cret" not in raw
