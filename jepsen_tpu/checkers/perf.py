@@ -67,9 +67,12 @@ def rate_points(history, dt: float = 1.0) -> Dict[Tuple[Any, str], Tuple[np.ndar
     return out
 
 
+# Fallback name heuristics for tests without perf metadata.  Note the
+# exact f="start" is a *start* here (the conventional start/stop nemesis);
+# the kill package, whose recovery op is f="start", supplies metadata.
 _DEFAULT_STARTS = frozenset({"partition", "kill", "pause", "bump-clock",
                              "strobe-clock"})
-_DEFAULT_STOPS = frozenset({"resume", "restart", "reset-clock", "start"})
+_DEFAULT_STOPS = frozenset({"resume", "restart", "reset-clock"})
 
 
 def _perf_specs(test: Optional[dict]) -> List[Tuple[frozenset, frozenset]]:
@@ -81,8 +84,11 @@ def _perf_specs(test: Optional[dict]) -> List[Tuple[frozenset, frozenset]]:
     t = test or {}
     metas = list((t.get("plot") or {}).get("nemeses") or ())
     for pkg in t.get("nemesis-packages", ()) or ():
-        if (pkg or {}).get("perf"):
-            metas.append(pkg["perf"])
+        perf_val = (pkg or {}).get("perf")
+        if isinstance(perf_val, list):  # composed package: list of metas
+            metas.extend(m for m in perf_val if m)
+        elif perf_val:
+            metas.append(perf_val)
     specs = []
     for perf_meta in metas:
         if perf_meta.get("start") or perf_meta.get("stop"):
@@ -102,22 +108,17 @@ def nemesis_intervals(history, test: Optional[dict] = None
     specs = _perf_specs(test)
     open_at: List[Optional[float]] = [None] * len(specs)
     open_f: List[Any] = [None] * len(specs)
-    last_t = 0.0
     for op in history:
         if op.process != "nemesis" or op.type == INVOKE:
             continue
         f = str(op.f or "")
         t = op.time / _NS
-        last_t = max(last_t, t)
         for si, (starts, stops) in enumerate(specs):
             generic = starts is _DEFAULT_STARTS
             is_start = f in starts or (generic and f.startswith("start"))
             is_stop = f in stops or (generic and (f.startswith("stop")
                                                   or f.startswith("heal")))
-            # metadata start/stop sets can overlap name-wise with other
-            # packages; exact membership wins over the generic heuristic
-            if is_start and not (generic and is_stop) \
-                    and open_at[si] is None:
+            if is_start and open_at[si] is None:
                 open_at[si], open_f[si] = t, op.f
             elif is_stop and open_at[si] is not None:
                 out.append((open_at[si], t, open_f[si]))

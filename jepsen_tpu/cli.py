@@ -127,7 +127,8 @@ def run_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
 
 def serve_cmd(opts: argparse.Namespace) -> int:
     from . import web
-    web.serve(port=opts.port, base=opts.store_dir)
+    web.serve(port=opts.port, base=opts.store_dir,
+              host=getattr(opts, "host", "127.0.0.1"))
     return 0
 
 
@@ -160,6 +161,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
 
     ps = sub.add_parser("serve", help="serve the store web UI")
     ps.add_argument("-p", "--port", type=int, default=8080)
+    ps.add_argument("--host", default="127.0.0.1",
+                    help='bind address (use "0.0.0.0" to expose)')
 
     pa = sub.add_parser("analyze", help="re-check a stored run")
     pa.add_argument("dir", help="store run directory")

@@ -65,7 +65,7 @@ def _build() -> bool:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib
+    global _lib, _build_failed
     if _lib is not None:
         return _lib
     with _lock:
@@ -73,7 +73,23 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if not _build():
             return None
-        lib = ctypes.CDLL(_LIB)
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            # stale/corrupt/wrong-arch .so: force one rebuild, then give up
+            logger.warning("native lib unloadable; rebuilding")
+            try:
+                os.unlink(_LIB)
+            except OSError:
+                pass
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError as e:
+                logger.warning("native lib still unloadable: %s", e)
+                _build_failed = True
+                return None
         i64p = ctypes.POINTER(ctypes.c_int64)
         i32p = ctypes.POINTER(ctypes.c_int32)
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -139,16 +155,22 @@ def bfs_cycle(n: int, src, dst, start: int,
     if lib is None or n == 0:
         return None
     indptr, indices = _csr(n, src, dst)
-    out = np.empty(max_len, dtype=np.int64)
     m = (np.ascontiguousarray(mask, dtype=np.uint8)
          if mask is not None else None)
-    ln = lib.jt_bfs_cycle(
-        n, _as(indptr, ctypes.c_int64), _as(indices, ctypes.c_int64),
-        _as(m, ctypes.c_uint8) if m is not None else None,
-        start, _as(out, ctypes.c_int64), max_len)
-    if ln <= 0:
-        return None
-    return out[:ln].copy()
+    while True:
+        out = np.empty(max_len, dtype=np.int64)
+        ln = lib.jt_bfs_cycle(
+            n, _as(indptr, ctypes.c_int64), _as(indices, ctypes.c_int64),
+            _as(m, ctypes.c_uint8) if m is not None else None,
+            start, _as(out, ctypes.c_int64), max_len)
+        if ln == -1:  # buffer too small; a cycle is at most n+1 nodes
+            if max_len > n:
+                return None  # can't happen, but never loop forever
+            max_len = n + 1
+            continue
+        if ln <= 0:
+            return None
+        return out[:ln].copy()
 
 
 def wgl(op_sym, invokes, returns, never: int, table: np.ndarray,

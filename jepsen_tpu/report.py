@@ -1,0 +1,50 @@
+"""Text rendering of test results.
+
+Equivalent of the reference's `jepsen/src/jepsen/report.clj` (SURVEY.md
+§2.1): a compact human-readable summary of a completed test's results
+map, for terminals and logs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+def _fmt(v: Any, indent: int = 0, depth: int = 0) -> List[str]:
+    pad = "  " * indent
+    if isinstance(v, dict):
+        lines = []
+        for k in sorted(v, key=str):
+            val = v[k]
+            if isinstance(val, (dict, list)) and val and depth < 4:
+                lines.append(f"{pad}{k}:")
+                lines.extend(_fmt(val, indent + 1, depth + 1))
+            else:
+                sval = repr(val)
+                if len(sval) > 120:
+                    sval = sval[:117] + "..."
+                lines.append(f"{pad}{k}: {sval}")
+        return lines
+    if isinstance(v, list):
+        if len(v) > 8:
+            shown = v[:8]
+            rest = f"{pad}... ({len(v) - 8} more)"
+            return [x for item in shown for x in _fmt(item, indent, depth + 1)] + [rest]
+        return [x for item in v for x in _fmt(item, indent, depth + 1)]
+    return [f"{pad}{v!r}"]
+
+
+def render(test: dict) -> str:
+    """Render a completed test's verdict + results (reference's textual
+    report)."""
+    results = test.get("results", {}) or {}
+    valid = results.get("valid?")
+    mark = {True: "✓", False: "✗"}.get(valid, "?")
+    header = (f"{mark} {test.get('name', 'test')} — valid? = {valid}"
+              f" ({len(test.get('history') or [])} ops)")
+    body = _fmt({k: v for k, v in results.items() if k != "valid?"})
+    return "\n".join([header] + body)
+
+
+def print_report(test: dict) -> None:
+    print(render(test))

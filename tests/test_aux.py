@@ -1,0 +1,185 @@
+"""Aux subsystem tests: role, lazyfs, faketime, fs_cache, report, repl
+(SURVEY.md §2.1 aux rows), driven through the sim control plane."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import (control, core, db, faketime, fs_cache, lazyfs, repl,
+                        report, role, store)
+from jepsen_tpu.checkers.api import Stats
+from jepsen_tpu.control.sim import SimRemote
+from jepsen_tpu.generator import core as g
+from jepsen_tpu.workloads.mem import MemClient
+
+
+# ---------------------------------------------------------------- role
+
+class TrackDB(db.DB):
+    def __init__(self, name):
+        self.name = name
+        self.calls = []
+
+    def setup(self, test, node):
+        self.calls.append(("setup", node, tuple(test["nodes"])))
+
+    def teardown(self, test, node):
+        self.calls.append(("teardown", node, tuple(test["nodes"])))
+
+
+def test_role_of_and_nodes():
+    t = {"roles": {"shard-a": ["n1", "n2"], "coord": ["n3"]}}
+    assert role.role_of(t, "n1") == "shard-a"
+    assert role.role_of(t, "n3") == "coord"
+    assert role.role_of(t, "nx") is None
+    assert role.nodes_of(t, "shard-a") == ["n1", "n2"]
+
+
+def test_role_db_dispatch(tmp_path):
+    shard_db = TrackDB("shard")
+    coord_db = TrackDB("coord")
+    rdb = role.RoleDB({"shard-a": shard_db, "coord": coord_db})
+    remote = SimRemote()
+    for n in ("n1", "n2", "n3"):
+        remote.node(n).respond("*", "")
+    t = {
+        "name": "role-test", "nodes": ["n1", "n2", "n3"],
+        "roles": {"shard-a": ["n1", "n2"], "coord": ["n3"]},
+        "remote": remote, "db": rdb, "client": MemClient(),
+        "concurrency": 2, "store-dir": str(tmp_path / "s"),
+        "generator": g.clients(g.limit(
+            4, lambda t, c: {"f": "read", "value": None})),
+        "checker": Stats(),
+    }
+    done = core.run(t)
+    assert done["results"]["valid?"] is True
+    # each role db saw only its own nodes, with a restricted node view
+    assert {c[1] for c in shard_db.calls} == {"n1", "n2"}
+    assert all(c[2] == ("n1", "n2") for c in shard_db.calls)
+    assert {c[1] for c in coord_db.calls} == {"n3"}
+    assert all(c[2] == ("n3",) for c in coord_db.calls)
+
+
+def test_role_nemesis_scoped():
+    from jepsen_tpu.nemesis.core import Nemesis
+
+    seen = {}
+
+    class Grab(Nemesis):
+        def invoke(self, test, op):
+            seen["nodes"] = list(test["nodes"])
+            return dict(op, type="info")
+
+    rn = role.RoleNemesis("coord", Grab())
+    t = {"nodes": ["n1", "n2", "n3"],
+         "roles": {"shard-a": ["n1", "n2"], "coord": ["n3"]}}
+    rn = rn.setup(t)
+    rn.invoke(t, {"f": "kill", "type": "invoke"})
+    assert seen["nodes"] == ["n3"]
+
+
+# ---------------------------------------------------------------- lazyfs
+
+def test_lazyfs_mount_commands():
+    remote = SimRemote()
+    node = remote.node("n1")
+    node.respond("*", "")
+    fs = lazyfs.LazyFS(dir="/var/lib/db")
+    assert fs.data_dir == "/var/lib/db.data"
+    assert fs.fifo == "/var/lib/db.fifo"
+    with control.with_session("n1", remote.connect("n1")):
+        lazyfs.mount(fs)
+        lazyfs.lose_unfsynced_writes(fs)
+        lazyfs.checkpoint(fs)
+        lazyfs.umount(fs)
+    cmds = node.cmds()
+    assert any("lazyfs" in c and "/var/lib/db" in c for c in cmds)
+    assert any("clear-cache" in c for c in cmds)
+    assert any("cache-checkpoint" in c for c in cmds)
+    assert any("fusermount" in c for c in cmds)
+
+
+def test_lazyfs_db_wrapper_forwards_facets():
+    inner = TrackDB("inner")
+    wrapped = lazyfs.DB(inner, lazyfs.LazyFS(dir="/d"))
+    assert wrapped.name == "inner"  # __getattr__ forwarding
+
+
+# ---------------------------------------------------------------- faketime
+
+def test_faketime_spec_and_wrap():
+    assert faketime.faketime_spec(5, 2.0) == "+5s x2"
+    assert faketime.faketime_spec(-3.5, 0.5) == "-3.5s x0.5"
+    remote = SimRemote()
+    node = remote.node("n1")
+    node.respond("test -e /usr/lib/x86_64-linux-gnu/faketime/*", "")
+    node.respond("*", "")
+    with control.with_session("n1", remote.connect("n1")):
+        cmd = faketime.wrap_cmd(["etcd", "--flag"], offset_s=10, rate=5)
+    joined = control.core.join_cmd(cmd)
+    assert "LD_PRELOAD=" in joined and "FAKETIME=" in joined
+    assert joined.endswith("etcd --flag")
+
+
+def test_faketime_rand_factor_bounds():
+    import random
+    for _ in range(50):
+        f = faketime.rand_factor(random.Random(), max_skew=5.0)
+        assert 1 / 5.0 <= f <= 5.0
+
+
+# ---------------------------------------------------------------- fs_cache
+
+def test_fs_cache_save_and_deploy(tmp_path, monkeypatch):
+    monkeypatch.setattr(fs_cache, "CACHE_DIR", str(tmp_path / "cache"))
+    src = tmp_path / "artifact.tar"
+    src.write_bytes(b"dbdata")
+    p = fs_cache.save("etcd-v3.5", str(src))
+    assert fs_cache.cached("etcd-v3.5") == p
+    assert fs_cache.cached("nope") is None
+
+    remote = SimRemote()
+    node = remote.node("n1")
+    node.respond("*", "")
+    with control.with_session("n1", remote.connect("n1")):
+        fs_cache.deploy_remote("etcd-v3.5", "/opt/db/etcd.tar", mode="755")
+    cmds = node.cmds()
+    assert any("mkdir" in c for c in cmds)
+    assert any("chmod 755" in c for c in cmds)
+    assert ("/opt/db/etcd.tar", p) in [(d, s) for (s, d) in node.uploads] \
+        or node.uploads  # upload recorded
+
+
+def test_fs_cache_deploy_uncached_raises():
+    with pytest.raises(FileNotFoundError):
+        fs_cache.deploy_remote("never-cached", "/tmp/x")
+
+
+# ---------------------------------------------------------------- report/repl
+
+def test_report_render():
+    t = {"name": "demo", "history": [1, 2, 3],
+         "results": {"valid?": False, "anomaly-types": ["G1c"],
+                     "count": 3}}
+    out = report.render(t)
+    assert "✗ demo" in out and "G1c" in out and "count: 3" in out
+    t["results"]["valid?"] = True
+    assert "✓" in report.render(t)
+
+
+def test_repl_roundtrip(tmp_path):
+    base = str(tmp_path / "s")
+    t = core.run({
+        "name": "repl-test", "client": MemClient(), "concurrency": 2,
+        "nodes": ["n1"], "store-dir": base,
+        "generator": g.clients(g.limit(
+            4, lambda t, c: {"f": "read", "value": None})),
+        "checker": Stats(),
+    })
+    loaded = repl.latest("repl-test", base=base)
+    assert loaded["name"] == "repl-test"
+    h = repl.history(loaded)
+    assert len(h) == 8
+    re = repl.recheck(loaded, Stats())
+    assert re["results"]["valid?"] is True
+    assert len(repl.runs("repl-test", base=base)) == 1

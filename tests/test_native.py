@@ -162,3 +162,13 @@ def test_wgl_differential_random_histories():
         assert rn["valid?"] == rp["valid?"], f"trial {trial} diverged"
         agree += 1
     assert agree == 30
+
+
+def test_bfs_cycle_grows_buffer():
+    # a cycle longer than the initial buffer must still be found
+    n = 50
+    src = np.arange(n, dtype=np.int64)
+    dst = np.roll(src, -1)
+    cyc = native.bfs_cycle(n, src, dst, 0, max_len=4)
+    assert cyc is not None and len(cyc) == n + 1
+    assert cyc[0] == cyc[-1] == 0

@@ -131,3 +131,14 @@ def test_nemesis_intervals_kill_package_metadata():
     assert len(iv) == 2
     assert abs(iv[0][0] - 1.0) < 0.1 and abs(iv[0][1] - 2.0) < 0.1
     assert abs(iv[1][0] - 3.0) < 0.1 and abs(iv[1][1] - 4.0) < 0.1
+
+
+def test_nemesis_intervals_conventional_start_stop():
+    # the plain start/stop nemesis with no metadata still shades
+    ops = []
+    for (t, f) in [(1, "start"), (2, "stop"), (3, "start"), (4, "stop")]:
+        ops.append(Op(type="invoke", process="nemesis", f=f, time=t * S))
+        ops.append(Op(type="info", process="nemesis", f=f,
+                      time=t * S + 1000))
+    iv = perf.nemesis_intervals(history(ops))
+    assert len(iv) == 2
