@@ -1,36 +1,74 @@
-"""Competition: host WGL vs device frontier search.
+"""Competition: race the linearizability algorithms.
 
 Equivalent of `knossos/competition.clj` (SURVEY.md §2.4), which races
-`linear` and `wgl` and takes the first definitive answer.  Here the two
-contestants are the exact host WGL (small-history anchor) and the TPU
-batched frontier search (scales wider).  The host runs first below a size
-threshold; the device verdict is used for larger histories, with the host
-as fallback when the device returns "unknown" (overflow / state
-explosion).
+`linear` and `wgl` on two thread pools and takes the first definitive
+answer.  Here three contestants exist: JIT-linear (`linear.py`), host WGL
+(`wgl.py`, C++-accelerated via `jepsen_tpu.native`), and the TPU batched
+frontier search (`device_wgl.py`).  Small histories race linear vs wgl on
+threads (losers aborted via `search.Search`); large ones go to the
+device first, with the host as fallback for "unknown".
 """
 
 from __future__ import annotations
 
+import concurrent.futures as _fut
+import logging
 from typing import Any, Dict
 
-from jepsen_tpu.checkers.knossos import device_wgl, wgl
+logger = logging.getLogger("jepsen.knossos")
+
+from jepsen_tpu.checkers.knossos import device_wgl, linear, wgl
 from jepsen_tpu.checkers.knossos.prep import prepare
+from jepsen_tpu.checkers.knossos.search import Search
 from jepsen_tpu.history.ops import History
 from jepsen_tpu.models import Model
 
 HOST_FIRST_MAX_OPS = 256
 
 
+def _race_host(ops, model, **kw) -> Dict[str, Any]:
+    """linear vs wgl on two threads; first definitive answer wins and the
+    loser is aborted (reference competition semantics).  The executor is
+    shut down without waiting — the loser notices `ctl` and exits."""
+    ctl = Search()
+    ex = _fut.ThreadPoolExecutor(max_workers=2)
+    futs = {
+        ex.submit(linear.check, list(ops), model, ctl=ctl, **kw): "linear",
+        ex.submit(wgl.check, list(ops), model, ctl=ctl, **kw): "wgl",
+    }
+    fallback: Dict[str, Any] = {"valid?": "unknown"}
+    try:
+        for fut in _fut.as_completed(futs):
+            try:
+                res = fut.result()
+            except Exception:  # noqa: BLE001 — let the other finish
+                logger.warning("%s contestant crashed", futs[fut],
+                               exc_info=True)
+                fallback = {"valid?": "unknown",
+                            "error": f"{futs[fut]} crashed"}
+                continue
+            if res.get("valid?") != "unknown":
+                return res
+            fallback = res
+        return fallback
+    finally:
+        ctl.abort()
+        ex.shutdown(wait=False)
+
+
 def analysis(history: History, model: Model,
              algorithm: str = "auto", **kw) -> Dict[str, Any]:
-    """Linearizability analysis.  algorithm: auto | wgl | device."""
+    """Linearizability analysis.
+    algorithm: auto | wgl | linear | device | competition."""
     ops = prepare(history)
     if algorithm == "wgl":
         return wgl.check(ops, model, **kw)
+    if algorithm == "linear":
+        return linear.check(ops, model, **kw)
     if algorithm == "device":
         return device_wgl.check(ops, model, **kw)
     if len(ops) <= HOST_FIRST_MAX_OPS:
-        res = wgl.check(ops, model)
+        res = _race_host(ops, model, **kw)
         if res["valid?"] != "unknown":
             return res
         dres = device_wgl.check(ops, model)
@@ -38,4 +76,4 @@ def analysis(history: History, model: Model,
     res = device_wgl.check(ops, model)
     if res["valid?"] != "unknown":
         return res
-    return wgl.check(ops, model)
+    return _race_host(ops, model, **kw)

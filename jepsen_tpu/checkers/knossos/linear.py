@@ -1,0 +1,127 @@
+"""Just-in-time linearization (Lowe's algorithm).
+
+Equivalent of `knossos/linear.clj` + `knossos/linear/config.clj`
+(SURVEY.md §2.4): configurations evolve per history *event* rather than
+per linearization order.  A configuration is ``(model-state,
+linearized-set)`` where the set holds ops linearized but not yet
+returned.  On an op's return, every surviving configuration must have
+linearized it — configurations are expanded "just in time" by linearizing
+subsets of pending calls, then filtered; an empty configuration set is a
+linearizability violation, localized to that return event.
+
+Uses the same memoized int model states as WGL (`memo.py`); compact
+configs are ``(state:int, frozenset[int])`` — the Python analogue of the
+reference's array-packed config structures.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from jepsen_tpu.checkers.knossos.memo import Memo, StateExplosion, memoize
+from jepsen_tpu.checkers.knossos.prep import NEVER, LinOp, prepare
+from jepsen_tpu.checkers.knossos.search import Search
+from jepsen_tpu.history.ops import History
+from jepsen_tpu.models import Model
+
+Config = Tuple[int, frozenset]
+
+
+def _events(ops: Sequence[LinOp]) -> List[Tuple[int, str, int]]:
+    evs = []
+    for op in ops:
+        evs.append((op.invoke_pos, "call", op.index))
+        if op.return_pos < NEVER:
+            evs.append((op.return_pos, "ret", op.index))
+    evs.sort()
+    return evs
+
+
+def _jit_expand(configs: Set[Config], target: int, calls: Set[int],
+                table, op_sym, max_configs: int) -> Optional[Set[Config]]:
+    """All configs reachable by linearizing pending calls, keeping those
+    with `target` linearized (then dropping target from the set).
+    Returns None on budget blowout."""
+    out: Set[Config] = set()
+    seen: Set[Config] = set(configs)
+    stack = list(configs)
+    budget = max_configs
+    while stack:
+        state, lin = stack.pop()
+        if target in lin:
+            out.add((state, lin - {target}))
+        pending = calls - lin
+        for j in pending:
+            s2 = int(table[state, op_sym[j]])
+            if s2 < 0:
+                continue
+            c2 = (s2, lin | {j})
+            if c2 in seen:
+                continue
+            seen.add(c2)
+            budget -= 1
+            if budget <= 0:
+                return None
+            stack.append(c2)
+    return out
+
+
+def _search(ops: Sequence[LinOp], memo: Memo, max_configs: int,
+            ctl: Optional[Search] = None):
+    table = memo.table
+    op_sym = memo.op_sym
+    configs: Set[Config] = {(memo.init_state, frozenset())}
+    calls: Set[int] = set()
+    for pos, kind, i in _events(ops):
+        if ctl is not None and ctl.aborted():
+            return None, {"reason": "aborted"}
+        if kind == "call":
+            calls.add(i)
+            continue
+        expanded = _jit_expand(configs, i, calls, table, op_sym,
+                               max_configs)
+        if expanded is None:
+            return None, {"reason": "config budget exhausted"}
+        calls.remove(i)
+        if not expanded:
+            return False, _failure_info(ops, i, pos, configs)
+        configs = expanded
+        if ctl is not None:
+            ctl.explored += len(configs)
+    return True, None
+
+
+def _failure_info(ops: Sequence[LinOp], bad_op: int, pos: int,
+                  prior_configs: Set[Config]) -> dict:
+    op = ops[bad_op]
+    return {
+        "op": {"index": op.orig_invoke, "f": op.f, "value": op.value},
+        "return-pos": pos,
+        "prior-config-count": len(prior_configs),
+        "prior-configs": [
+            {"state": int(s), "linearized-not-returned": sorted(lin)}
+            for (s, lin) in list(prior_configs)[:4]],
+    }
+
+
+def check(history: "History | Sequence[LinOp]", model: Model,
+          max_configs: int = 5_000_000,
+          ctl: Optional[Search] = None) -> Dict[str, Any]:
+    """JIT-linearization check; same result shape as `wgl.check`.  Unlike
+    WGL, a violation is localized to the first un-linearizable return."""
+    ops = history if isinstance(history, list) else prepare(history)
+    if not ops:
+        return {"valid?": "unknown", "op-count": 0}
+    try:
+        memo = memoize(model, ops)
+    except StateExplosion:
+        return {"valid?": "unknown", "reason": "state explosion",
+                "op-count": len(ops)}
+    ok, info = _search(ops, memo, max_configs, ctl)
+    if ok is None:
+        return {"valid?": "unknown", **(info or {})}
+    out: Dict[str, Any] = {"valid?": bool(ok), "op-count": len(ops),
+                           "algorithm": "linear"}
+    if info:
+        out["final-info"] = info
+    return out

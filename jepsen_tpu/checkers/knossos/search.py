@@ -1,0 +1,38 @@
+"""Search control: abort flags, deadlines, budgets.
+
+Equivalent of `knossos/search.clj` (SURVEY.md §2.4): a small handle the
+long-running searches poll so a competition can abort the loser, a
+deadline can bound wall time, and callers can read progress.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+
+class Search:
+    """Shared control block for one search run."""
+
+    def __init__(self, *, deadline_s: Optional[float] = None):
+        self._abort = threading.Event()
+        self.deadline = (time.monotonic() + deadline_s
+                         if deadline_s else None)
+        self.explored = 0
+        self.result: Optional[dict] = None
+
+    def abort(self) -> None:
+        self._abort.set()
+
+    def aborted(self) -> bool:
+        if self._abort.is_set():
+            return True
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            self._abort.set()
+            return True
+        return False
+
+    def report(self, result: dict) -> dict:
+        self.result = result
+        return result

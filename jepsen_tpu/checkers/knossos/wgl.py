@@ -22,7 +22,7 @@ from jepsen_tpu.models import Inconsistent, Model
 
 
 def _search_memo(ops: Sequence[LinOp], memo: Memo,
-                 max_configs: int = 5_000_000):
+                 max_configs: int = 5_000_000, ctl=None):
     """DFS over (linearized bitset, state).  Returns (ok, final_info)."""
     n = len(ops)
     must = 0  # bitmask of ops that MUST linearize (have returns)
@@ -69,6 +69,8 @@ def _search_memo(ops: Sequence[LinOp], memo: Memo,
         explored += 1
         if explored > max_configs:
             return None, {"reason": "config budget exhausted"}
+        if ctl is not None and explored % 4096 == 0 and ctl.aborted():
+            return None, {"reason": "aborted"}
         stack.append((S2, s2, candidates(S2), 0))
     # exhausted without linearizing all required ops
     return False, _final_info(ops, seen, memo)
@@ -88,7 +90,10 @@ def _final_info(ops, seen, memo):
     return {
         "max-linearized": best_count,
         "op-count": len(ops),
-        "configs": [{"linearized": [i for i in range(len(ops))
+        # history indices (orig_invoke), not internal prepared-op ids, so
+        # reports and humans can find the ops
+        "configs": [{"linearized": [ops[i].orig_invoke
+                                    for i in range(len(ops))
                                     if (S >> i) & 1],
                      "state": int(st)} for (S, st) in best[:4]],
     }
@@ -168,8 +173,10 @@ def _search_native(ops: Sequence[LinOp], memo: Memo, max_configs: int):
 
 
 def check(history: History | Sequence[LinOp], model: Model,
-          max_configs: int = 5_000_000) -> Dict[str, Any]:
-    """Check linearizability of a single-object history against a model."""
+          max_configs: int = 5_000_000, ctl=None) -> Dict[str, Any]:
+    """Check linearizability of a single-object history against a model.
+    `ctl` (a `search.Search`) lets a competition abort the Python search;
+    the native path is not abortable but returns quickly or not at all."""
     ops = history if isinstance(history, list) else prepare(history)
     if not ops:
         return {"valid?": "unknown", "op-count": 0}
@@ -177,7 +184,7 @@ def check(history: History | Sequence[LinOp], model: Model,
         memo = memoize(model, ops)
         ok, info = _search_native(ops, memo, max_configs)
         if ok is NotImplemented:
-            ok, info = _search_memo(ops, memo, max_configs)
+            ok, info = _search_memo(ops, memo, max_configs, ctl)
     except StateExplosion:
         ok, info = _search_direct(ops, model, max_configs)
     if ok is None:

@@ -118,6 +118,10 @@ def nemesis_intervals(history, test: Optional[dict] = None
             is_start = f in starts or (generic and f.startswith("start"))
             is_stop = f in stops or (generic and (f.startswith("stop")
                                                   or f.startswith("heal")))
+            if generic and f == "start" and open_at[si] is not None:
+                # heuristic mode: a bare "start" while a window is open is
+                # the kill nemesis's recovery — close, don't open
+                is_start, is_stop = False, True
             if is_start and open_at[si] is None:
                 open_at[si], open_f[si] = t, op.f
             elif is_stop and open_at[si] is not None:

@@ -43,15 +43,26 @@ def save(key: str, src_path: str) -> str:
 
 def fetch(url: str, *, force: bool = False) -> str:
     """Download url into the cache (once) and return the local path
-    (reference `cache/locking-fetch!`-style)."""
+    (reference `cache/locking-fetch!`-style).  Concurrent fetchers each
+    write a private temp file and publish atomically, so parallel node
+    setups can never observe a torn artifact."""
+    import tempfile
+
     p = _key_path(url)
     if not force and os.path.exists(p):
         return p
     os.makedirs(CACHE_DIR, exist_ok=True)
-    tmp = p + ".tmp"
-    with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
-        shutil.copyfileobj(r, f)
-    os.replace(tmp, p)
+    fd, tmp = tempfile.mkstemp(dir=CACHE_DIR, suffix=".tmp")
+    try:
+        with urllib.request.urlopen(url) as r, os.fdopen(fd, "wb") as f:
+            shutil.copyfileobj(r, f)
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     return p
 
 

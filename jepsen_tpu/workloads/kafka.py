@@ -1,0 +1,288 @@
+"""Kafka-style partitioned-log workload.
+
+Equivalent of the reference's `jepsen/src/jepsen/tests/kafka.clj`
+(SURVEY.md §2.6): clients send uniquely-valued messages to partitioned
+topics ("keys") and poll them back; a consumer's assignment changes over
+time via ``assign``/``subscribe`` ops.  Op shapes mirror the reference:
+
+- ``{"f": "send", "value": [("send", k, v)]}`` — completed sends get
+  ``("send", k, (offset, v))``;
+- ``{"f": "poll", "value": [("poll", None)]}`` — completed polls get
+  ``("poll", {k: [(offset, v), ...]})`` for the assigned keys;
+- ``{"f": "assign", "value": [k, ...]}`` — replace the assignment (seeks
+  to the last committed position per key);
+- ``{"f": "crash", ...}`` — client crashes (:info), forcing reassignment.
+
+The checker hunts the reference's anomaly families:
+
+- **lost-write**: a committed send whose offset is below some polled
+  offset for that key, yet never polled by anyone;
+- **duplicate**: one value at two different offsets of a key;
+- **inconsistent-offsets**: two different values observed at one offset;
+- **nonmonotonic-poll**: a process's successive polls of a key going
+  backwards in offset;
+- **skipped-poll** (int-poll-skip): a single poll batch jumping over an
+  offset that some poll observed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..checkers import api as checker_api
+from ..client import Client
+from ..history.ops import OK
+
+
+# ---------------------------------------------------------------------------
+# Generator
+
+
+class _KafkaGen:
+    """send/poll mix with occasional assign churn (reference kafka gen)."""
+
+    def __init__(self, *, key_count: int = 4, poll_frac: float = 0.4,
+                 assign_frac: float = 0.1, crash_frac: float = 0.0,
+                 rng: Optional[random.Random] = None):
+        self.rng = rng or random.Random()
+        self.key_count = key_count
+        self.poll_frac = poll_frac
+        self.assign_frac = assign_frac
+        self.crash_frac = crash_frac
+        self.counter = itertools.count()
+
+    def _keys_sample(self):
+        n = self.rng.randint(1, self.key_count)
+        return sorted(self.rng.sample(range(self.key_count), n))
+
+    def __call__(self, test, ctx):
+        r = self.rng.random()
+        if r < self.crash_frac:
+            return {"f": "crash", "value": None}
+        r = self.rng.random()
+        if r < self.assign_frac:
+            return {"f": "assign", "value": self._keys_sample()}
+        if r < self.assign_frac + self.poll_frac:
+            return {"f": "poll", "value": [("poll", None)]}
+        k = self.rng.randrange(self.key_count)
+        return {"f": "send", "value": [("send", k, next(self.counter))]}
+
+
+def gen(**opts) -> Any:
+    return _KafkaGen(**opts)
+
+
+def final_gen():
+    """Final phase: assign everything and poll until quiet (so the
+    checker can distinguish lost from merely-unread)."""
+    from ..generator import core as g
+
+    def assign_all(test, ctx):
+        keys = list(range(test.get("kafka-key-count", 4)))
+        return {"f": "assign", "value": keys}
+
+    # a bare fn generator is infinite — wrap in once()
+    return g.clients(g.each_thread(g.lift(
+        [g.once(assign_all)]
+        + [{"f": "poll", "value": [("poll", None)]}] * 16)))
+
+
+# ---------------------------------------------------------------------------
+# In-memory kafka-ish broker + client (the sim-cluster db)
+
+
+class KafkaStore:
+    """Partitioned append-only logs with per-consumer positions."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.logs: Dict[Any, List[Any]] = {}
+
+    def append(self, k, v) -> int:
+        log = self.logs.setdefault(k, [])
+        log.append(v)
+        return len(log) - 1
+
+    def read_from(self, k, pos: int, limit: int) -> List[Tuple[int, Any]]:
+        log = self.logs.get(k, [])
+        return [(i, log[i]) for i in range(pos, min(len(log), pos + limit))]
+
+
+class KafkaClient(Client):
+    """One consumer/producer per process (reference kafka client shape).
+
+    `lose_tail_p`: on send, with this probability the broker "acks" but
+    drops the message (a lost write, for checker tests)."""
+
+    def __init__(self, store: Optional[KafkaStore] = None, *,
+                 poll_limit: int = 8, lose_tail_p: float = 0.0,
+                 dup_p: float = 0.0, rng: Optional[random.Random] = None):
+        self.store = store or KafkaStore()
+        self.poll_limit = poll_limit
+        self.lose_tail_p = lose_tail_p
+        self.dup_p = dup_p
+        self.rng = rng or random.Random(0)
+        self.assigned: List[Any] = []
+        self.pos: Dict[Any, int] = {}
+
+    def open(self, test, node):
+        c = KafkaClient(self.store, poll_limit=self.poll_limit,
+                        lose_tail_p=self.lose_tail_p, dup_p=self.dup_p,
+                        rng=self.rng)
+        return c
+
+    def invoke(self, test, op):
+        f = op["f"]
+        s = self.store
+        with s.lock:
+            if f == "send":
+                out = []
+                for (_kind, k, v) in op["value"]:
+                    if self.lose_tail_p and self.rng.random() < self.lose_tail_p:
+                        # broker acks but drops: offset it claims is bogus
+                        out.append(("send", k, (len(s.logs.get(k, [])), v)))
+                        continue
+                    off = s.append(k, v)
+                    if self.dup_p and self.rng.random() < self.dup_p:
+                        s.append(k, v)  # duplicated append
+                    out.append(("send", k, (off, v)))
+                return dict(op, type="ok", value=out)
+            if f == "poll":
+                batch: Dict[Any, List[Tuple[int, Any]]] = {}
+                for k in self.assigned:
+                    msgs = s.read_from(k, self.pos.get(k, 0),
+                                       self.poll_limit)
+                    if msgs:
+                        self.pos[k] = msgs[-1][0] + 1
+                    batch[k] = msgs
+                return dict(op, type="ok", value=[("poll", batch)])
+            if f == "assign":
+                self.assigned = list(op["value"])
+                for k in self.assigned:
+                    self.pos.setdefault(k, 0)
+                return dict(op, type="ok")
+            if f == "subscribe":
+                # sim broker: subscribe == assign (no group rebalance)
+                self.assigned = list(op["value"])
+                for k in self.assigned:
+                    self.pos.setdefault(k, 0)
+                return dict(op, type="ok")
+            if f == "crash":
+                return dict(op, type="info", error="client crashed")
+        raise ValueError(f"unknown kafka op {f!r}")
+
+
+# ---------------------------------------------------------------------------
+# Checker
+
+
+def _observations(history):
+    """Collected facts from the history, one pass."""
+    sends: List[Tuple[Any, int, Any, int]] = []   # (k, offset, v, op-index)
+    polls: List[Tuple[Any, List[Tuple[int, Any]], Any, int]] = []
+    for op in history:
+        if op.type != OK or not op.is_client_op() \
+                or op.f not in ("send", "poll", "txn"):
+            continue  # assign/subscribe values are key lists, not mops
+        for mop in op.value or ():
+            if not isinstance(mop, (tuple, list)) or len(mop) < 2:
+                continue
+            kind = mop[0]
+            if kind == "send" and isinstance(mop[2], tuple):
+                off, v = mop[2]
+                sends.append((mop[1], int(off), v, op.index))
+            elif kind == "poll" and isinstance(mop[1], dict):
+                for k, msgs in mop[1].items():
+                    polls.append((k, [(int(o), v) for (o, v) in msgs],
+                                  op.process, op.index))
+    return sends, polls
+
+
+class KafkaChecker(checker_api.Checker):
+    """The reference kafka checker's core anomaly families."""
+
+    def check(self, test, history, opts=None):
+        sends, polls = _observations(history)
+        if not sends and not polls:
+            return {"valid?": "unknown"}
+
+        # version map: (k, offset) -> set of values observed there
+        at: Dict[Tuple[Any, int], set] = {}
+        polled_offsets: Dict[Any, set] = {}
+        polled_values: Dict[Any, Dict[Any, set]] = {}
+        for (k, off, v, _i) in sends:
+            at.setdefault((k, off), set()).add(v)
+        for (k, msgs, _p, _i) in polls:
+            for (off, v) in msgs:
+                at.setdefault((k, off), set()).add(v)
+                polled_offsets.setdefault(k, set()).add(off)
+                polled_values.setdefault(k, {}).setdefault(v, set()).add(off)
+
+        inconsistent_offsets = sorted(
+            (k, off, sorted(vs, key=repr))
+            for (k, off), vs in at.items() if len(vs) > 1)
+
+        duplicates = sorted(
+            (k, v, sorted(offs))
+            for k, vals in polled_values.items()
+            for v, offs in vals.items() if len(offs) > 1)
+
+        # lost: committed send below the max polled offset, never polled
+        lost = []
+        for (k, off, v, i) in sends:
+            seen = polled_offsets.get(k, set())
+            if not seen:
+                continue
+            if off < max(seen) and off not in seen:
+                lost.append((k, off, v))
+        lost = sorted(set(lost))
+
+        # per-process nonmonotonic polls; per-batch skips
+        nonmonotonic = []
+        skipped = []
+        last_polled: Dict[Tuple[Any, Any], int] = {}
+        for (k, msgs, p, i) in polls:
+            if not msgs:
+                continue
+            offs = [o for (o, _v) in msgs]
+            prev = last_polled.get((p, k))
+            if prev is not None and offs[0] <= prev:
+                nonmonotonic.append({"process": p, "key": k,
+                                     "prev": prev, "next": offs[0],
+                                     "op-index": i})
+            for a, b in zip(offs, offs[1:]):
+                if b != a + 1 and any(a < o < b
+                                      for o in polled_offsets.get(k, ())):
+                    skipped.append({"key": k, "from": a, "to": b,
+                                    "op-index": i})
+            last_polled[(p, k)] = offs[-1]
+
+        anomalies = {
+            "lost-write": lost[:16],
+            "duplicate": duplicates[:16],
+            "inconsistent-offsets": inconsistent_offsets[:16],
+            "nonmonotonic-poll": nonmonotonic[:16],
+            "skipped-poll": skipped[:16],
+        }
+        found = {k: v for k, v in anomalies.items() if v}
+        return {
+            "valid?": not found,
+            "anomaly-types": sorted(found),
+            "anomalies": found,
+            "send-count": len(sends),
+            "poll-count": len(polls),
+        }
+
+
+def workload(*, key_count: int = 4, crash_frac: float = 0.0,
+             rng: Optional[random.Random] = None) -> dict:
+    return {
+        "generator": gen(key_count=key_count, crash_frac=crash_frac,
+                         rng=rng),
+        "final-generator": final_gen(),
+        "checker": KafkaChecker(),
+        "kafka-key-count": key_count,
+    }

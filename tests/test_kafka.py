@@ -1,0 +1,110 @@
+"""Kafka workload tests: healthy runs pass; each injected fault family is
+detected (reference kafka_test strategy, SURVEY.md §2.6/§4)."""
+
+import random
+
+from jepsen_tpu import core
+from jepsen_tpu.generator import core as g
+from jepsen_tpu.history.ops import history, invoke, ok
+from jepsen_tpu.workloads import kafka
+
+
+def _run(tmp_path, client, *, n_ops=60, crash_frac=0.0, seed=1):
+    wl = kafka.workload(rng=random.Random(seed), crash_frac=crash_frac)
+    t = {
+        "name": "kafka-test", "nodes": ["n1", "n2"], "client": client,
+        "concurrency": 4, "store-dir": str(tmp_path / "s"),
+        "kafka-key-count": wl["kafka-key-count"],
+        "generator": g.clients(g.limit(n_ops, wl["generator"])),
+        "final-generator": wl["final-generator"],
+        "checker": wl["checker"],
+    }
+    return core.run(t)
+
+
+def test_kafka_healthy_run_valid(tmp_path):
+    done = _run(tmp_path, kafka.KafkaClient())
+    assert done["results"]["valid?"] is True
+    assert done["results"]["send-count"] > 0
+    assert done["results"]["poll-count"] > 0
+
+
+def test_kafka_with_crashes_still_valid(tmp_path):
+    done = _run(tmp_path, kafka.KafkaClient(), crash_frac=0.1, seed=3)
+    assert done["results"]["valid?"] is True
+
+
+def test_kafka_lost_writes_detected(tmp_path):
+    done = _run(tmp_path,
+                kafka.KafkaClient(lose_tail_p=0.3,
+                                  rng=random.Random(5)), seed=5)
+    res = done["results"]
+    assert res["valid?"] is False
+    assert "lost-write" in res["anomaly-types"] \
+        or "inconsistent-offsets" in res["anomaly-types"]
+
+
+def test_kafka_duplicates_detected(tmp_path):
+    done = _run(tmp_path,
+                kafka.KafkaClient(dup_p=0.5, rng=random.Random(6)),
+                seed=6)
+    res = done["results"]
+    assert res["valid?"] is False
+    assert "duplicate" in res["anomaly-types"]
+
+
+# ---- checker unit cases on literal histories ----
+
+
+def test_checker_inconsistent_offsets():
+    h = history([
+        invoke(0, "send", [("send", 0, 1)]),
+        ok(0, "send", [("send", 0, (0, 1))]),
+        invoke(1, "send", [("send", 0, 2)]),
+        ok(1, "send", [("send", 0, (0, 2))]),  # same offset, different value
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is False
+    assert "inconsistent-offsets" in res["anomaly-types"]
+
+
+def test_checker_lost_write():
+    h = history([
+        invoke(0, "send", [("send", 0, 10)]),
+        ok(0, "send", [("send", 0, (0, 10))]),
+        invoke(0, "send", [("send", 0, 11)]),
+        ok(0, "send", [("send", 0, (1, 11))]),
+        invoke(1, "poll", [("poll", None)]),
+        ok(1, "poll", [("poll", {0: [(1, 11)]})]),  # saw offset 1, not 0
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is False
+    assert res["anomalies"]["lost-write"] == [(0, 0, 10)]
+
+
+def test_checker_nonmonotonic_poll():
+    h = history([
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(3, "c"), (4, "d")]})]),
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(2, "b")]})]),  # went backwards
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is False
+    assert "nonmonotonic-poll" in res["anomaly-types"]
+
+
+def test_checker_skipped_poll():
+    h = history([
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(0, "a"), (2, "c")]})]),  # skipped 1
+        invoke(1, "poll", [("poll", None)]),
+        ok(1, "poll", [("poll", {0: [(1, "b")]})]),  # 1 does exist
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is False
+    assert "skipped-poll" in res["anomaly-types"]
+
+
+def test_checker_empty_unknown():
+    assert kafka.KafkaChecker().check({}, history([]))["valid?"] == "unknown"

@@ -1,0 +1,147 @@
+"""JIT-linearization tests: agreement with WGL on random histories,
+violation localization, competition racing, SVG failure report
+(SURVEY.md §2.4)."""
+
+import os
+import random
+
+import pytest
+
+from jepsen_tpu.checkers.knossos import (competition, linear, report, wgl)
+from jepsen_tpu.checkers.knossos.search import Search
+from jepsen_tpu.history.ops import history, info, invoke, ok
+from jepsen_tpu.models import cas_register, register
+
+
+def test_linear_valid_sequential():
+    h = history([
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(0, "read", None), ok(0, "read", 1),
+    ])
+    res = linear.check(h, register())
+    assert res["valid?"] is True
+    assert res["algorithm"] == "linear"
+
+
+def test_linear_invalid_localizes_op():
+    h = history([
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(0, "read", None), ok(0, "read", 9),
+    ])
+    res = linear.check(h, register())
+    assert res["valid?"] is False
+    # the violation is localized to the bad read's invocation index
+    assert res["final-info"]["op"]["index"] == 2
+    assert res["final-info"]["op"]["f"] == "read"
+
+
+def test_linear_concurrent_reordering_ok():
+    # two concurrent writes, read can see either — but this read's value
+    # requires w2 to linearize first even though w1 invoked first
+    h = history([
+        invoke(0, "write", 1),
+        invoke(1, "write", 2),
+        ok(1, "write", 2),
+        ok(0, "write", 1),
+        invoke(2, "read", None), ok(2, "read", 1),
+    ])
+    assert linear.check(h, register())["valid?"] is True
+
+
+def test_linear_info_may_never_linearize():
+    h = history([
+        invoke(0, "write", 5), info(0, "write", 5),
+        invoke(1, "read", None), ok(1, "read", None),
+        invoke(1, "read", None), ok(1, "read", 5),  # later it lands
+    ])
+    assert linear.check(h, register())["valid?"] is True
+
+
+def test_linear_wgl_agree_random():
+    rng = random.Random(11)
+    for trial in range(30):
+        ops = []
+        events = []
+        for p in range(3):
+            for _ in range(rng.randint(1, 3)):
+                kind = rng.choice(["read", "write", "cas"])
+                if kind == "read":
+                    v = rng.choice([None, 0, 1])
+                elif kind == "write":
+                    v = rng.choice([0, 1, 2])
+                else:
+                    v = [rng.choice([0, 1]), rng.choice([0, 1])]
+                events.append((p, kind, v))
+        rng.shuffle(events)
+        for p, kind, v in events:
+            ops.append(invoke(p, kind, v))
+            ops.append(rng.choice([ok, ok, ok, info])(p, kind, v))
+        h = history(ops)
+        rl = linear.check(h, cas_register())
+        os.environ["JT_NO_NATIVE"] = "1"
+        try:
+            rw = wgl.check(h, cas_register())
+        finally:
+            del os.environ["JT_NO_NATIVE"]
+        assert rl["valid?"] == rw["valid?"], f"trial {trial}"
+
+
+def test_linear_abort():
+    ctl = Search()
+    ctl.abort()
+    h = history([invoke(0, "write", 1), ok(0, "write", 1)])
+    res = linear.check(h, register(), ctl=ctl)
+    assert res["valid?"] == "unknown"
+
+
+def test_competition_race_and_fallbacks():
+    h = history([
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "cas", [1, 2]), ok(1, "cas", [1, 2]),
+        invoke(0, "read", None), ok(0, "read", 2),
+    ])
+    for algo in ("auto", "wgl", "linear", "device"):
+        assert competition.analysis(h, cas_register(),
+                                    algorithm=algo)["valid?"] is True, algo
+    bad = history([
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(0, "read", None), ok(0, "read", 3),
+    ])
+    for algo in ("auto", "wgl", "linear"):
+        assert competition.analysis(bad, cas_register(),
+                                    algorithm=algo)["valid?"] is False, algo
+
+
+def test_failure_report_svg(tmp_path):
+    h = history([
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "write", 2), ok(1, "write", 2),
+        invoke(0, "read", None), ok(0, "read", 7),
+    ])
+    res = linear.check(h, register())
+    assert res["valid?"] is False
+    path = str(tmp_path / "linear.svg")
+    out = report.render_analysis(h, res, path)
+    assert out == path
+    svg = open(path).read()
+    assert svg.startswith("<svg") and "non-linearizable" in svg
+    assert "read" in svg
+
+
+def test_failure_report_handles_wgl_shape(tmp_path):
+    h = history([
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(0, "read", None), ok(0, "read", 9),
+    ])
+    os.environ["JT_NO_NATIVE"] = "1"
+    try:
+        res = wgl.check(h, register())
+    finally:
+        del os.environ["JT_NO_NATIVE"]
+    assert res["valid?"] is False
+    path = str(tmp_path / "wgl.svg")
+    out = report.render_analysis(h, res, path)
+    # WGL failures carry configs; report may or may not localize, but must
+    # not crash, and when it renders the file must be valid SVG
+    if out is not None:
+        assert open(path).read().startswith("<svg")

@@ -142,3 +142,15 @@ def test_nemesis_intervals_conventional_start_stop():
                       time=t * S + 1000))
     iv = perf.nemesis_intervals(history(ops))
     assert len(iv) == 2
+
+
+def test_nemesis_intervals_kill_start_heuristic_no_metadata():
+    # metadata-less kill nemesis: bare "start" closes an open kill window
+    ops = []
+    for (t, f) in [(1, "kill"), (2, "start"), (3, "kill"), (4, "start")]:
+        ops.append(Op(type="invoke", process="nemesis", f=f, time=t * S))
+        ops.append(Op(type="info", process="nemesis", f=f,
+                      time=t * S + 1000))
+    iv = perf.nemesis_intervals(history(ops))
+    assert len(iv) == 2
+    assert abs(iv[0][1] - 2.0) < 0.1 and abs(iv[1][1] - 4.0) < 0.1
