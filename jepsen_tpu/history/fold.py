@@ -1,0 +1,171 @@
+"""Chunk-parallel folds over histories, with fold fusion.
+
+Equivalent of the reference's `jepsen/history/fold.clj` + `task.clj`
+(SURVEY.md §2.2): a fold is a spec of
+
+- ``reducer_identity`` / ``reducer`` / ``post_reducer`` — applied within a
+  chunk,
+- ``combiner_identity`` / ``combiner`` / ``post_combiner`` — applied across
+  chunk results **in order**,
+- ``associative`` — when False the fold runs serially (exact reference
+  semantics: only associative folds go chunk-parallel).
+
+:class:`Folder` binds to a chunked op source (a History, a store
+``LazyHistory``, or an explicit chunk list) and **fuses** concurrently
+requested folds into one pass — each chunk is traversed once no matter how
+many folds run (`fold_many`), the reference's signature optimization.
+
+The numeric hot path lives on device: once a history is packed
+(`history/soa.py`), sums/counts/extrema are jax segment reductions
+(`ops/segments.py`).  This module is the general host path for arbitrary
+Python reducers, parallelized across chunks with threads (numpy-heavy
+reducers release the GIL; pure-Python ones still win via fusion).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as _fut
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .ops import History, Op
+
+CHUNK_SIZE = 16384
+
+
+def _identity(x: Any) -> Any:
+    return x
+
+
+@dataclasses.dataclass
+class Fold:
+    """A fold spec (reference fold maps)."""
+
+    reducer_identity: Callable[[], Any]
+    reducer: Callable[[Any, Op], Any]
+    post_reducer: Callable[[Any], Any] = _identity
+    combiner_identity: Optional[Callable[[], Any]] = None
+    combiner: Optional[Callable[[Any, Any], Any]] = None
+    post_combiner: Callable[[Any], Any] = _identity
+    associative: bool = True
+    name: str = "fold"
+
+
+def fold_spec(*, reducer_identity, reducer, post_reducer=_identity,
+              combiner_identity=None, combiner=None,
+              post_combiner=_identity, associative=True,
+              name="fold") -> Fold:
+    return Fold(reducer_identity, reducer, post_reducer, combiner_identity,
+                combiner, post_combiner, associative, name)
+
+
+class Folder:
+    """Bound to one chunked source; runs (fused) folds over it."""
+
+    def __init__(self, chunks_or_history, *,
+                 max_workers: Optional[int] = None):
+        self._chunks = self._chunkify(chunks_or_history)
+        self.max_workers = max_workers or min(8, (os.cpu_count() or 2))
+
+    @staticmethod
+    def _chunkify(src) -> List[Sequence[Op]]:
+        # store.format.LazyHistory: chunk-at-a-time access
+        if hasattr(src, "iter_chunks"):
+            return list(src.iter_chunks())
+        if isinstance(src, History):
+            ops = src.ops
+        else:
+            ops = list(src)
+            if ops and not isinstance(ops[0], Op):
+                # already a list of chunks
+                return [list(c) for c in ops]
+        return [ops[i:i + CHUNK_SIZE]
+                for i in range(0, len(ops), CHUNK_SIZE)] or [[]]
+
+    # -- execution ---------------------------------------------------------
+
+    def _reduce_chunk(self, folds: Sequence[Fold], chunk: Sequence[Op]
+                      ) -> List[Any]:
+        accs = [f.reducer_identity() for f in folds]
+        reducers = [f.reducer for f in folds]
+        for op in chunk:
+            for i, r in enumerate(reducers):
+                accs[i] = r(accs[i], op)
+        return [f.post_reducer(a) for f, a in zip(folds, accs)]
+
+    def fold_many(self, folds: Sequence[Fold]) -> List[Any]:
+        """Run several folds in ONE pass over the chunks (fold fusion).
+        Associative folds share a chunk-parallel pass; non-associative
+        ones run serially (still fused with each other)."""
+        folds = list(folds)
+        par = [f for f in folds if f.associative]
+        ser = [f for f in folds if not f.associative]
+        results: Dict[int, Any] = {}
+
+        if par:
+            for f in par:
+                if f.combiner is None:
+                    raise TypeError(f"associative fold {f.name!r} needs "
+                                    f"a combiner")
+            if len(self._chunks) > 1:
+                with _fut.ThreadPoolExecutor(self.max_workers) as ex:
+                    chunk_results = list(ex.map(
+                        lambda c: self._reduce_chunk(par, c), self._chunks))
+            else:
+                chunk_results = [self._reduce_chunk(par, self._chunks[0])]
+            for fi, f in enumerate(par):
+                acc = (f.combiner_identity or f.reducer_identity)()
+                for cr in chunk_results:  # ordered combine
+                    acc = f.combiner(acc, cr[fi])
+                results[id(f)] = f.post_combiner(acc)
+        for f in ser:
+            acc = f.reducer_identity()
+            for chunk in self._chunks:
+                for op in chunk:
+                    acc = f.reducer(acc, op)
+            results[id(f)] = f.post_combiner(f.post_reducer(acc))
+        return [results[id(f)] for f in folds]
+
+    def fold(self, f: Fold) -> Any:
+        return self.fold_many([f])[0]
+
+
+# ---------------------------------------------------------------------------
+# Common folds (reference history's built-in folds / tesser shims)
+
+
+def count_fold(pred: Optional[Callable[[Op], bool]] = None) -> Fold:
+    return fold_spec(
+        name="count",
+        reducer_identity=lambda: 0,
+        reducer=(lambda acc, op: acc + 1) if pred is None
+        else (lambda acc, op: acc + (1 if pred(op) else 0)),
+        combiner_identity=lambda: 0,
+        combiner=lambda a, b: a + b)
+
+
+def group_count_fold(key: Callable[[Op], Any]) -> Fold:
+    def red(acc, op):
+        k = key(op)
+        acc[k] = acc.get(k, 0) + 1
+        return acc
+
+    def comb(a, b):
+        for k, v in b.items():
+            a[k] = a.get(k, 0) + v
+        return a
+
+    return fold_spec(name="group-count", reducer_identity=dict,
+                     reducer=red, combiner_identity=dict, combiner=comb)
+
+
+def collect_fold(pred: Callable[[Op], bool],
+                 xform: Callable[[Op], Any] = _identity) -> Fold:
+    return fold_spec(
+        name="collect",
+        reducer_identity=list,
+        reducer=lambda acc, op: (acc.append(xform(op)) or acc)
+        if pred(op) else acc,
+        combiner_identity=list,
+        combiner=lambda a, b: a + b)

@@ -63,13 +63,16 @@ class AppendChecker(checker_api.Checker):
         self.anomalies = tuple(anomalies)
 
     def check(self, test, history, opts=None):
-        from ..checkers.elle import list_append  # defers jax init
+        from ..checkers.elle import list_append, viz  # defers jax init
 
         opts = opts or {}
-        return list_append.check(
+        res = list_append.check(
             history,
             consistency_models=opts.get("consistency-models", self.models),
             anomalies=opts.get("anomalies", self.anomalies))
+        if test and test.get("store-dir") is not None:
+            viz.viz_for_test(res, test, history)
+        return res
 
 
 def workload(*, key_count: int = 10, min_txn_length: int = 1,

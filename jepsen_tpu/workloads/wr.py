@@ -51,13 +51,16 @@ class WrChecker(checker_api.Checker):
         self.anomalies = tuple(anomalies)
 
     def check(self, test, history, opts=None):
-        from ..checkers.elle import rw_register  # defers jax init
+        from ..checkers.elle import rw_register, viz  # defers jax init
 
         opts = opts or {}
-        return rw_register.check(
+        res = rw_register.check(
             history,
             consistency_models=opts.get("consistency-models", self.models),
             anomalies=opts.get("anomalies", self.anomalies))
+        if test and test.get("store-dir") is not None:
+            viz.viz_for_test(res, test, history)
+        return res
 
 
 def workload(*, key_count: int = 8, min_txn_length: int = 1,

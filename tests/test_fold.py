@@ -1,0 +1,105 @@
+"""Fold tests: parallel result == serial reference, fold fusion runs one
+pass, non-associative stays serial (reference history.fold's generative
+strategy, SURVEY.md §2.2/§4)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.history import fold as F
+from jepsen_tpu.history.ops import History, Op, history, invoke, ok
+
+
+def _mk(n, seed=0):
+    rng = random.Random(seed)
+    ops = []
+    for i in range(n // 2):
+        p = rng.randrange(5)
+        f = rng.choice(["read", "write"])
+        ops.append(Op(type="invoke", process=p, f=f, value=i))
+        ops.append(Op(type=rng.choice(["ok", "fail", "info"]),
+                      process=p, f=f, value=i))
+    return history(ops)
+
+
+def test_count_parallel_equals_serial():
+    h = _mk(50_000, seed=1)
+    folder = F.Folder(h)
+    assert folder.fold(F.count_fold()) == 50_000
+    ok_count = folder.fold(F.count_fold(lambda o: o.type == "ok"))
+    assert ok_count == sum(1 for o in h if o.type == "ok")
+
+
+def test_group_count_matches():
+    h = _mk(30_000, seed=2)
+    folder = F.Folder(h)
+    got = folder.fold(F.group_count_fold(lambda o: o.f))
+    want = {}
+    for o in h:
+        want[o.f] = want.get(o.f, 0) + 1
+    assert got == want
+
+
+def test_collect_preserves_order():
+    h = _mk(40_000, seed=3)
+    folder = F.Folder(h)
+    got = folder.fold(F.collect_fold(lambda o: o.type == "ok",
+                                     lambda o: o.index))
+    want = [o.index for o in h if o.type == "ok"]
+    assert got == want  # ordered combine keeps chunk order
+
+
+def test_fusion_single_pass():
+    h = _mk(5000, seed=4)
+    seen = []
+
+    def make_counting_fold(name):
+        def red(acc, op):
+            seen.append(name)
+            return acc + 1
+        return F.fold_spec(name=name, reducer_identity=lambda: 0,
+                           reducer=red, combiner_identity=lambda: 0,
+                           combiner=lambda a, b: a + b)
+
+    folder = F.Folder(h, max_workers=1)
+    r = folder.fold_many([make_counting_fold("a"), make_counting_fold("b")])
+    assert r == [5000, 5000]
+    # fused: both reducers saw each op exactly once -> 2 * n total calls
+    assert len(seen) == 10_000
+
+
+def test_non_associative_serial():
+    h = _mk(40_000, seed=5)
+    # a deliberately order-sensitive fold: build a "hash" of indices
+    f = F.fold_spec(
+        name="order-hash", associative=False,
+        reducer_identity=lambda: 0,
+        reducer=lambda acc, op: (acc * 31 + op.index) % (2 ** 61 - 1))
+    got = F.Folder(h).fold(f)
+    want = 0
+    for op in h:
+        want = (want * 31 + op.index) % (2 ** 61 - 1)
+    assert got == want
+
+
+def test_associative_without_combiner_raises():
+    f = F.fold_spec(reducer_identity=lambda: 0,
+                    reducer=lambda a, o: a + 1)
+    with pytest.raises(TypeError):
+        F.Folder(_mk(10)).fold(f)
+
+
+def test_folder_over_lazy_history(tmp_path):
+    from jepsen_tpu.store.format import CHUNK_SIZE, JepsenFile
+
+    n = CHUNK_SIZE + 100
+    ops = []
+    for i in range(n // 2):
+        ops.append(invoke(i % 5, "read", None))
+        ops.append(ok(i % 5, "read", i))
+    p = str(tmp_path / "t.jepsen")
+    JepsenFile(p).write_test({"name": "f"}, History(ops))
+    lh = JepsenFile(p).read_history()
+    folder = F.Folder(lh)
+    assert folder.fold(F.count_fold()) == len(ops)
+    assert len(folder._chunks) == 2

@@ -1,0 +1,58 @@
+"""Elle viz tests: cycle witnesses -> SVG files (SURVEY.md §2.3 viz.clj)."""
+
+import os
+
+from jepsen_tpu.checkers.elle import oracle, viz
+from jepsen_tpu.workloads import synth
+
+
+def test_render_cycle_basic(tmp_path):
+    cycle = [{"src": 0, "rel": "ww", "dst": 4},
+             {"src": 4, "rel": "rw", "dst": 8},
+             {"src": 8, "rel": "wr", "dst": 0}]
+    p = str(tmp_path / "c.svg")
+    out = viz.render_cycle(cycle, p, title="G2 demo")
+    svg = open(out).read()
+    assert svg.startswith("<svg")
+    assert svg.count("<circle") == 3
+    assert "ww" in svg and "rw" in svg and "wr" in svg
+    assert "G2 demo" in svg
+
+
+def test_write_anomalies_from_real_check(tmp_path):
+    h = synth.la_history(n_txns=120, n_keys=5, concurrency=5, seed=13)
+    synth.inject_wr_cycle(h)
+    res = oracle.check(h, ["serializable"])
+    assert res["valid?"] is False
+    out_dir = str(tmp_path / "elle")
+    written = viz.write_anomalies(res, out_dir, history=h)
+    assert written, "no SVGs written for a failing check"
+    for p in written:
+        assert os.path.exists(p)
+        content = open(p).read()
+        assert content.startswith("<svg") and "cycle" in content
+    assert res["viz-files"] == written
+
+
+def test_write_anomalies_noop_for_non_cycles(tmp_path):
+    res = {"anomalies": {"duplicate-elements": [{"count": 3}]}}
+    assert viz.write_anomalies(res, str(tmp_path / "e")) == []
+    assert "viz-files" not in res
+
+
+def test_viz_for_test_only_on_invalid(tmp_path):
+    res = {"valid?": True, "anomalies": {}}
+    assert viz.viz_for_test(res, {"name": "x",
+                                  "store-dir": str(tmp_path)}) == []
+
+
+def test_append_checker_writes_viz(tmp_path):
+    from jepsen_tpu.workloads.append import AppendChecker
+
+    h = synth.la_history(n_txns=120, n_keys=5, concurrency=5, seed=17)
+    synth.inject_wr_cycle(h)
+    test = {"name": "viz-run", "store-dir": str(tmp_path / "s")}
+    res = AppendChecker().check(test, h)
+    assert res["valid?"] is False
+    files = res.get("viz-files") or []
+    assert files and all("elle" in os.path.dirname(f) for f in files)
