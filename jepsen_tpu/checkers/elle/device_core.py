@@ -98,3 +98,37 @@ def core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
     counts = [out["counts"][n].astype(jnp.int32) for n in COUNT_NAMES]
     bits = jnp.stack(counts + cyc_bits + [conv_all.astype(jnp.int32)])
     return bits, overflow
+
+
+MAX_K_CAP = 8192
+MAX_ROUNDS_CAP = 1024
+
+
+def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
+                     max_rounds: int = 64):
+    """core_check with host-side rebatching until exact.
+
+    If the sweep overflows its backward-edge budget, retry with the budget
+    grown to cover the observed count; if the fixpoint hits max_rounds,
+    retry with doubled rounds.  Gives up (returning the last, inexact
+    result) only at the caps — callers then fall back to the host oracle.
+    Returns (bits, overflowed) like core_check; exact iff
+    bits[-1] == 1 and overflowed == 0.
+    """
+    import numpy as np
+
+    while True:
+        bits, over = core_check(h, n_keys, max_k=max_k,
+                                max_rounds=max_rounds)
+        over_i = int(np.asarray(over))
+        conv = int(np.asarray(bits)[-1]) == 1
+        if over_i > 0 and max_k < MAX_K_CAP:
+            need = max_k + over_i
+            while max_k < need:
+                max_k *= 2
+            max_k = min(max_k, MAX_K_CAP)
+            continue
+        if not conv and over_i == 0 and max_rounds < MAX_ROUNDS_CAP:
+            max_rounds = min(max_rounds * 2, MAX_ROUNDS_CAP)
+            continue
+        return bits, over

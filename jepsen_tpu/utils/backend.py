@@ -1,0 +1,39 @@
+"""Backend selection workarounds — single canonical copy.
+
+The axon (TPU tunnel) PJRT plugin is registered at interpreter startup by
+sitecustomize.  Backend *initialization* dials the TPU relay even under
+``JAX_PLATFORMS=cpu``, so any process that wants the CPU backend must drop
+the axon/tpu backend factories before the first jax backend init.  Used by
+tests/conftest.py, bench.py, and __graft_entry__.py — keep the knowledge
+here, in one place (it touches the private jax._src.xla_bridge API).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend(n_devices: int | None = None) -> None:
+    """Make jax use the CPU backend, optionally with ``n_devices`` virtual
+    host devices.  Must run before the first jax backend initialization;
+    safe to call again after (no-op beyond config updates).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        for name in ("axon", "tpu"):
+            getattr(_xb, "_backend_factories", {}).pop(name, None)
+        # a caller (or pytest plugin) may have imported jax before us,
+        # binding jax_platforms to the outer env — override the config too
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

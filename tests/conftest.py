@@ -1,31 +1,17 @@
 """Test configuration.
 
 Tests run on CPU with 8 virtual devices so multi-chip sharding paths
-(jax.sharding.Mesh / shard_map) are exercised without TPU hardware, per the
-project's environment contract.  Must run before jax initializes.
+(jax.sharding.Mesh / shard_map) are exercised without TPU hardware, per
+the project's environment contract.  Must run before jax initializes —
+the canonical axon-factory-drop workaround lives in
+jepsen_tpu.utils.backend (which imports no jax at module scope).
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon (TPU tunnel) PJRT plugin is registered at interpreter startup by
-# sitecustomize — before this conftest runs.  Backend *initialization* would
-# dial the TPU relay even under JAX_PLATFORMS=cpu, so tests must drop the
-# factory before any jax backend init.
-try:
-    import jax
-    import jax._src.xla_bridge as _xb
+from jepsen_tpu.utils.backend import force_cpu_backend
 
-    for _name in ("axon", "tpu"):
-        getattr(_xb, "_backend_factories", {}).pop(_name, None)
-    # a pytest plugin may have imported jax before this conftest, binding
-    # jax_platforms to the outer env's "axon" — override it too
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+force_cpu_backend(8)

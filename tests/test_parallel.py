@@ -1,0 +1,68 @@
+"""Batched/sharded checking paths (parallel/batch.py, device_core exact).
+
+Differential style per SURVEY.md §4: sharded and rebatched results must
+equal the plain single-device verdicts.
+"""
+
+import numpy as np
+
+from jepsen_tpu.checkers.elle.device_core import (
+    core_check,
+    core_check_exact,
+)
+from jepsen_tpu.checkers.elle.device_infer import pad_packed
+from jepsen_tpu.history.soa import pack_txns
+from jepsen_tpu.parallel.batch import check_batch, make_mesh
+from jepsen_tpu.workloads import synth
+
+
+def test_check_batch_unsharded():
+    ps = [synth.packed_la_history(n_txns=48, n_keys=4, seed=s)
+          for s in range(4)]
+    results = check_batch(ps)
+    assert len(results) == 4
+    assert all(r["valid?"] is True for r in results)
+
+
+def test_check_batch_sharded_non_divisible():
+    # 10 histories on an 8-device mesh: batch must be padded to 16 and
+    # the padding rows dropped
+    mesh = make_mesh(8)
+    ps = [synth.packed_la_history(n_txns=48, n_keys=4, seed=s)
+          for s in range(10)]
+    results = check_batch(ps, mesh=mesh)
+    assert len(results) == 10
+    assert all(r["valid?"] is True for r in results)
+
+
+def _cyclic_packed(seed=5, n_inject=8):
+    h = synth.la_history(n_txns=120, n_keys=5, concurrency=6,
+                         multi_append_prob=0.2, seed=seed)
+    for _ in range(n_inject):
+        synth.inject_wr_cycle(h)
+        synth.inject_rw_cycle(h)
+    return pack_txns(h, "list-append")
+
+
+def test_core_check_exact_rebatches_overflow():
+    p = _cyclic_packed()
+    hp = pad_packed(p)
+    _, over_small = core_check(hp, p.n_keys, max_k=2)
+    assert int(np.asarray(over_small)) > 0, "fixture must overflow max_k=2"
+
+    bits, over = core_check_exact(hp, p.n_keys, max_k=2, max_rounds=8)
+    bits_ref, over_ref = core_check(hp, p.n_keys)
+    assert int(np.asarray(over)) == int(np.asarray(over_ref)) == 0
+    assert np.array_equal(np.asarray(bits), np.asarray(bits_ref))
+    assert int(np.asarray(bits)[-1]) == 1  # converged
+
+
+def test_check_batch_recovers_overflowed_history():
+    # a batch mixing valid histories with one that overflows the default
+    # budget path at small max_k must still get a definitive verdict
+    ps = [synth.packed_la_history(n_txns=48, n_keys=4, seed=s)
+          for s in range(3)] + [_cyclic_packed()]
+    results = check_batch(ps)
+    assert [r["valid?"] for r in results[:3]] == [True, True, True]
+    assert results[3]["valid?"] is False  # injected cycles, definitive
+    assert results[3]["exact"] is True
