@@ -96,9 +96,20 @@ def _arm_watchdog(deadline_s: float):
     return done
 
 
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
 def _emit(payload):
-    print(json.dumps(payload))
-    sys.stdout.flush()
+    """Print the result line exactly once, even when the deadline
+    watchdog and the main thread race at the boundary."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        print(json.dumps(payload))
+        sys.stdout.flush()
 
 
 def main():

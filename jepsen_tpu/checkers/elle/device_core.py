@@ -104,22 +104,21 @@ MAX_K_CAP = 8192
 MAX_ROUNDS_CAP = 1024
 
 
-def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
-                     max_rounds: int = 64):
-    """core_check with host-side rebatching until exact.
+def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
+                     round_to: int = 1):
+    """Host-side rebatch policy, shared by every fused-check caller.
 
-    If the sweep overflows its backward-edge budget, retry with the budget
-    grown to cover the observed count; if the fixpoint hits max_rounds,
-    retry with doubled rounds.  Gives up (returning the last, inexact
-    result) only at the caps — callers then fall back to the host oracle.
-    Returns (bits, overflowed) like core_check; exact iff
-    bits[-1] == 1 and overflowed == 0.
+    `run(max_k, max_rounds)` -> (bits, overflowed).  If the sweep
+    overflows its backward-edge budget, retry with the budget grown past
+    the observed count (rounded up to a multiple of `round_to` — mesh
+    size for sharded sweeps); if the fixpoint hits max_rounds, retry with
+    doubled rounds.  Gives up (returning the last, inexact result) only
+    at the caps — callers then fall back to the host oracle.
     """
     import numpy as np
 
     while True:
-        bits, over = core_check(h, n_keys, max_k=max_k,
-                                max_rounds=max_rounds)
+        bits, over = run(max_k, max_rounds)
         over_i = int(np.asarray(over))
         conv = int(np.asarray(bits)[-1]) == 1
         if over_i > 0 and max_k < MAX_K_CAP:
@@ -127,8 +126,20 @@ def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
             while max_k < need:
                 max_k *= 2
             max_k = min(max_k, MAX_K_CAP)
+            if max_k % round_to:
+                max_k = ((max_k // round_to) + 1) * round_to
             continue
         if not conv and over_i == 0 and max_rounds < MAX_ROUNDS_CAP:
             max_rounds = min(max_rounds * 2, MAX_ROUNDS_CAP)
             continue
         return bits, over
+
+
+def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
+                     max_rounds: int = 64):
+    """core_check with host-side rebatching until exact.  Returns
+    (bits, overflowed) like core_check; exact iff bits[-1] == 1 and
+    overflowed == 0."""
+    return grow_until_exact(
+        lambda k, r: core_check(h, n_keys, max_k=k, max_rounds=r),
+        max_k, max_rounds)

@@ -73,15 +73,24 @@ class SweepGraph:
     chain_mask: jnp.ndarray    # (C,) bool
 
 
-def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
+def _sweep_window(n_nodes: int, k_total: int, k_local: int, max_rounds: int,
                   rank, nc_src, nc_dst, nc_mask,
-                  chain_nodes, chain_starts, chain_mask):
-    """Core kernel.  Returns (has_cycle, witness_bits, n_backward, converged).
+                  chain_nodes, chain_starts, chain_mask,
+                  k_offset, axis_name=None):
+    """Sweep kernel over a window of the backward-edge axis.
 
-    witness_bits: (max_k,) int8 — 1 for backward edges on some cycle.
-    n_backward: actual number of backward edges found (may exceed max_k —
-    caller must re-batch; we still compute exactly for the first max_k and
-    report overflow via n_backward).
+    Each caller owns backward edges with global ids in
+    [k_offset, k_offset + k_local) and propagates only their (N, k_local)
+    label planes — backward-edge columns are fully independent until the
+    tiny meta-graph closure, which is the ONLY cross-window coupling.  With
+    `axis_name` set (inside shard_map over a mesh axis of
+    k_total // k_local devices) the local meta rows are combined with an
+    ICI all_gather and convergence with a psum; every device then holds the
+    full (k_total, k_total) meta graph and computes the closure redundantly
+    (it is k_total^2 bytes — trivial next to the label planes).
+
+    Returns (has_cycle, witness_bits (k_total,), n_backward, converged) —
+    replicated across the axis when axis_name is set.
     """
     # ---- split edges: backward iff rank[src] >= rank[dst] -----------------
     # (chain edges are forward by construction: caller guarantees ranks
@@ -94,25 +103,31 @@ def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
     # stable enumeration of backward edges: order by edge position
     back_order = jnp.cumsum(is_back.astype(jnp.int32)) - 1  # id per back edge
     back_id = jnp.where(is_back, back_order, -1)
-    in_budget = is_back & (back_id < max_k)
 
-    # backward edge endpoints, gathered into (max_k,) tables
-    E = nc_src.shape[0]
-    sink = max_k
-    scat_idx = jnp.where(in_budget, back_id, sink).astype(jnp.int32)
-    bsrc = jnp.zeros((max_k + 1,), jnp.int32).at[scat_idx].max(
-        jnp.where(in_budget, nc_src, 0))[:max_k]
-    bdst = jnp.zeros((max_k + 1,), jnp.int32).at[scat_idx].max(
-        jnp.where(in_budget, nc_dst, 0))[:max_k]
-    bvalid = (jnp.arange(max_k) < n_back)
+    # full-width source table (identical on every window — needed for the
+    # meta-graph columns)
+    in_full = is_back & (back_id < k_total)
+    scat_full = jnp.where(in_full, back_id, k_total).astype(jnp.int32)
+    bsrc_full = jnp.zeros((k_total + 1,), jnp.int32).at[scat_full].max(
+        jnp.where(in_full, nc_src, 0))[:k_total]
+    bvalid_full = (jnp.arange(k_total) < n_back)
+
+    # local window endpoints
+    in_local = is_back & (back_id >= k_offset) & (back_id < k_offset + k_local)
+    scat_local = jnp.where(in_local, back_id - k_offset,
+                           k_local).astype(jnp.int32)
+    bdst_local = jnp.zeros((k_local + 1,), jnp.int32).at[scat_local].max(
+        jnp.where(in_local, nc_dst, 0))[:k_local]
+    bvalid_local = (jnp.arange(k_local) + k_offset) < n_back
 
     fwd_mask = nc_mask & ~is_back  # forward non-chain edges only
 
     def propagate(_):
-        # labels: (N, max_k) int8; seed label[bdst[e], e] = 1
-        labels0 = jnp.zeros((n_nodes, max_k), jnp.int8)
-        labels0 = labels0.at[jnp.where(bvalid, bdst, 0),
-                             jnp.arange(max_k)].max(bvalid.astype(jnp.int8))
+        # labels: (N, k_local) int8; seed label[bdst[e], e] = 1
+        labels0 = jnp.zeros((n_nodes, k_local), jnp.int8)
+        labels0 = labels0.at[jnp.where(bvalid_local, bdst_local, 0),
+                             jnp.arange(k_local)].max(
+            bvalid_local.astype(jnp.int8))
 
         def chain_pass(labels):
             vals = gather_rows(labels, chain_nodes, chain_mask)
@@ -139,26 +154,41 @@ def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
         # type matches the body's outputs under shard_map
         changed0 = n_back >= 0                 # always True, varying-typed
         rounds0 = jnp.where(n_back < 0, 1, 0)  # always 0, varying-typed
+        if axis_name is not None:
+            # the label plane is varying over the mesh axis (its window
+            # depends on axis_index), so the whole carry must be too
+            changed0 = jax.lax.pcast(changed0, axis_name, to="varying")
+            rounds0 = jax.lax.pcast(rounds0, axis_name, to="varying")
         labels, changed, rounds = jax.lax.while_loop(
             cond, body, (chain_pass(labels0), changed0, rounds0))
         converged = ~(changed & (rounds >= max_rounds))
 
-        # meta-graph closure: meta[e, e2] = dst(e) ->* src(e2), read from
-        # labels[src(e2), e]
-        meta = gather_rows(labels, bsrc, bvalid).T
-        meta = meta & bvalid[:, None].astype(jnp.int8) \
-                    & bvalid[None, :].astype(jnp.int8)
+        # meta-graph rows for the local window: meta[e, e2] = dst(e) ->*
+        # src(e2), read from labels[src(e2), e]
+        meta_local = gather_rows(labels, bsrc_full, bvalid_full).T
+        if axis_name is not None:
+            meta = jax.lax.all_gather(meta_local, axis_name, axis=0,
+                                      tiled=True)
+            # psum/pmax outputs are replicated over the axis — required for
+            # the P() out_specs of the enclosing shard_map
+            n_bad = jax.lax.psum((~converged).astype(jnp.int32), axis_name)
+            converged = n_bad == 0
+            meta = jax.lax.pmax(meta, axis_name)
+        else:
+            meta = meta_local
+        meta = meta & bvalid_full[:, None].astype(jnp.int8) \
+                    & bvalid_full[None, :].astype(jnp.int8)
 
         def close_body(_, r):
             ri = r.astype(jnp.int32)
             r2 = ((ri @ ri) > 0).astype(jnp.int8)
             return r | r2
 
-        n_sq = max(1, int(np.ceil(np.log2(max(2, max_k)))))
+        n_sq = max(1, int(np.ceil(np.log2(max(2, k_total)))))
         closure = jax.lax.fori_loop(0, n_sq, close_body, meta)
         # backward edge e is on a cycle iff closure[e][e] (dst ->* src,
         # then the edge src -> dst itself closes it)
-        witness = jnp.diagonal(closure) & bvalid.astype(jnp.int8)
+        witness = jnp.diagonal(closure) & bvalid_full.astype(jnp.int8)
         return jnp.any(witness == 1), witness, converged
 
     def acyclic(_):
@@ -167,12 +197,29 @@ def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
         # valid histories; this skip is the fast path)
         # zeros derived from n_back so the varying-axis type matches the
         # propagate branch under shard_map
-        zeros = jnp.zeros((max_k,), jnp.int8) + (n_back * 0).astype(jnp.int8)
+        zeros = jnp.zeros((k_total,), jnp.int8) + (n_back * 0).astype(jnp.int8)
         return (n_back < 0, zeros, n_back >= 0)
 
     has_cycle, witness, converged = jax.lax.cond(
         n_back > 0, propagate, acyclic, operand=None)
     return has_cycle, witness, n_back, converged
+
+
+def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
+                  rank, nc_src, nc_dst, nc_mask,
+                  chain_nodes, chain_starts, chain_mask):
+    """Core kernel (single window).  Returns (has_cycle, witness_bits,
+    n_backward, converged).
+
+    witness_bits: (max_k,) int8 — 1 for backward edges on some cycle.
+    n_backward: actual number of backward edges found (may exceed max_k —
+    caller must re-batch; we still compute exactly for the first max_k and
+    report overflow via n_backward).
+    """
+    return _sweep_window(n_nodes, max_k, max_k, max_rounds,
+                         rank, nc_src, nc_dst, nc_mask,
+                         chain_nodes, chain_starts, chain_mask,
+                         k_offset=jnp.int32(0), axis_name=None)
 
 
 _sweep = jax.jit(_sweep_arrays,

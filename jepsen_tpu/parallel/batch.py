@@ -122,9 +122,9 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
             # inexact (backward-edge overflow or fixpoint truncation):
             # re-run this history alone, seeding the budget past the
             # overflow already observed so the failed config isn't repeated
-            k0 = 128
-            while k0 < 128 + int(over[i]):
-                k0 *= 2
+            from jepsen_tpu.checkers.elle.device_infer import pow2_at_least
+
+            k0 = pow2_at_least(128 + int(over[i]), floor=128)
             h_i = jax.tree_util.tree_map(lambda x: x[i], batch)
             b2, o2 = core_check_exact(h_i, n_keys, max_k=k0)
             row = np.asarray(b2)

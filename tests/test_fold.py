@@ -102,4 +102,111 @@ def test_folder_over_lazy_history(tmp_path):
     lh = JepsenFile(p).read_history()
     folder = F.Folder(lh)
     assert folder.fold(F.count_fold()) == len(ops)
-    assert len(folder._chunks) == 2
+    assert len(folder._thunks) == 2
+
+
+def test_folder_lazy_history_not_materialized(tmp_path):
+    # binding a Folder to a LazyHistory must not decode every chunk up
+    # front (ADVICE round 1): decode happens inside the pass, bounded by
+    # the LazyHistory's own LRU
+    from jepsen_tpu.store.format import CHUNK_SIZE, JepsenFile
+
+    n = 3 * CHUNK_SIZE
+    ops = []
+    for i in range(n // 2):
+        ops.append(invoke(i % 5, "read", None))
+        ops.append(ok(i % 5, "read", i))
+    p = str(tmp_path / "t.jepsen")
+    JepsenFile(p).write_test({"name": "f"}, History(ops))
+    lh = JepsenFile(p).read_history()
+    folder = F.Folder(lh)
+    assert len(lh._cache) == 0  # nothing decoded yet
+    assert folder.fold(F.count_fold()) == len(ops)
+    assert len(lh._cache) > 0
+
+
+def test_folder_empty_lazy_columnar(tmp_path):
+    from jepsen_tpu.store.format import JepsenFile
+
+    p = str(tmp_path / "e.jepsen")
+    JepsenFile(p).write_test({"name": "e"}, History([]))
+    lh = JepsenFile(p).read_history()
+    assert F.Folder(lh, columnar=True).fold(F.count_fold()) == 0
+
+
+def test_folder_rejects_raw_dict_chunks():
+    # a history passed as raw op dicts must error, not fold garbage
+    with pytest.raises(TypeError):
+        F.Folder([{"type": "ok", "f": "read"}, {"type": "ok", "f": "w"}])
+
+
+def test_concurrent_submit_fusion():
+    import concurrent.futures as fut
+
+    h = _mk(20_000, seed=7)
+    with F.Folder(h) as folder:
+        futures = [folder.submit(F.count_fold()) for _ in range(6)]
+        futures.append(folder.submit(F.group_count_fold(lambda o: o.f)))
+        done = fut.wait(futures, timeout=30)
+        assert not done.not_done
+        assert all(f.result() == 20_000 for f in futures[:6])
+        want = {}
+        for o in h:
+            want[o.f] = want.get(o.f, 0) + 1
+        assert futures[6].result() == want
+
+
+def test_submit_error_delivered():
+    h = _mk(1000, seed=8)
+
+    def boom(acc, op):
+        raise RuntimeError("bad reducer")
+
+    f = F.fold_spec(name="boom", reducer_identity=lambda: 0, reducer=boom,
+                    combiner_identity=lambda: 0,
+                    combiner=lambda a, b: a + b)
+    with F.Folder(h) as folder:
+        with pytest.raises(RuntimeError):
+            folder.submit(f).result(timeout=30)
+
+
+def test_columnar_folds_match_per_op():
+    h = _mk(30_000, seed=9)
+    per_op = F.Folder(h)
+    col = F.Folder(h, columnar=True)
+    assert col.fold(F.count_fold()) == per_op.fold(F.count_fold())
+    assert col.fold(F.type_count_fold()) == per_op.fold(F.type_count_fold())
+    assert col.fold(F.group_count_fold(column="f")) == \
+        per_op.fold(F.group_count_fold(column="f"))
+
+
+def test_columnar_throughput_1m():
+    # VERDICT round 1 done-bar: fold throughput >= 1e6 ops/s on a 1M-op
+    # history (fused columnar pass; includes the one-time column build)
+    import time
+
+    h = _mk(1_000_000, seed=10)
+    t0 = time.perf_counter()
+    folder = F.Folder(h, columnar=True)
+    n, by_type = folder.fold_many([F.count_fold(), F.type_count_fold()])
+    dt = time.perf_counter() - t0
+    assert n == 1_000_000
+    assert sum(by_type.values()) == 1_000_000
+    assert n / dt >= 1_000_000, f"fold throughput {n / dt:.0f} ops/s"
+    # columns are memoized: a second pass must be far faster
+    t0 = time.perf_counter()
+    folder.fold(F.type_count_fold())
+    assert time.perf_counter() - t0 < dt
+
+
+def test_stats_checker_columnar_matches_loop():
+    from jepsen_tpu.checkers.api import Stats
+
+    h = _mk(80_000, seed=11)  # above COLUMNAR_MIN -> columnar path
+    st = Stats()
+    got = st.check({}, h)
+    by_f, total = Stats._loop_counts(h)
+    assert got["count"] == sum(total.values())
+    assert got["ok-count"] == total["ok"]
+    for f, c in by_f.items():
+        assert got["by-f"][f]["count"] == sum(c.values())

@@ -9,8 +9,10 @@ from jepsen_tpu.history.ops import history, invoke, ok
 from jepsen_tpu.workloads import kafka
 
 
-def _run(tmp_path, client, *, n_ops=60, crash_frac=0.0, seed=1):
-    wl = kafka.workload(rng=random.Random(seed), crash_frac=crash_frac)
+def _run(tmp_path, client, *, n_ops=60, crash_frac=0.0,
+         subscribe_frac=0.0, txn_frac=0.0, seed=1):
+    wl = kafka.workload(rng=random.Random(seed), crash_frac=crash_frac,
+                        subscribe_frac=subscribe_frac, txn_frac=txn_frac)
     t = {
         "name": "kafka-test", "nodes": ["n1", "n2"], "client": client,
         "concurrency": 4, "store-dir": str(tmp_path / "s"),
@@ -94,7 +96,7 @@ def test_checker_nonmonotonic_poll():
     assert "nonmonotonic-poll" in res["anomaly-types"]
 
 
-def test_checker_skipped_poll():
+def test_checker_int_poll_skip():
     h = history([
         invoke(0, "poll", [("poll", None)]),
         ok(0, "poll", [("poll", {0: [(0, "a"), (2, "c")]})]),  # skipped 1
@@ -103,7 +105,121 @@ def test_checker_skipped_poll():
     ])
     res = kafka.KafkaChecker().check({}, h)
     assert res["valid?"] is False
-    assert "skipped-poll" in res["anomaly-types"]
+    assert "int-poll-skip" in res["anomaly-types"]
+
+
+def test_checker_poll_skip_cross_batch():
+    h = history([
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(0, "a")]})]),
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(2, "c")]})]),  # skipped 1 across polls
+        invoke(1, "poll", [("poll", None)]),
+        ok(1, "poll", [("poll", {0: [(1, "b")]})]),  # 1 does exist
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is False
+    assert "poll-skip" in res["anomaly-types"]
+
+
+def test_checker_redelivery_after_assign_is_legal():
+    # ADVICE round 1: consumers seek back to the committed offset on
+    # (re)assign, so the same poll repeating after an assign must NOT be
+    # a nonmonotonic-poll
+    h = history([
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(0, "a"), (1, "b")]})]),
+        invoke(0, "assign", [0]),
+        ok(0, "assign", [0]),
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(0, "a"), (1, "b")]})]),  # re-delivery
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is True, res["anomalies"]
+
+
+def test_checker_nonmonotonic_send():
+    h = history([
+        invoke(0, "send", [("send", 0, 1)]),
+        ok(0, "send", [("send", 0, (5, 1))]),
+        invoke(0, "send", [("send", 0, 2)]),
+        ok(0, "send", [("send", 0, (3, 2))]),  # offset went backwards
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is False
+    assert "nonmonotonic-send" in res["anomaly-types"]
+
+
+def test_checker_int_send_skip():
+    h = history([
+        invoke(0, "txn", [("send", 0, 1), ("send", 0, 2)]),
+        ok(0, "txn", [("send", 0, (0, 1)), ("send", 0, (4, 2))]),
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is False
+    assert "int-send-skip" in res["anomaly-types"]
+
+
+def test_checker_precommitted_read():
+    h = history([
+        invoke(1, "poll", [("poll", None)]),
+        ok(1, "poll", [("poll", {0: [(0, "x")]})]),   # sees x ...
+        invoke(0, "send", [("send", 0, "x")]),
+        ok(0, "send", [("send", 0, (0, "x"))]),        # ... before commit
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is False
+    assert "precommitted-read" in res["anomaly-types"]
+
+
+def test_checker_unseen_reported_not_invalid():
+    h = history([
+        invoke(0, "send", [("send", 0, 1)]),
+        ok(0, "send", [("send", 0, (0, 1))]),
+        invoke(0, "send", [("send", 0, 2)]),
+        ok(0, "send", [("send", 0, (1, 2))]),
+        invoke(1, "poll", [("poll", None)]),
+        ok(1, "poll", [("poll", {0: [(0, 1)]})]),  # offset 1 not yet seen
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is True
+    assert res["unseen"] == {0: 1}
+
+
+def test_kafka_subscribe_rebalance_run(tmp_path):
+    # group-managed consumption with rebalances stays valid
+    done = _run(tmp_path, kafka.KafkaClient(), subscribe_frac=0.25,
+                n_ops=120, seed=11)
+    res = done["results"]
+    assert res["valid?"] is True, res["anomalies"]
+    assert res["poll-count"] > 0
+
+
+def test_kafka_txn_ops_run(tmp_path):
+    done = _run(tmp_path, kafka.KafkaClient(), txn_frac=0.4, n_ops=100,
+                seed=12)
+    res = done["results"]
+    assert res["valid?"] is True, res["anomalies"]
+
+
+def test_checker_group_rebalance_seek_is_legal():
+    # a rebalance triggered by ANOTHER member moves a partition away and
+    # back; the returning consumer resumes from the group's committed
+    # offset.  Its own op stream has no assign/subscribe, so only the
+    # attached rebalance generation can mark the epoch change.
+    h = history([
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(0, "a"), (1, "b")]})],
+           ext={"rebalance": 1}),
+        invoke(1, "poll", [("poll", None)]),
+        ok(1, "poll", [("poll", {0: [(2, "c"), (3, "d")]})],
+           ext={"rebalance": 2}),
+        invoke(0, "poll", [("poll", None)]),
+        ok(0, "poll", [("poll", {0: [(4, "e")]})],
+           ext={"rebalance": 3}),  # jumped 1 -> 4: legal, epoch changed
+    ])
+    res = kafka.KafkaChecker().check({}, h)
+    assert res["valid?"] is True, res["anomalies"]
 
 
 def test_checker_empty_unknown():

@@ -1,0 +1,129 @@
+"""Task scheduler tests (reference `jepsen/history/task.clj` strategy:
+DAG ordering, cancellation cascade, failure propagation, stress)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu.history.task import (
+    CancelledError,
+    TaskExecutor,
+)
+
+
+def test_simple_chain():
+    with TaskExecutor(4) as ex:
+        a = ex.submit(lambda: 2, name="a")
+        b = ex.submit(lambda x: x * 3, deps=[a], name="b")
+        c = ex.submit(lambda x: x + 1, deps=[b], name="c")
+        assert c.result(5) == 7
+
+
+def test_fanin_receives_dep_results_in_order():
+    with TaskExecutor(4) as ex:
+        parts = [ex.submit(lambda i=i: i, name=f"p{i}") for i in range(5)]
+        total = ex.submit(lambda *xs: list(xs), deps=parts, name="sum")
+        assert total.result(5) == [0, 1, 2, 3, 4]
+
+
+def test_failure_cascades():
+    with TaskExecutor(2) as ex:
+        a = ex.submit(lambda: 1 / 0, name="boom")
+        b = ex.submit(lambda x: x, deps=[a], name="child")
+        with pytest.raises(ZeroDivisionError):
+            a.result(5)
+        with pytest.raises(ZeroDivisionError):
+            b.result(5)
+
+
+def test_submit_on_failed_dep_fails_fast():
+    with TaskExecutor(2) as ex:
+        a = ex.submit(lambda: 1 / 0, name="boom")
+        with pytest.raises(ZeroDivisionError):
+            a.result(5)
+        b = ex.submit(lambda x: x, deps=[a], name="late-child")
+        assert b.done()
+        with pytest.raises(ZeroDivisionError):
+            b.result(5)
+
+
+def test_cancel_cascades_to_dependents():
+    gate = threading.Event()
+    with TaskExecutor(1) as ex:
+        blocker = ex.submit(gate.wait, name="blocker")
+        a = ex.submit(lambda: 1, deps=[blocker], name="a")
+        b = ex.submit(lambda x: x, deps=[a], name="b")
+        assert ex.cancel(a)
+        gate.set()
+        with pytest.raises(CancelledError):
+            a.result(5)
+        with pytest.raises(CancelledError):
+            b.result(5)
+        assert blocker.result(5) is True
+
+
+def test_cancel_running_task_returns_false():
+    gate = threading.Event()
+    started = threading.Event()
+
+    def run():
+        started.set()
+        gate.wait()
+        return "done"
+
+    with TaskExecutor(2) as ex:
+        t = ex.submit(run, name="running")
+        started.wait(5)
+        assert not ex.cancel(t)
+        gate.set()
+        assert t.result(5) == "done"
+
+
+def test_diamond_dag():
+    with TaskExecutor(4) as ex:
+        a = ex.submit(lambda: 1, name="a")
+        b = ex.submit(lambda x: x + 1, deps=[a], name="b")
+        c = ex.submit(lambda x: x + 2, deps=[a], name="c")
+        d = ex.submit(lambda x, y: x * y, deps=[b, c], name="d")
+        assert d.result(5) == 6
+
+
+def test_stress_random_dag():
+    rng = random.Random(42)
+    with TaskExecutor(8) as ex:
+        tasks = []
+        expect = []
+        for i in range(300):
+            k = rng.randint(0, min(3, len(tasks)))
+            dep_idx = rng.sample(range(len(tasks)), k) if tasks else []
+            deps = [tasks[j] for j in dep_idx]
+            t = ex.submit(lambda *xs: sum(xs) + 1, deps=deps, name=f"t{i}")
+            tasks.append(t)
+            expect.append(sum(expect[j] for j in dep_idx) + 1)
+        for t, e in zip(tasks, expect):
+            assert t.result(30) == e
+
+
+def test_dep_ordering_under_contention():
+    # each task appends after its dep: final list must respect DAG order
+    out = []
+    lock = threading.Lock()
+
+    def emit(i):
+        def go(*_):
+            time.sleep(random.random() * 0.002)
+            with lock:
+                out.append(i)
+        return go
+
+    with TaskExecutor(8) as ex:
+        prev = None
+        chain = []
+        for i in range(50):
+            prev = ex.submit(emit(i), deps=[prev] if prev else [],
+                             name=f"c{i}")
+            chain.append(prev)
+        chain[-1].result(30)
+    assert out == list(range(50))
