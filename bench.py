@@ -13,7 +13,12 @@ the CPU backend (recorded in the "backend"/"error" fields).
 
 Env knobs: BENCH_TXNS (default 1,000,000), BENCH_KEYS, BENCH_REPEATS,
 BENCH_FORCE_CPU=1, BENCH_INIT_TIMEOUT (s, default 120),
-BENCH_DEADLINE (s, default 1500).
+BENCH_DEADLINE (s, default 1500), BENCH_CACHE_DIR (persistent XLA
+compilation cache, default <repo>/.jax_cache — repeat runs skip compile).
+
+Exit status: 0 with a real value; 1 on any error/deadline path (the JSON
+line is still printed — consumers may read either the rc or the "error"
+field).
 """
 
 import json
@@ -90,7 +95,7 @@ def _arm_watchdog(deadline_s: float):
             _emit({"metric": "elle-list-append-check-throughput",
                    "value": 0, "unit": "ops/sec", "vs_baseline": 0,
                    "error": f"bench exceeded {deadline_s:.0f}s deadline"})
-            os._exit(0)
+            os._exit(1)
 
     threading.Thread(target=fire, daemon=True).start()
     return done
@@ -128,22 +133,42 @@ def main():
         _emit({"metric": "elle-list-append-check-throughput", "value": 0,
                "unit": "ops/sec", "vs_baseline": 0,
                "error": f"backend init failed: {type(e).__name__}: {e}"})
-        return 0
+        return 1
 
     try:
         import jax
+
+        # Persistent compilation cache: driver reruns (and the 10M config
+        # after a 1M run at the same padded shapes) skip XLA compile —
+        # round 2 measured 125.8 s compile at 100k-txn shapes, the whole
+        # reason BENCH_r02 was a DNF.
+        from jepsen_tpu.utils.backend import enable_compile_cache
+
+        enable_compile_cache()
 
         from jepsen_tpu.checkers.elle.device_core import core_check
         from jepsen_tpu.checkers.elle.device_infer import pad_packed
         from jepsen_tpu.workloads import synth
 
+        t_gen = time.perf_counter()
         p = synth.packed_la_history(n_txns=n_txns, n_keys=n_keys,
                                     mops_per_txn=4, read_frac=0.25, seed=7)
         h = pad_packed(p)
+        t_gen = time.perf_counter() - t_gen
 
-        # warmup (compile)
+        # stage inputs on device BEFORE timing: first dispatch otherwise
+        # pays a synchronous host->device transfer of every padded array
+        # (measured ~30 s at 100k txns in round 2)
+        t_stage = time.perf_counter()
+        h = jax.device_put(h)
+        jax.block_until_ready(h)
+        t_stage = time.perf_counter() - t_stage
+
+        # warmup (compile — or cache hit on reruns)
+        t_compile = time.perf_counter()
         bits, over = core_check(h, p.n_keys)
         jax.block_until_ready(bits)
+        t_compile = time.perf_counter() - t_compile
         assert int(bits[-1]) == 1, "sweep did not converge on bench history"
         assert int(bits[:12].sum()) == 0, "bench history must be valid"
 
@@ -163,6 +188,9 @@ def main():
             "backend": platform,
             "n_txns": n_txns,
             "wall_s": round(best, 3),
+            "gen_s": round(t_gen, 2),
+            "stage_s": round(t_stage, 2),
+            "compile_or_warmup_s": round(t_compile, 2),
         }
         if backend_err:
             payload["backend_init_retried"] = backend_err
@@ -176,7 +204,7 @@ def main():
                "unit": "ops/sec", "vs_baseline": 0,
                "backend": platform,
                "error": f"{type(e).__name__}: {e}", "trace": tb})
-        return 0
+        return 1
 
 
 if __name__ == "__main__":

@@ -105,6 +105,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     chain_starts = jnp.concatenate([pc_starts, bc_starts])
 
     host_edges: EdgeList = None  # lazily materialized for classification
+    explainer = None             # lazily built per-edge Explainer
     needs_fallback = False
     for rels, group in projections.items():
         sel = jnp.zeros_like(base_mask)
@@ -138,8 +139,14 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
                 if hit is not None:
                     break
             if hit is not None:
+                if explainer is None:
+                    from jepsen_tpu.checkers.elle.explain import la_explainer
+
+                    explainer = la_explainer(
+                        p, {k: np.asarray(v)
+                            for k, v in out["order"].items()})
                 found.setdefault(name, []).append(
-                    {"cycle": _render(hit, p, T),
+                    {"cycle": _render(hit, p, T, explainer),
                      "witnesses": int(len(res.witness_edge_ids))})
 
     if needs_fallback:
@@ -243,20 +250,11 @@ def _witness_regions(proj: EdgeList, e_src, e_dst, witness_ids, n_nodes,
     return regions
 
 
-def _render(cyc, p: PackedTxns, T: int):
-    orig = p.txn_orig_index
-    out = []
-    pend_src = None
-    k = next((i for i, (s, _, _) in enumerate(cyc) if s < T), 0)
-    cyc = cyc[k:] + cyc[:k]
-    for (s, rel, d) in cyc:
-        if d >= T:
-            if s < T:
-                pend_src = s
-            continue
-        src = s if s < T else pend_src
-        out.append({"src": int(orig[src]) if src is not None and
-                    src < p.n_txns else src,
-                    "rel": REL_NAMES[rel],
-                    "dst": int(orig[d]) if d < p.n_txns else d})
-    return out
+def _render(cyc, p: PackedTxns, T: int, explainer=None):
+    """Collapse barrier hops and emit reported edges, each carrying the
+    Explainer's per-edge justification (key, values, why) — the
+    reference's `elle/core.clj` Explainer output shape.  Single shared
+    implementation in `txn_cycles._render_cycle`."""
+    from jepsen_tpu.checkers.elle.txn_cycles import _render_cycle
+
+    return _render_cycle(cyc, explainer, T, np.asarray(p.txn_orig_index))

@@ -268,8 +268,14 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
         [consistency.canonical(m) for m in consistency_models]))
     want |= set(anomalies)
     want |= {"duplicate-writes", "cyclic-versions"}
+    from jepsen_tpu.checkers.elle.explain import rw_explainer
+
+    expl = rw_explainer(p, writer, v_src, v_dst,
+                        ext_read_txn=rt[ext_idx],
+                        ext_read_val=ext_read_val[ext_idx])
     found.update(cycle_anomalies(edges, n_nodes, rank, want,
-                                 use_device=use_device))
+                                 use_device=use_device, explainer=expl,
+                                 n_txns=T, orig_index=p.txn_orig_index))
 
     found = {k: val for k, val in found.items() if k in want}
     anomaly_types = sorted(found.keys())

@@ -25,11 +25,19 @@ from jepsen_tpu.checkers.elle.specs import CYCLE_ANOMALY_SPECS, SPEC_ORDER
 
 def cycle_anomalies(edges: EdgeList, n_nodes: int, rank: np.ndarray,
                     want: set, use_device: bool = True,
-                    max_reported: int = 4) -> Dict[str, List[dict]]:
+                    max_reported: int = 4, explainer=None,
+                    n_txns: int = None,
+                    orig_index: np.ndarray = None) -> Dict[str, List[dict]]:
     """Find cycle anomalies among `want` specs over the given edges.
 
     rank: per-node order where most edges go forward (completion order);
     used by the device sweep.  Returns {anomaly: [witness dicts]}.
+
+    `explainer(src, rel_name, dst) -> dict` (see `explain.py`) adds
+    per-edge justification fields to each reported cycle edge — the
+    reference's Explainer protocol.  When `n_txns` is given, nodes >=
+    n_txns (realtime barrier nodes) are collapsed out of reported
+    cycles; `orig_index` maps internal txn ids to history indices.
     """
     specs = [(name, CYCLE_ANOMALY_SPECS[name]) for name in SPEC_ORDER
              if name in want]
@@ -50,10 +58,39 @@ def cycle_anomalies(edges: EdgeList, n_nodes: int, rank: np.ndarray,
                 hit = find_cycle(region, proj, spec)
                 if hit is not None:
                     found.setdefault(name, []).append(
-                        {"cycle": [{"src": int(s), "rel": REL_NAMES[r],
-                                    "dst": int(d)} for (s, r, d) in hit]})
+                        {"cycle": _render_cycle(hit, explainer, n_txns,
+                                                orig_index)})
                     break
     return found
+
+
+def _render_cycle(hit, explainer, n_txns, orig_index) -> List[dict]:
+    """Emit reported edges: collapse barrier hops (nodes >= n_txns) into
+    single realtime edges, map ids to history indices, and attach the
+    Explainer's justification per edge."""
+    if n_txns is None:
+        return [{"src": int(s), "rel": REL_NAMES[r], "dst": int(d)}
+                for (s, r, d) in hit]
+    out = []
+    pend_src = None
+    k = next((i for i, (s, _, _) in enumerate(hit) if s < n_txns), 0)
+    hit = hit[k:] + hit[:k]
+    for (s, r, d) in hit:
+        if d >= n_txns:
+            if s < n_txns:
+                pend_src = s
+            continue
+        src = s if s < n_txns else pend_src
+        rel_name = REL_NAMES[r]
+        edge = {"src": int(orig_index[src]) if orig_index is not None and
+                src is not None and src < len(orig_index) else src,
+                "rel": rel_name,
+                "dst": int(orig_index[d]) if orig_index is not None and
+                d < len(orig_index) else int(d)}
+        if explainer is not None and src is not None:
+            edge.update(explainer(int(src), rel_name, int(d)))
+        out.append(edge)
+    return out
 
 
 def _cycle_regions(proj: EdgeList, n_nodes: int, rank: np.ndarray,

@@ -72,14 +72,20 @@ def render_cycle(cycle: Sequence[dict], path: str,
         x1p, y1p = x1 - dx / d * pad, y1 - dy / d * pad
         rel = str(e.get("rel", "?"))
         color = _REL_COLOR.get(rel, "#333")
+        why = str(e.get("why", "")) if e.get("why") else ""
+        tip = (f"<title>{html.escape(why)}</title>") if why else ""
         parts.append(
             f'<line x1="{x0p:.0f}" y1="{y0p:.0f}" x2="{x1p:.0f}" '
             f'y2="{y1p:.0f}" stroke="{color}" stroke-width="1.6" '
-            f'marker-end="url(#arr)"/>')
+            f'marker-end="url(#arr)">{tip}</line>')
         mx, my = (x0 + x1) / 2, (y0 + y1) / 2
+        label = rel
+        if e.get("key") is not None:
+            label = f'{rel} {e["key"]!r}'
         parts.append(
             f'<text x="{mx:.0f}" y="{my:.0f}" font-size="11" '
-            f'fill="{color}" font-weight="bold">{html.escape(rel)}</text>')
+            f'fill="{color}" font-weight="bold">{html.escape(label)}'
+            f'{tip}</text>')
     for v in nodes:
         x, y = pos[v]
         parts.append(
@@ -93,7 +99,14 @@ def render_cycle(cycle: Sequence[dict], path: str,
             f'<text x="{lx:.0f}" y="{y + 4:.0f}" font-size="9" '
             f'text-anchor="{anchor}" fill="#555">'
             f'{html.escape(_op_label(history, v))}</text>')
-    w, h = 2 * _CX, 2 * _CY
+    # Explainer legend: one line per edge naming the key/values evidence
+    # (the reference's Explainer output, `elle/core.clj`)
+    whys = [str(e["why"]) for e in cycle if e.get("why")]
+    w, h = 2 * _CX, 2 * _CY + (14 * len(whys) + 10 if whys else 0)
+    for i, why in enumerate(whys):
+        parts.append(
+            f'<text x="8" y="{2 * _CY + 14 * (i + 1):.0f}" font-size="10" '
+            f'fill="#333">{i + 1}. {html.escape(why)}</text>')
     svg = (f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" '
            f'height="{h}" font-family="sans-serif">'
            f'<text x="8" y="16" font-size="13">{html.escape(title)}</text>'

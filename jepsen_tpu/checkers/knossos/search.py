@@ -11,12 +11,20 @@ import threading
 import time
 from typing import Any, Optional
 
+import numpy as np
+
 
 class Search:
-    """Shared control block for one search run."""
+    """Shared control block for one search run.
+
+    `flag` is a (1,) int32 shared with native searches: ctypes calls
+    release the GIL, so the C++ WGL polls this memory while another
+    thread aborts — the loser of a competition stops within ~1k configs
+    instead of running out its full budget."""
 
     def __init__(self, *, deadline_s: Optional[float] = None):
         self._abort = threading.Event()
+        self.flag = np.zeros(1, dtype=np.int32)
         self.deadline = (time.monotonic() + deadline_s
                          if deadline_s else None)
         self.explored = 0
@@ -24,12 +32,13 @@ class Search:
 
     def abort(self) -> None:
         self._abort.set()
+        self.flag[0] = 1
 
     def aborted(self) -> bool:
         if self._abort.is_set():
             return True
         if self.deadline is not None and time.monotonic() > self.deadline:
-            self._abort.set()
+            self.abort()
             return True
         return False
 

@@ -145,9 +145,12 @@ def _search_direct(ops: Sequence[LinOp], model: Model,
     return False, {"op-count": n}
 
 
-def _search_native(ops: Sequence[LinOp], memo: Memo, max_configs: int):
+def _search_native(ops: Sequence[LinOp], memo: Memo, max_configs: int,
+                   ctl=None):
     """C++ WGL (jepsen_tpu.native, SURVEY.md §2.5 #2) when available;
-    returns (NotImplemented, None) to fall back to the Python anchor."""
+    returns (NotImplemented, None) to fall back to the Python anchor.
+    `ctl.flag` is shared with the C++ search so a competition can abort
+    it mid-run (the ctypes call releases the GIL)."""
     import os
     if os.environ.get("JT_NO_NATIVE"):
         return NotImplemented, None
@@ -155,10 +158,13 @@ def _search_native(ops: Sequence[LinOp], memo: Memo, max_configs: int):
     res = native.wgl(memo.op_sym,
                      [op.invoke_pos for op in ops],
                      [op.return_pos for op in ops],
-                     NEVER, memo.table, memo.init_state, max_configs)
+                     NEVER, memo.table, memo.init_state, max_configs,
+                     abort_flag=ctl.flag if ctl is not None else None)
     if res is None:
         return NotImplemented, None
-    ok, explored = res
+    ok, explored, aborted = res
+    if aborted:
+        return None, {"reason": "aborted", "explored": explored}
     if ok is None:
         return None, {"reason": "config budget exhausted",
                       "explored": explored}
@@ -167,7 +173,7 @@ def _search_native(ops: Sequence[LinOp], memo: Memo, max_configs: int):
         # (max-linearized, witness configs) when cheap; keep the summary
         # shape when the config space is too big to redo.
         if explored <= 200_000:
-            return _search_memo(ops, memo, max_configs)
+            return _search_memo(ops, memo, max_configs, ctl)
         return False, {"op-count": len(ops), "explored": explored}
     return True, None
 
@@ -175,14 +181,15 @@ def _search_native(ops: Sequence[LinOp], memo: Memo, max_configs: int):
 def check(history: History | Sequence[LinOp], model: Model,
           max_configs: int = 5_000_000, ctl=None) -> Dict[str, Any]:
     """Check linearizability of a single-object history against a model.
-    `ctl` (a `search.Search`) lets a competition abort the Python search;
-    the native path is not abortable but returns quickly or not at all."""
+    `ctl` (a `search.Search`) lets a competition abort the search —
+    both the Python DFS (polled every 4096 configs) and the C++ one
+    (shared abort flag, polled every 1024 configs)."""
     ops = history if isinstance(history, list) else prepare(history)
     if not ops:
         return {"valid?": "unknown", "op-count": 0}
     try:
         memo = memoize(model, ops)
-        ok, info = _search_native(ops, memo, max_configs)
+        ok, info = _search_native(ops, memo, max_configs, ctl)
         if ok is NotImplemented:
             ok, info = _search_memo(ops, memo, max_configs, ctl)
     except StateExplosion:

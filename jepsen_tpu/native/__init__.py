@@ -102,7 +102,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.jt_wgl.argtypes = [ctypes.c_int64, i32p, i64p, i64p,
                                ctypes.c_int64, i32p, ctypes.c_int64,
                                ctypes.c_int64, ctypes.c_int32,
-                               ctypes.c_int64, i64p]
+                               ctypes.c_int64, i64p, i32p]
         _lib = lib
         return _lib
 
@@ -174,10 +174,14 @@ def bfs_cycle(n: int, src, dst, start: int,
 
 
 def wgl(op_sym, invokes, returns, never: int, table: np.ndarray,
-        init_state: int, max_configs: int = 5_000_000
-        ) -> Optional[Tuple[Optional[bool], int]]:
-    """Memoized WGL search.  Returns (verdict, explored) where verdict is
-    True/False/None (budget exhausted), or None if native unavailable."""
+        init_state: int, max_configs: int = 5_000_000,
+        abort_flag: Optional[np.ndarray] = None
+        ) -> Optional[Tuple[Optional[bool], int, bool]]:
+    """Memoized WGL search.  Returns (verdict, explored, aborted) where
+    verdict is True/False/None (budget exhausted or aborted), or None if
+    native unavailable.  `abort_flag` is a shared (1,) int32 array the
+    C++ polls (ctypes releases the GIL, so another thread can set it —
+    the competition's loser-abort path)."""
     lib = _load()
     if lib is None:
         return None
@@ -187,11 +191,19 @@ def wgl(op_sym, invokes, returns, never: int, table: np.ndarray,
     table = np.ascontiguousarray(table, dtype=np.int32)
     n_states, n_syms = table.shape
     explored = np.zeros(1, dtype=np.int64)
+    if abort_flag is not None and (abort_flag.dtype != np.int32
+                                   or abort_flag.size < 1
+                                   or not abort_flag.flags["C_CONTIGUOUS"]):
+        raise TypeError("abort_flag must be a contiguous int32 array "
+                        f"of size >= 1, got {abort_flag.dtype} "
+                        f"size {abort_flag.size}")
     rc = lib.jt_wgl(len(op_sym), _as(op_sym, ctypes.c_int32),
                     _as(invokes, ctypes.c_int64),
                     _as(returns, ctypes.c_int64), never,
                     _as(table, ctypes.c_int32), n_states, n_syms,
                     init_state, max_configs,
-                    _as(explored, ctypes.c_int64))
-    verdict = {1: True, 0: False, -1: None}[int(rc)]
-    return verdict, int(explored[0])
+                    _as(explored, ctypes.c_int64),
+                    _as(abort_flag, ctypes.c_int32)
+                    if abort_flag is not None else None)
+    verdict = {1: True, 0: False, -1: None, -2: None}[int(rc)]
+    return verdict, int(explored[0]), int(rc) == -2

@@ -144,11 +144,16 @@ struct VecHash {
 // op_sym[i]: memoized symbol of op i.  invokes/returns: positions in the
 // total order; returns[i] >= never  <=>  op i crashed (:info) and may
 // linearize or not.  table[state * n_syms + sym] -> next state or -1.
-// Returns 1 linearizable, 0 not, -1 config budget exhausted.
+// abort_flag (may be null): polled every 1024 configs; a nonzero value
+// aborts the search — lets a competition kill the losing contestant
+// (knossos/search.clj ctl semantics) instead of letting the C++ run to
+// its full config budget after the verdict.
+// Returns 1 linearizable, 0 not, -1 config budget exhausted, -2 aborted.
 int32_t jt_wgl(int64_t n_ops, const int32_t* op_sym, const int64_t* invokes,
                const int64_t* returns, int64_t never, const int32_t* table,
                int64_t n_states, int64_t n_syms, int32_t init_state,
-               int64_t max_configs, int64_t* explored_out) {
+               int64_t max_configs, int64_t* explored_out,
+               const volatile int32_t* abort_flag) {
   (void)n_states;
   const int64_t words = (n_ops + 63) / 64;
 
@@ -219,6 +224,10 @@ int32_t jt_wgl(int64_t n_ops, const int32_t* op_sym, const int64_t* invokes,
     if (++explored > max_configs) {
       if (explored_out) *explored_out = explored;
       return -1;
+    }
+    if (abort_flag && (explored & 1023) == 0 && *abort_flag) {
+      if (explored_out) *explored_out = explored;
+      return -2;
     }
     Frame nf{std::move(S2), s2, {}, 0};
     candidates(nf.S, nf.cands);

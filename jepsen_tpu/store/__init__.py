@@ -165,7 +165,10 @@ def tests(name: Optional[str] = None, *, base: Optional[str] = None) -> List[str
             d = os.path.join(nd, ts)
             if ts != "latest" and os.path.isdir(d) and not os.path.islink(d):
                 out.append(d)
-    return sorted(out, reverse=True)
+    # newest run first regardless of test name: order by the timestamp
+    # basename, not the full path (sorting full paths would rank runs by
+    # lexicographically-greatest *name* first)
+    return sorted(out, key=lambda d: os.path.basename(d), reverse=True)
 
 
 def latest(name: Optional[str] = None, *, base: Optional[str] = None) -> Optional[str]:

@@ -37,3 +37,20 @@ def force_cpu_backend(n_devices: int | None = None) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> str:
+    """Point jax at a persistent XLA compilation cache (honors the
+    BENCH_CACHE_DIR env knob; defaults to <repo>/.jax_cache).  Driver
+    reruns and same-shape recompiles then skip XLA compile entirely —
+    round 2 measured 125.8 s of compile at 100k-txn shapes.  Returns the
+    cache dir in use."""
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.environ.get("BENCH_CACHE_DIR") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir

@@ -121,3 +121,43 @@ def test_device_packed_generator_valid():
     p = synth.packed_la_history(n_txns=3000, n_keys=24, seed=11)
     r = list_append.check(p, MODELS, _force_no_fallback=True)
     assert r["valid?"] is True, r["anomaly-types"]
+
+
+def test_explainer_g_single_names_key_and_values():
+    # VERDICT done-bar: a G-single report names the key and read/append
+    # values on EVERY edge (elle/core.clj Explainer equivalence)
+    h = concurrent_history(
+        ([["append", "k", 1], ["append", "j", 10]],
+         [["append", "k", 1], ["append", "j", 10]]),
+        ([["append", "k", 2], ["r", "j", None]],
+         [["append", "k", 2], ["r", "j", []]]),
+        ([["r", "k", None], ["r", "j", None]],
+         [["r", "k", [1, 2]], ["r", "j", [10]]]),
+    )
+    r = list_append.check(h, MODELS, _force_no_fallback=True)
+    assert "G-single" in r["anomalies"]
+    cyc = r["anomalies"]["G-single"][0]["cycle"]
+    assert len(cyc) >= 2
+    for e in cyc:
+        assert e.get("why"), e
+        if e["rel"] in ("ww", "wr", "rw"):
+            assert e.get("key") is not None, e
+            assert ("value" in e) or ("value'" in e), e
+    # the rw (anti-dependency) edge must name the unobserved successor
+    rw = [e for e in cyc if e["rel"] == "rw"]
+    assert rw and rw[0]["value'"] is not None
+
+
+def test_explainer_realtime_edge_positions():
+    h = history([
+        invoke(0, "txn", [["r", "x", None]]),
+        ok(0, "txn", [["r", "x", [1]]]),
+        invoke(1, "txn", [["append", "x", 1]]),
+        ok(1, "txn", [["append", "x", 1]]),
+    ])
+    r = list_append.check(h, MODELS, _force_no_fallback=True)
+    cyc = r["anomalies"]["G1c-realtime"][0]["cycle"]
+    rt = [e for e in cyc if e["rel"] == "realtime"]
+    assert rt and "completed-at" in rt[0] and "invoked-at" in rt[0]
+    wr = [e for e in cyc if e["rel"] == "wr"]
+    assert wr and wr[0]["key"] == "x" and wr[0]["value"] == 1

@@ -172,3 +172,39 @@ def test_bfs_cycle_grows_buffer():
     cyc = native.bfs_cycle(n, src, dst, 0, max_len=4)
     assert cyc is not None and len(cyc) == n + 1
     assert cyc[0] == cyc[-1] == 0
+
+
+def test_wgl_native_abort_flag_stops_search():
+    # a hard (wide-window) invalid history would explore many configs;
+    # with the abort flag pre-set the C++ must stop almost immediately
+    # and report aborted (knossos/search.clj ctl semantics)
+    if not native.available():
+        pytest.skip("native unavailable")
+    from jepsen_tpu.checkers.knossos.memo import memoize
+    from jepsen_tpu.checkers.knossos.prep import prepare
+    from jepsen_tpu.checkers.knossos.search import Search
+    from jepsen_tpu.models import cas_register
+
+    n = 18
+    events = []
+    for i in range(n):  # n fully-concurrent writes, then a bad read
+        events.append(invoke(i, "write", i))
+    for i in range(n):
+        events.append(ok(i, "write", i))
+    events.append(invoke(n, "read", None))
+    events.append(ok(n, "read", 777))  # never written -> must explore all
+    h = history(events)
+    ops = prepare(h)
+    memo = memoize(cas_register(), ops)
+
+    ctl = Search()
+    ctl.abort()
+    res = native.wgl(memo.op_sym,
+                     [op.invoke_pos for op in ops],
+                     [op.return_pos for op in ops],
+                     2 * len(events) + 1, memo.table, memo.init_state,
+                     50_000_000, abort_flag=ctl.flag)
+    assert res is not None
+    verdict, explored, aborted = res
+    assert aborted is True and verdict is None
+    assert explored < 10_000  # stopped within ~1k-config poll window

@@ -154,3 +154,20 @@ def test_aborted_duplicate_does_not_fabricate_g1a():
     res = rw_register.check(h, ["serializable"])
     assert "G1a" not in res["anomaly-types"], res
     assert "duplicate-writes" in res["anomaly-types"]
+
+
+def test_explainer_rw_register_edges_justified():
+    h = concurrent_history(
+        ([["w", "x", 1], ["r", "y", None]],
+         [["w", "x", 1], ["r", "y", 9]]),
+        ([["w", "y", 9], ["r", "x", None]],
+         [["w", "y", 9], ["r", "x", 1]]),
+    )
+    res = rw_register.check(h, ["read-committed"])
+    cyc = res["anomalies"]["G1c"][0]["cycle"]
+    for e in cyc:
+        assert e.get("why"), e
+        if e["rel"] in ("ww", "wr", "rw"):
+            assert e.get("key") is not None, e
+    wr = [e for e in cyc if e["rel"] == "wr"]
+    assert wr and wr[0]["value"] in (1, 9)

@@ -227,3 +227,16 @@ def test_password_not_persisted(tmp_path):
     assert loaded["username"] == "admin"
     raw = open(os.path.join(store.test_dir(t), "test.jepsen"), "rb").read()
     assert b"s3cret" not in raw
+
+
+def test_latest_across_names_orders_by_timestamp(tmp_path):
+    # regression: sorting full paths ranked runs by lexicographically
+    # greatest *name*; latest(None) must return the newest run overall
+    base = str(tmp_path / "store")
+    for name, start in (("zzz-old", 1000.0), ("aaa-new", 5000.0)):
+        t = {"name": name, "store-dir": base, "start-time": start,
+             "history": _mk_history(2), "results": {"valid?": True}}
+        store.save_0(t)
+        store.save_1(t)
+    newest = store.latest(None, base=base)
+    assert newest is not None and "aaa-new" in newest

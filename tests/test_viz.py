@@ -56,3 +56,16 @@ def test_append_checker_writes_viz(tmp_path):
     assert res["valid?"] is False
     files = res.get("viz-files") or []
     assert files and all("elle" in os.path.dirname(f) for f in files)
+
+
+def test_render_cycle_includes_explainer_legend(tmp_path):
+    cyc = [{"src": 0, "rel": "wr", "dst": 2, "key": "x", "value": 1,
+            "why": "T0 read x ending in 1, which T2 appended"},
+           {"src": 2, "rel": "rw", "dst": 0, "key": "x", "value'": 2,
+            "why": "T2 read x up to 1, before T0's append of 2"}]
+    p = str(tmp_path / "c.svg")
+    viz.render_cycle(cyc, p, title="G-single")
+    svg = open(p).read()
+    assert "which T2 appended" in svg          # legend line
+    assert "<title>" in svg                    # hover tooltip
+    assert "wr &#x27;x&#x27;" in svg or "wr 'x'" in svg  # key on label
