@@ -69,24 +69,42 @@ def la_explainer(p: PackedTxns, order: Dict[str, np.ndarray]) -> Explainer:
     def T(t: int):
         return int(orig[t]) if 0 <= t < p.n_txns else t
 
+    # consecutive version pairs (u, v) per key, vectorized once: slot j
+    # pairs with j+1 when both lie inside the same key's order segment
+    n_slots = len(ord_elems)
+    slots = np.arange(max(n_slots - 1, 0))
+    if n_slots > 1:
+        slot_key = np.clip(
+            np.searchsorted(ord_start, slots, side="right") - 1, 0,
+            max(nk - 1, 0))
+        seg_end = ord_start[slot_key] + ord_len[slot_key]
+        pair_ok = (slots + 1 < seg_end)
+        pu = ord_elems[:-1]
+        pv = ord_elems[1:]
+        pair_ok &= (pu >= 0) & (pu < V) & (pv >= 0) & (pv < V)
+        pair_wu = np.where(pair_ok, writer[np.clip(pu, 0, V - 1)], -1)
+        pair_wv = np.where(pair_ok, writer[np.clip(pv, 0, V - 1)], -1)
+    else:
+        slot_key = np.zeros(0, np.int64)
+        pu = pv = pair_wu = pair_wv = np.zeros(0, np.int64)
+
     def explain(a: int, rel: str, b: int) -> Dict:
         if rel == "ww":
             # consecutive versions (u, v) of some key with writer(u)=a,
-            # writer(v)=b
-            for k in range(nk):
-                s, ln = int(ord_start[k]), int(ord_len[k])
-                for j in range(s, s + ln - 1):
-                    u, v = int(ord_elems[j]), int(ord_elems[j + 1])
-                    if 0 <= u < V and 0 <= v < V and \
-                            writer[u] == a and writer[v] == b:
-                        return {
-                            "key": _kname(p, k), "value": _vname(p, u),
-                            "value'": _vname(p, v),
-                            "why": (f"T{T(a)} appended {_vname(p, u)!r} to "
-                                    f"key {_kname(p, k)!r}; T{T(b)} appended "
-                                    f"{_vname(p, v)!r}, its immediate "
-                                    f"successor in the version order"),
-                        }
+            # writer(v)=b (vectorized: a reported cycle must stay cheap
+            # to justify even on 1M-op histories)
+            hits = np.nonzero((pair_wu == a) & (pair_wv == b))[0]
+            if len(hits):
+                j = int(hits[0])
+                k, u, v = int(slot_key[j]), int(pu[j]), int(pv[j])
+                return {
+                    "key": _kname(p, k), "value": _vname(p, u),
+                    "value'": _vname(p, v),
+                    "why": (f"T{T(a)} appended {_vname(p, u)!r} to "
+                            f"key {_kname(p, k)!r}; T{T(b)} appended "
+                            f"{_vname(p, v)!r}, its immediate "
+                            f"successor in the version order"),
+                }
         elif rel == "wr":
             # b read a list whose final element a appended
             for m in np.nonzero((mtxn == b) & (kind == MOP_READ)

@@ -125,23 +125,31 @@ def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
     return bits, overflow
 
 
-def shard_padded(h: PaddedLA, mesh: Mesh, axis: str = "dp") -> PaddedLA:
+def shard_padded(h: PaddedLA, mesh: Mesh, axis: str = "dp"
+                 ) -> tuple[PaddedLA, bool]:
     """device_put a padded history with its op/mop/element axes sharded
     along the mesh axis (GSPMD input shardings for edge inference).
 
     Arrays whose leading dim doesn't divide the mesh (padded capacities
     are powers of two, so e.g. a 6-device mesh never divides) are
     replicated instead — inference then runs unsharded but the K-axis
-    sweep sharding (the dominant cost at scale) still applies."""
+    sweep sharding (the dominant cost at scale) still applies.  Returns
+    (placed history, inference_sharded) — False means every array was
+    replicated, a fact callers must surface (a user on a 6-device mesh
+    should be able to see that input sharding didn't happen)."""
     n = mesh.shape[axis]
     sharded = NamedSharding(mesh, P(axis))
     replicated = NamedSharding(mesh, P())
+    any_sharded = False
 
     def put(x):
+        nonlocal any_sharded
         divisible = x.ndim > 0 and x.shape[0] % n == 0
+        any_sharded = any_sharded or divisible
         return jax.device_put(x, sharded if divisible else replicated)
 
-    return jax.tree_util.tree_map(put, h)
+    placed = jax.tree_util.tree_map(put, h)
+    return placed, any_sharded
 
 
 def check_sharded(p: PackedTxns | PaddedLA, mesh: Optional[Mesh] = None,
@@ -154,7 +162,7 @@ def check_sharded(p: PackedTxns | PaddedLA, mesh: Optional[Mesh] = None,
         mesh = Mesh(np.array(jax.devices()), (axis,))
     h = p if isinstance(p, PaddedLA) else pad_packed(p)
     n_keys = h.n_keys
-    h = shard_padded(h, mesh, axis)
+    h, infer_sharded = shard_padded(h, mesh, axis)
     n_shards = mesh.shape[axis]
     if max_k % n_shards:
         # non-power-of-two meshes: round the budget up to a mesh multiple
@@ -180,4 +188,7 @@ def check_sharded(p: PackedTxns | PaddedLA, mesh: Optional[Mesh] = None,
             "G2-family-realtime": cycles[4],
         },
         "exact": converged,
+        # False = input arrays were replicated (leading dims don't divide
+        # the mesh); the K-axis sweep sharding still applied
+        "inference-sharded": infer_sharded,
     }

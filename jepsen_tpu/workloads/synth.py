@@ -360,6 +360,79 @@ def packed_la_history(n_txns: int, n_keys: int, concurrency: int = 10,
     )
 
 
+def packed_rw_history(n_txns: int, n_keys: int, concurrency: int = 10,
+                      mops_per_txn: int = 3, read_frac: float = 0.5,
+                      seed: int = 0) -> PackedTxns:
+    """Vectorized strict-serializable rw-register history as PackedTxns.
+
+    Serial execution in txn order (commit order == txn index): writes get
+    globally unique value ids; each read observes the latest write of its
+    key by mop order (txn-major, so txn-local writes are visible).  All
+    txns ok.  O(n) numpy — the BASELINE config-3 scale (1M ops) can't be
+    built through Python Op objects in reasonable time.
+    """
+    from jepsen_tpu.checkers.elle.rw_register import _seg_exclusive_max
+
+    rng = np.random.default_rng(seed)
+    T = n_txns
+    M = T * mops_per_txn
+    mop_txn = np.repeat(np.arange(T, dtype=np.int32), mops_per_txn)
+    is_read = rng.random(M) < read_frac
+    mop_kind = np.where(is_read, MOP_READ, MOP_APPEND).astype(np.int8)
+    mop_key = rng.integers(0, n_keys, M).astype(np.int32)
+
+    n_app = int((~is_read).sum())
+    app_idx = np.nonzero(~is_read)[0]
+    mop_val = np.full(M, -1, dtype=np.int32)
+    mop_val[app_idx] = np.arange(n_app, dtype=np.int32)
+
+    # latest write of the key strictly before each mop, via per-key runs
+    mop_order = np.lexsort((np.arange(M), mop_key))
+    k_sorted = mop_key[mop_order]
+    run_start = np.concatenate([[True], k_sorted[1:] != k_sorted[:-1]])
+    seg_id = np.cumsum(run_start) - 1
+    app_sorted = (~is_read)[mop_order]
+    wq = np.where(app_sorted, np.arange(M), -1)
+    prev_w = _seg_exclusive_max(wq, seg_id)
+    val_sorted = mop_val[mop_order]
+    read_val_sorted = np.where(prev_w >= 0,
+                               val_sorted[np.maximum(prev_w, 0)], -1)
+    read_val = np.empty(M, dtype=np.int32)
+    read_val[mop_order] = read_val_sorted
+    mop_val = np.where(is_read, read_val, mop_val).astype(np.int32)
+
+    rd_len = np.where(is_read, 0, -1).astype(np.int32)  # known scalar reads
+    rd_start = np.full(M, -1, dtype=np.int32)
+
+    txn_process = (np.arange(T, dtype=np.int32) % concurrency)
+    txn_invoke_pos = (2 * np.arange(T, dtype=np.int32))
+    txn_complete_pos = txn_invoke_pos + 1
+
+    key_names = list(range(n_keys))
+    app_keys = mop_key[app_idx]
+    val_keys = np.empty(n_app, dtype=np.int64)
+    val_keys[mop_val[app_idx]] = app_keys
+    val_names = [(int(val_keys[v]), int(v)) for v in range(n_app)]
+
+    return PackedTxns(
+        txn_type=np.full(T, TXN_OK, dtype=np.int8),
+        txn_process=txn_process,
+        txn_invoke_pos=txn_invoke_pos,
+        txn_complete_pos=txn_complete_pos,
+        txn_orig_index=np.arange(T, dtype=np.int32) * 2 + 1,
+        mop_txn=mop_txn,
+        mop_kind=mop_kind,
+        mop_key=mop_key,
+        mop_val=mop_val,
+        mop_rd_start=rd_start,
+        mop_rd_len=rd_len,
+        rd_elems=np.zeros(0, dtype=np.int32),
+        key_names=key_names,
+        val_names=val_names,
+        n_events=2 * T,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Linearizable-register histories (knossos test corpus).
 # ---------------------------------------------------------------------------

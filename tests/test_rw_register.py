@@ -171,3 +171,116 @@ def test_explainer_rw_register_edges_justified():
             assert e.get("key") is not None, e
     wr = [e for e in cyc if e["rel"] == "wr"]
     assert wr and wr[0]["value"] in (1, 9)
+
+
+# ---- fused device rw check (device_rw.py) --------------------------------
+
+def _host_flags(h):
+    """Host-checker verdicts mapped to the device bit granularity."""
+    res = rw_register.check(h, ["strict-serializable"], use_device=False)
+    at = set(res["anomaly-types"])
+    base = {"G0", "G1c", "G-single", "G2-item", "G-nonadjacent"}
+    proc = {a + "-process" for a in base}
+    rt_ = {a + "-realtime" for a in base}
+    return res, {
+        "counts": {n: (n in at) for n in
+                   ("duplicate-writes", "internal", "G1a", "G1b",
+                    "lost-update", "cyclic-versions")},
+        "cycles": {
+            "G0": "G0" in at,
+            "G1c": bool({"G0", "G1c"} & at),
+            "G2-family": bool(base & at),
+            "G2-family-process": bool((base | proc) & at),
+            "G2-family-realtime": bool((base | rt_) & at),
+        },
+    }
+
+
+def _assert_device_matches_host(h):
+    from jepsen_tpu.checkers.elle import device_rw
+    from jepsen_tpu.history.soa import pack_txns
+
+    res_host, want = _host_flags(h)
+    got = device_rw.check(pack_txns(h, "rw-register"))
+    assert got["exact"] is True
+    assert got["valid?"] == res_host["valid?"], (got, res_host)
+    for n, flag in want["counts"].items():
+        assert (got["counts"][n] > 0) == flag, (n, got, res_host)
+    for n, flag in want["cycles"].items():
+        assert got["cycles"][n] == flag, (n, got, res_host)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_device_rw_differential_valid(seed):
+    h = synth.rw_history(n_txns=150, n_keys=6, concurrency=5,
+                         fail_prob=0.05, info_prob=0.05, seed=seed)
+    _assert_device_matches_host(h)
+
+
+def test_device_rw_differential_anomalies():
+    cases = [
+        # wr cycle (G1c)
+        concurrent_history(
+            ([["w", "x", 1], ["r", "y", None]],
+             [["w", "x", 1], ["r", "y", 9]]),
+            ([["w", "y", 9], ["r", "x", None]],
+             [["w", "y", 9], ["r", "x", 1]]),
+        ),
+        # write skew (G2-item via rw edges)
+        concurrent_history(
+            ([["r", "x", None], ["w", "y", 10]],
+             [["r", "x", None], ["w", "y", 10]]),
+            ([["r", "y", None], ["w", "x", 1]],
+             [["r", "y", None], ["w", "x", 1]]),
+        ),
+        # G1a: read of failed write
+        concurrent_history(
+            ([["w", "x", 5]], "fail"),
+            ([["r", "x", None]], [["r", "x", 5]]),
+        ),
+        # internal: read contradicts own write
+        concurrent_history(
+            ([["w", "x", 7], ["r", "x", None]],
+             [["w", "x", 7], ["r", "x", 3]]),
+            ([["w", "x", 3]], [["w", "x", 3]]),
+        ),
+        # lost update: two txns read same version then write
+        concurrent_history(
+            ([["r", "x", None], ["w", "x", 1]],
+             [["r", "x", None], ["w", "x", 1]]),
+            ([["r", "x", None], ["w", "x", 2]],
+             [["r", "x", None], ["w", "x", 2]]),
+        ),
+        # duplicate writes
+        concurrent_history(
+            ([["w", "x", 1]], [["w", "x", 1]]),
+            ([["w", "x", 1]], [["w", "x", 1]]),
+        ),
+    ]
+    for i, h in enumerate(cases):
+        try:
+            _assert_device_matches_host(h)
+        except AssertionError as e:
+            raise AssertionError(f"case {i}: {e}") from e
+
+
+def test_device_rw_realtime_cycle():
+    # read-before-write in real time: strict-serializable violation only
+    h = history([
+        invoke(0, "txn", [["r", "x", None]]),
+        ok(0, "txn", [["r", "x", 1]]),
+        invoke(1, "txn", [["w", "x", 1]]),
+        ok(1, "txn", [["w", "x", 1]]),
+    ])
+    _assert_device_matches_host(h)
+
+
+def test_packed_rw_history_valid_and_matches_host():
+    from jepsen_tpu.checkers.elle import device_rw
+
+    p = synth.packed_rw_history(n_txns=2000, n_keys=50, seed=3)
+    got = device_rw.check(p)
+    assert got["valid?"] is True, got
+    res_host = rw_register.check(p, ["strict-serializable"],
+                                 use_device=False)
+    assert res_host["valid?"] is True, res_host["anomaly-types"]
