@@ -25,17 +25,44 @@ Exactness: a 64-bit hash collision could merge two distinct configs
 therefore carries `hash_dedup: True`; `competition.analysis` anchors
 definitive verdicts on the exact host search when the history is small and
 uses the device verdict beyond that, as the reference races wgl/linear.
-Frontier overflow -> `"unknown"` (never a wrong verdict).
+
+Scaling beyond the single-jit wave (SURVEY.md §7 "WGL state explosion:
+wave-size caps + host spill"): histories past 4096 ops, and frontiers past
+the device cap, go through the *blocked* search — the frontier lives on
+the host as a list of <= F-row blocks (the JVM-heap analogue is host RAM,
+the spill target), each wave expands block-by-block through one device
+jit (`_expand_block`), and cross-block dedup happens on the host with
+one vectorized sort-unique per wave — per-WAVE only, because configs in
+different waves have different popcounts and so can never collide.  A
+block whose unique children exceed the output capacity is split in half
+and re-expanded — never truncated.  Only genuine resource exhaustion
+(the cumulative explored-config counter passing `max_configs`) returns
+"unknown"; frontier size alone no longer does.
+
+Expansion is restricted per wave to the ACTIVE op window (ops not
+linearized in every config, invokable below the wave's minret bound) —
+without this, every wave pays F x n work and long serial histories are
+hopeless; with it, per-wave cost tracks the real concurrency window.
+Differentially tested per-wave against an exact Python set-BFS.
+
+BFS-vs-DFS caveat: each crashed (`info`) op stays forever-concurrent and
+multiplies the per-wave config count (the subsets that did/didn't
+linearize it) — BFS enumerates them; the reference's DFS often finds a
+witness first.  That asymmetry is why `competition.analysis` races this
+search against the host DFS rather than replacing it.
 """
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Dict, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_DEBUG = bool(os.environ.get("JT_WGL_DEBUG"))
 
 from jepsen_tpu.checkers.knossos.memo import Memo, StateExplosion, memoize
 from jepsen_tpu.checkers.knossos.prep import NEVER, LinOp
@@ -141,20 +168,12 @@ def _frontier_search(n: int, W: int, max_frontier: int, n_waves: int,
     return done, exhausted, overflow
 
 
-def check(ops: Sequence[LinOp], model: Model,
-          max_frontier: int = 16384) -> Dict[str, Any]:
-    """Device linearizability check of prepared ops against a model."""
+MAX_DEVICE_OPS = 32768
+
+
+def _setup(ops: Sequence[LinOp], memo: Memo):
+    """Padded arrays shared by both search shapes."""
     n = len(ops)
-    if n == 0:
-        return {"valid?": "unknown", "op-count": 0}
-    if n > 4096:
-        return {"valid?": "unknown", "op-count": n,
-                "reason": "too many ops for device WGL"}
-    try:
-        memo = memoize(model, ops)
-    except StateExplosion:
-        return {"valid?": "unknown", "op-count": n,
-                "reason": "model state explosion"}
     n_pad = 8
     while n_pad < n:
         n_pad *= 2
@@ -171,21 +190,296 @@ def check(ops: Sequence[LinOp], model: Model,
         op_sym[i] = memo.op_sym[i]
         if op.return_pos < NEVER:
             must[i // 32] |= np.uint32(1 << (i % 32))
-    # padding ops: make them non-candidates (invoke = huge) and
-    # transitions irrelevant; returns huge so they never constrain minret
-    table = memo.table
     rng = np.random.default_rng(0xC0FFEE)
     z1 = rng.integers(0, 2 ** 32, n_pad, dtype=np.uint32)
     z2 = rng.integers(0, 2 ** 32, n_pad, dtype=np.uint32)
+    return n_pad, W, invokes, returns, op_sym, must, z1, z2
 
-    lin, exhausted, overflow = _frontier_search(
-        n_pad, W, max_frontier, n + 1,
-        jnp.asarray(invokes), jnp.asarray(returns), jnp.asarray(op_sym),
-        jnp.asarray(must), jnp.asarray(table), jnp.asarray(z1),
-        jnp.asarray(z2), jnp.int32(memo.init_state))
-    lin, exhausted, overflow = (bool(lin), bool(exhausted), bool(overflow))
-    if overflow:
+
+def check(ops: Sequence[LinOp], model: Model,
+          max_frontier: int = 16384,
+          max_configs: int = 20_000_000) -> Dict[str, Any]:
+    """Device linearizability check of prepared ops against a model."""
+    n = len(ops)
+    if n == 0:
+        return {"valid?": "unknown", "op-count": 0}
+    if n > MAX_DEVICE_OPS:
         return {"valid?": "unknown", "op-count": n,
-                "reason": "frontier overflow", "hash_dedup": True}
-    return {"valid?": True if lin else False, "op-count": n,
-            "hash_dedup": True}
+                "reason": "too many ops for device WGL"}
+    try:
+        memo = memoize(model, ops)
+    except StateExplosion:
+        return {"valid?": "unknown", "op-count": n,
+                "reason": "model state explosion"}
+    n_pad, W, invokes, returns, op_sym, must, z1, z2 = _setup(ops, memo)
+    table = memo.table
+
+    # The single-jit wave burns F x n_pad work EVERY wave regardless of
+    # frontier occupancy — past ~1k ops a serial history pays thousands
+    # of full-width waves and the blocked search (blocks sized to the
+    # live frontier) is strictly faster as well as memory-spilled.
+    if n <= 1024:
+        lin, exhausted, overflow = _frontier_search(
+            n_pad, W, max_frontier, n + 1,
+            jnp.asarray(invokes), jnp.asarray(returns),
+            jnp.asarray(op_sym), jnp.asarray(must), jnp.asarray(table),
+            jnp.asarray(z1), jnp.asarray(z2), jnp.int32(memo.init_state))
+        lin, overflow = bool(lin), bool(overflow)
+        if not overflow:
+            return {"valid?": True if lin else False, "op-count": n,
+                    "hash_dedup": True}
+        # fall through: re-run with host-spilled frontier blocks
+
+    return _blocked_search(n, n_pad, W, invokes, returns, op_sym, must,
+                           table, memo.init_state, z1, z2,
+                           max_frontier, max_configs)
+
+
+def _blocked_and_check(ops: Sequence[LinOp], model: Model,
+                       max_frontier: int = 16384,
+                       max_configs: int = 20_000_000) -> Dict[str, Any]:
+    """Route straight to the blocked (host-spill) search — used by tests
+    and by callers that know the frontier will overflow."""
+    n = len(ops)
+    try:
+        memo = memoize(model, ops)
+    except StateExplosion:
+        return {"valid?": "unknown", "op-count": n,
+                "reason": "model state explosion"}
+    n_pad, W, invokes, returns, op_sym, must, z1, z2 = _setup(ops, memo)
+    return _blocked_search(n, n_pad, W, invokes, returns, op_sym, must,
+                           memo.table, memo.init_state, z1, z2,
+                           max_frontier, max_configs)
+
+
+# ---------------------------------------------------------------------------
+# Blocked search: host-resident frontier, device per-block expansion.
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("A", "W", "F", "C"))
+def _expand_block(A: int, W: int, F: int, C: int,
+                  act_mask, act_invokes, act_returns, act_sym,
+                  act_z1, act_z2, act_word, act_bit,
+                  table, states, bits, h1, h2, valid):
+    """Expand one frontier block of F configs into <= C unique children,
+    over a WINDOW of A active ops (gathered on host).
+
+    The window restriction is what makes long histories tractable
+    (`knossos/wgl.clj`'s effective behavior): at wave k, ops linearized
+    in every config and ops not yet invokable (invoke >= the (k+1)-th
+    smallest return) can never be candidates, so the op axis shrinks
+    from n to the concurrency window.  `minret` over active unlinearized
+    ops is exact for the candidate test: excluded ops are either
+    linearized (no contribution) or have returns strictly above the
+    window bound every candidate's invoke is below.
+
+    Returns (out_states, out_bits, out_h1, out_h2, out_valid, n_unique):
+    children deduped within the block; n_unique may exceed C (the caller
+    must then split the block and retry — nothing is silently dropped).
+    """
+    op_bit = (jnp.uint32(1) << act_bit.astype(jnp.uint32))
+
+    cfg_words = bits[:, jnp.clip(act_word, 0, W - 1)]     # (F, A)
+    in_s = ((cfg_words >> act_bit.astype(jnp.uint32)) & 1).astype(bool)
+    in_s = in_s | ~act_mask[None, :]
+    ret_masked = jnp.where(in_s, INF, act_returns[None, :])
+    minret = jnp.min(ret_masked, axis=1)
+    cand = (~in_s) & (act_invokes[None, :] < minret[:, None]) & \
+        valid[:, None]
+    nxt_state = table[states[:, None], jnp.clip(act_sym, 0, None)[None, :]]
+    cand = cand & (nxt_state >= 0)
+
+    ch_h1 = (h1[:, None] ^ act_z1[None, :]).reshape(-1)
+    ch_h2 = (h2[:, None] ^ act_z2[None, :]).reshape(-1)
+    ch_state = nxt_state.reshape(-1)
+    ch_mask = cand.reshape(-1)
+    parent = jnp.repeat(jnp.arange(F, dtype=jnp.int32), A)
+    opid = jnp.tile(jnp.arange(A, dtype=jnp.int32), F)
+
+    order = jnp.lexsort((
+        ch_state, ch_h2, ch_h1, (~ch_mask).astype(jnp.int32)))
+    s_h1 = ch_h1[order]
+    s_h2 = ch_h2[order]
+    s_state = ch_state[order]
+    s_mask = ch_mask[order]
+    first = jnp.concatenate([
+        jnp.ones(1, bool),
+        (s_h1[1:] != s_h1[:-1]) | (s_h2[1:] != s_h2[:-1]) |
+        (s_state[1:] != s_state[:-1])])
+    keep = s_mask & first
+    n_unique = jnp.sum(keep.astype(jnp.int32))
+
+    kidx = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    tgt = jnp.where(keep & (kidx < C), kidx, C)
+    take = jnp.full(C + 1, -1, jnp.int32).at[tgt].max(
+        jnp.arange(F * A, dtype=jnp.int32))[:C]
+    out_valid = take >= 0
+    tk = jnp.clip(take, 0, F * A - 1)
+    src = order[tk]
+    p = parent[src]
+    o = opid[src]
+    out_states = jnp.where(out_valid, ch_state[src], 0)
+    out_bits = bits[p] | (
+        jnp.zeros((C, W), jnp.uint32).at[
+            jnp.arange(C), jnp.clip(act_word[o], 0, W - 1)].set(op_bit[o]))
+    out_bits = jnp.where(out_valid[:, None], out_bits, 0)
+    out_h1 = jnp.where(out_valid, ch_h1[src], 0)
+    out_h2 = jnp.where(out_valid, ch_h2[src], 0)
+    return out_states, out_bits, out_h1, out_h2, out_valid, n_unique
+
+
+def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
+                    init_state, z1, z2, max_frontier, max_configs
+                    ) -> Dict[str, Any]:
+    """Breadth-first over waves; frontier spilled to host as block lists.
+
+    Every wave holds configs with the same linearized-count, so the
+    cross-wave dedup set only needs the current wave's keys.  Device
+    memory is bounded by one (F, n_pad) expansion; host memory holds
+    everything else — the SURVEY §7 "host spill" answer to WGL state
+    explosion.
+    """
+    F_max = max(64, min(max_frontier, 16384))
+
+    table_dev = jnp.asarray(table)
+    word_idx_h = (np.arange(n_pad) // 32).astype(np.int32)
+    bit_h = (np.arange(n_pad) % 32).astype(np.int32)
+    must_row = must[None, :]
+    # (k+1)-th smallest real return bounds every wave-k config's minret
+    real_rets = np.sort(returns[returns < 2 ** 29])
+
+    def active_window(blocks, k):
+        """Op ids that can still be candidates at wave k: not linearized
+        in EVERY config, and invokable below the wave's minret bound."""
+        all_ones = np.full(W, 0xFFFFFFFF, np.uint64).astype(np.uint32)
+        for st, bi, a1, a2, va in blocks:
+            if va.any():
+                all_ones &= np.bitwise_and.reduce(bi[va], axis=0)
+        everywhere = ((all_ones[word_idx_h] >> bit_h) & 1).astype(bool)
+        bound = (real_rets[k] if k < len(real_rets)
+                 else np.int64(2 ** 62))
+        act = ~everywhere & (invokes < bound)
+        return np.nonzero(act)[0].astype(np.int32)
+
+    def cap_of(F, A):
+        # one config can have up to A children, so C >= A guarantees a
+        # single-row block never needs splitting (split progress)
+        return min(max(4 * F, A), F * A)
+
+    def pad_block(states, bits, h1, h2, m):
+        # right-size the block: a sparse wave (serial history) must not
+        # pay full-F_max expansion work
+        F = 64
+        while F < m and F < F_max:
+            F *= 2
+        out = (np.zeros(F, np.int32), np.zeros((F, W), np.uint32),
+               np.zeros(F, np.uint32), np.zeros(F, np.uint32),
+               np.zeros(F, bool))
+        out[0][:m] = states[:m]
+        out[1][:m] = bits[:m]
+        out[2][:m] = h1[:m]
+        out[3][:m] = h2[:m]
+        out[4][:m] = True
+        return out
+
+    # initial frontier: the empty config
+    blocks = [pad_block(np.array([init_state], np.int32),
+                        np.zeros((1, W), np.uint32),
+                        np.zeros(1, np.uint32), np.zeros(1, np.uint32), 1)]
+    if bool(np.all((blocks[0][1][:1] & must_row) == must_row)):
+        return {"valid?": True, "op-count": n, "hash_dedup": True,
+                "blocked": True}
+
+    total_seen = 0
+    for k in range(n + 1):
+        # collect every block's (block-deduped) children, then do ONE
+        # vectorized cross-block dedup + success check for the wave.
+        # Configs in different waves have different popcounts, so no
+        # cross-wave seen-set is needed.
+        ch_s: List[np.ndarray] = []
+        ch_b: List[np.ndarray] = []
+        ch_h1: List[np.ndarray] = []
+        ch_h2: List[np.ndarray] = []
+
+        act = active_window(blocks, k)
+        A = 8
+        while A < len(act):
+            A *= 2
+        act_mask = np.zeros(A, bool)
+        act_mask[:len(act)] = True
+        act_pad = np.zeros(A, np.int32)
+        act_pad[:len(act)] = act
+        win = (jnp.asarray(act_mask), jnp.asarray(invokes[act_pad]),
+               jnp.asarray(returns[act_pad]), jnp.asarray(op_sym[act_pad]),
+               jnp.asarray(z1[act_pad]), jnp.asarray(z2[act_pad]),
+               jnp.asarray(word_idx_h[act_pad]),
+               jnp.asarray(bit_h[act_pad]))
+
+        if _DEBUG and k % 50 == 0:
+            import time as _t
+            print(f"wave {k}: blocks={len(blocks)} "
+                  f"rows={sum(b[4].sum() for b in blocks)} A={A} "
+                  f"t={_t.perf_counter():.1f}", flush=True)
+        work = list(blocks)
+        while work:
+            st, bi, a1, a2, va = work.pop()
+            F = len(st)
+            C = cap_of(F, A)
+            outs = _expand_block(A, W, F, C, *win, table_dev,
+                                 jnp.asarray(st), jnp.asarray(bi),
+                                 jnp.asarray(a1), jnp.asarray(a2),
+                                 jnp.asarray(va))
+            o_st, o_bi, o_h1, o_h2, o_va, n_uniq = (np.asarray(x)
+                                                    for x in outs)
+            if int(n_uniq) > C:
+                # children overflow the output capacity: split the block
+                # rows in half and re-expand — exact, never truncating
+                half = max(1, int(va.sum()) // 2)
+                idx = np.nonzero(va)[0]
+                lo, hi = idx[:half], idx[half:]
+                for part in (lo, hi):
+                    if len(part):
+                        work.append(pad_block(st[part], bi[part],
+                                              a1[part], a2[part],
+                                              len(part)))
+                continue
+            m = o_va
+            ch_s.append(o_st[m])
+            ch_b.append(o_bi[m])
+            ch_h1.append(o_h1[m])
+            ch_h2.append(o_h2[m])
+
+        if not ch_s or not sum(len(x) for x in ch_s):
+            return {"valid?": False, "op-count": n, "hash_dedup": True,
+                    "blocked": True}
+        s = np.concatenate(ch_s)
+        b = np.concatenate(ch_b)
+        h1_all = np.concatenate(ch_h1)
+        h2_all = np.concatenate(ch_h2)
+        key = (h1_all.astype(np.uint64) << np.uint64(32)) | h2_all
+        order = np.lexsort((s, key))
+        sk = key[order]
+        ss = s[order]
+        first = np.concatenate([[True],
+                                (sk[1:] != sk[:-1]) | (ss[1:] != ss[:-1])])
+        uniq = order[first]
+        s, b = s[uniq], b[uniq]
+        h1u = h1_all[uniq]
+        h2u = h2_all[uniq]
+
+        if bool(np.all((b & must[None, :]) == must[None, :],
+                       axis=1).any()):
+            return {"valid?": True, "op-count": n, "hash_dedup": True,
+                    "blocked": True}
+        total_seen += len(s)
+        if total_seen > max_configs:
+            return {"valid?": "unknown", "op-count": n,
+                    "reason": "config budget exhausted",
+                    "explored": total_seen, "hash_dedup": True,
+                    "blocked": True}
+        blocks = [pad_block(s[i:], b[i:], h1u[i:], h2u[i:],
+                            min(F_max, len(s) - i))
+                  for i in range(0, len(s), F_max)]
+    return {"valid?": False, "op-count": n, "hash_dedup": True,
+            "blocked": True}

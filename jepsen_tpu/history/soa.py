@@ -91,6 +91,139 @@ def _mops_of(op: Op) -> Sequence:
     return v
 
 
+_CHUNK_COLS = (
+    ("txn_type", np.int8), ("txn_process", np.int32),
+    ("txn_invoke_pos", np.int32), ("txn_complete_pos", np.int32),
+    ("txn_orig_index", np.int32), ("mop_txn", np.int32),
+    ("mop_kind", np.int8), ("mop_key", np.int32), ("mop_val", np.int32),
+    ("mop_rd_start", np.int32), ("mop_rd_len", np.int32),
+    ("rd_elems", np.int32),
+)
+
+
+class TxnPacker:
+    """Chunk-feedable packer: flattens completed client txns to SoA
+    column chunks without ever holding the whole op list.
+
+    The streaming equivalent of the reference's big-vector blocks +
+    soft-reference chunks (`store/format.clj`, `history/core.clj`,
+    SURVEY.md §2.2 "Chunked storage"): `feed(ops)` consumes one history
+    chunk in order and returns that chunk's column arrays with *global*
+    txn ids and read-element offsets, so chunks can be shipped to the
+    device as they are packed (see `checkers.elle.stream`).  Host state
+    between chunks is O(concurrency + distinct keys/values): the
+    pending-invocation table plus the interner maps.
+    """
+
+    def __init__(self, workload: str = "list-append"):
+        self.la = workload == "list-append"
+        self.key_ids: dict = {}
+        self.key_names: List[Any] = []
+        self.val_ids: dict = {}  # (key_id, value) -> val id
+        self.val_names: List[Any] = []
+        self.pending: dict = {}  # process -> invoke Op
+        self.pos = 0             # global event position
+        self.n_txns = 0
+        self.n_mops = 0
+        self.n_rd_elems = 0
+
+    def _key_id(self, k) -> int:
+        i = self.key_ids.get(k)
+        if i is None:
+            i = len(self.key_names)
+            self.key_ids[k] = i
+            self.key_names.append(k)
+        return i
+
+    def _val_id(self, ki: int, v) -> int:
+        i = self.val_ids.get((ki, v))
+        if i is None:
+            i = len(self.val_names)
+            self.val_ids[(ki, v)] = i
+            self.val_names.append((ki, v))
+        return i
+
+    def feed(self, ops: Sequence[Op]) -> dict:
+        """Pack one chunk of ops (must be fed in history order).  Returns
+        {column: np.ndarray} for the txns COMPLETED in this chunk."""
+        cols: dict = {name: [] for name, _ in _CHUNK_COLS}
+        for op in ops:
+            pos = self.pos
+            self.pos += 1
+            if not op.is_client_op():
+                continue
+            if op.type == INVOKE:
+                self.pending[op.process] = op
+                continue
+            inv = self.pending.pop(op.process, None)
+            if op.type == OK:
+                ttype, mops, known_reads = TXN_OK, _mops_of(op), True
+            else:
+                src = inv if inv is not None else op
+                ttype = TXN_FAIL if op.type == FAIL else TXN_INFO
+                mops, known_reads = _mops_of(src), False
+            t = self.n_txns
+            self.n_txns += 1
+            cols["txn_type"].append(ttype)
+            cols["txn_process"].append(int(op.process))
+            cols["txn_invoke_pos"].append(inv.index if inv is not None
+                                          else pos)
+            cols["txn_complete_pos"].append(pos)
+            cols["txn_orig_index"].append(op.index)
+            for m in mops:
+                fkind = m[0]
+                k = self._key_id(m[1])
+                self.n_mops += 1
+                cols["mop_txn"].append(t)
+                cols["mop_key"].append(k)
+                if fkind in ("append", "w"):
+                    cols["mop_kind"].append(MOP_APPEND)
+                    cols["mop_val"].append(self._val_id(k, m[2]))
+                    cols["mop_rd_start"].append(-1)
+                    cols["mop_rd_len"].append(-1)
+                elif fkind == "r":
+                    cols["mop_kind"].append(MOP_READ)
+                    rv = m[2] if len(m) > 2 else None
+                    if self.la:
+                        cols["mop_val"].append(-1)
+                        if known_reads and rv is not None:
+                            cols["mop_rd_start"].append(self.n_rd_elems)
+                            cols["mop_rd_len"].append(len(rv))
+                            cols["rd_elems"].extend(
+                                self._val_id(k, v) for v in rv)
+                            self.n_rd_elems += len(rv)
+                        else:
+                            cols["mop_rd_start"].append(-1)
+                            cols["mop_rd_len"].append(-1)
+                    else:  # rw-register: scalar read (None -> unborn/-1)
+                        if known_reads:
+                            cols["mop_val"].append(
+                                -1 if rv is None else self._val_id(k, rv))
+                            cols["mop_rd_len"].append(0)
+                        else:
+                            cols["mop_val"].append(-1)
+                            cols["mop_rd_len"].append(-1)
+                        cols["mop_rd_start"].append(-1)
+                else:
+                    raise ValueError(f"unknown mop kind {fkind!r}")
+        return {name: np.asarray(cols[name], dtype=dt)
+                for name, dt in _CHUNK_COLS}
+
+    def to_packed(self, chunks: Sequence[dict]) -> PackedTxns:
+        """Concatenate fed chunks into one PackedTxns."""
+        def cat(name, dt):
+            parts = [c[name] for c in chunks]
+            return (np.concatenate(parts) if parts
+                    else np.zeros(0, dt))
+
+        return PackedTxns(
+            **{name: cat(name, dt) for name, dt in _CHUNK_COLS},
+            key_names=self.key_names,
+            val_names=self.val_names,
+            n_events=self.pos,
+        )
+
+
 def pack_txns(h: History | Sequence[Op], workload: str = "list-append") -> PackedTxns:
     """Flatten a history's completed client transactions to SoA arrays.
 
@@ -104,110 +237,6 @@ def pack_txns(h: History | Sequence[Op], workload: str = "list-append") -> Packe
         ops = list(h)
         # raw op sequences may lack indices; (re)index unless already indexed
         h = History(ops, reindex=any(op.index < 0 for op in ops))
-
-    key_ids: dict = {}
-    key_names: List[Any] = []
-    val_ids: dict = {}  # (key_id, value) -> val id
-    val_names: List[Any] = []
-
-    def key_id(k) -> int:
-        i = key_ids.get(k)
-        if i is None:
-            i = len(key_names)
-            key_ids[k] = i
-            key_names.append(k)
-        return i
-
-    def val_id(ki: int, v) -> int:
-        i = val_ids.get((ki, v))
-        if i is None:
-            i = len(val_names)
-            val_ids[(ki, v)] = i
-            val_names.append((ki, v))
-        return i
-
-    txn_type: List[int] = []
-    txn_process: List[int] = []
-    txn_invoke_pos: List[int] = []
-    txn_complete_pos: List[int] = []
-    txn_orig_index: List[int] = []
-    mop_txn: List[int] = []
-    mop_kind: List[int] = []
-    mop_key: List[int] = []
-    mop_val: List[int] = []
-    mop_rd_start: List[int] = []
-    mop_rd_len: List[int] = []
-    rd_elems: List[int] = []
-
-    la = workload == "list-append"
-
-    for pos, op in enumerate(h.ops):
-        if op.type == INVOKE or not op.is_client_op():
-            continue
-        if op.type == OK:
-            ttype, mops, known_reads = TXN_OK, _mops_of(op), True
-        else:
-            inv = h.invocation(op)
-            src = inv if inv is not None else op
-            ttype = TXN_FAIL if op.type == FAIL else TXN_INFO
-            mops, known_reads = _mops_of(src), False
-        t = len(txn_type)
-        txn_type.append(ttype)
-        txn_process.append(int(op.process))
-        inv = h.invocation(op)
-        txn_invoke_pos.append(inv.index if inv is not None else pos)
-        txn_complete_pos.append(pos)
-        txn_orig_index.append(op.index)
-        for m in mops:
-            fkind = m[0]
-            k = key_id(m[1])
-            mop_txn.append(t)
-            mop_key.append(k)
-            if fkind in ("append", "w"):
-                mop_kind.append(MOP_APPEND)
-                mop_val.append(val_id(k, m[2]))
-                mop_rd_start.append(-1)
-                mop_rd_len.append(-1)
-            elif fkind == "r":
-                mop_kind.append(MOP_READ)
-                rv = m[2] if len(m) > 2 else None
-                if la:
-                    mop_val.append(-1)
-                    if known_reads and rv is not None:
-                        mop_rd_start.append(len(rd_elems))
-                        mop_rd_len.append(len(rv))
-                        rd_elems.extend(val_id(k, v) for v in rv)
-                    else:
-                        mop_rd_start.append(-1)
-                        mop_rd_len.append(-1)
-                else:  # rw-register: scalar read value (None -> unborn/-1)
-                    if known_reads:
-                        mop_val.append(-1 if rv is None else val_id(k, rv))
-                        mop_rd_len.append(0)
-                    else:
-                        mop_val.append(-1)
-                        mop_rd_len.append(-1)
-                    mop_rd_start.append(-1)
-            else:
-                raise ValueError(f"unknown mop kind {fkind!r}")
-
-    def a(x, dt=np.int32):
-        return np.asarray(x, dtype=dt)
-
-    return PackedTxns(
-        txn_type=a(txn_type, np.int8),
-        txn_process=a(txn_process),
-        txn_invoke_pos=a(txn_invoke_pos),
-        txn_complete_pos=a(txn_complete_pos),
-        txn_orig_index=a(txn_orig_index),
-        mop_txn=a(mop_txn),
-        mop_kind=a(mop_kind, np.int8),
-        mop_key=a(mop_key),
-        mop_val=a(mop_val),
-        mop_rd_start=a(mop_rd_start),
-        mop_rd_len=a(mop_rd_len),
-        rd_elems=a(rd_elems),
-        key_names=key_names,
-        val_names=val_names,
-        n_events=len(h.ops),
-    )
+    pk = TxnPacker(workload)
+    chunk = pk.feed(h.ops)
+    return pk.to_packed([chunk])
