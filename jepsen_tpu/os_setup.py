@@ -60,6 +60,23 @@ class Debian(OS):
         pass
 
 
+class Ubuntu(Debian):
+    """Ubuntu setup (reference: `os/ubuntu.clj`, which extends debian):
+    Debian behavior plus Ubuntu-specific background jobs that fight
+    nemeses and db installs.  apt-daily/apt-daily-upgrade TIMERS are what
+    relaunch unattended-upgrade runs (stopping only the service leaves
+    the dpkg-lock contention in place), and snap refreshes are held via
+    snapd's own hold — there is no stoppable refresh unit."""
+
+    def setup(self, test, node):
+        super().setup(test, node)
+        for unit in ("apt-daily.timer", "apt-daily-upgrade.timer",
+                     "unattended-upgrades"):
+            control.exec_result("systemctl", "stop", unit)
+            control.exec_result("systemctl", "disable", unit)
+        control.exec_result("snap", "refresh", "--hold")
+
+
 class Centos(OS):
     """CentOS/RHEL setup (reference: `os/centos.clj`)."""
 

@@ -204,3 +204,23 @@ def test_install_archive_zip_strips_top_dir(tmp_path):
         cu.install_archive("http://example.com/app-1.0.zip", "opt/app")
         assert cu.exists("opt/app/bin/run"), \
             "zip should match tar layout (top dir stripped)"
+
+
+# ---------------------------------------------------------- os setup
+
+def test_os_variants_issue_expected_commands():
+    from jepsen_tpu import os_setup
+
+    r = SimRemote()
+    for os_obj, host, expect in (
+            (os_setup.Debian(packages=["jq"]), "n1", "apt-get"),
+            (os_setup.Ubuntu(packages=["jq"]), "n2", "unattended-upgrades"),
+            (os_setup.Centos(packages=["jq"]), "n3", "yum"),
+    ):
+        s = r.connect(host)
+        with control.with_session(host, s):
+            os_obj.setup({}, host)
+        joined = "\n".join(r.node(host).cmds())
+        assert expect in joined, (host, joined)
+    # Ubuntu inherits the Debian apt path too
+    assert "apt-get" in "\n".join(r.node("n2").cmds())

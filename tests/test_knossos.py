@@ -260,3 +260,27 @@ def test_device_wgl_blocked_matches_exact_bfs_frontiers():
     r2 = device_wgl._blocked_and_check(ops, cas_register(),
                                        max_configs=total_ref + 10)
     assert r2["valid?"] is True  # succeeds within the exact BFS budget
+
+
+def test_standalone_cli_json(tmp_path):
+    import json as _json
+
+    from jepsen_tpu.checkers.knossos import cli as kcli
+
+    good = [
+        {"type": "invoke", "process": 0, "f": "write", "value": 1},
+        {"type": "ok", "process": 0, "f": "write", "value": 1},
+        {"type": "invoke", "process": 1, "f": "read", "value": None},
+        {"type": "ok", "process": 1, "f": "read", "value": 1},
+    ]
+    bad = good[:2] + [
+        {"type": "invoke", "process": 1, "f": "read", "value": None},
+        {"type": "ok", "process": 1, "f": "read", "value": 7},
+    ]
+    g = tmp_path / "good.json"
+    b = tmp_path / "bad.json"
+    g.write_text(_json.dumps(good))
+    b.write_text(_json.dumps(bad))
+    assert kcli.main([str(g), "--model", "register"]) == 0
+    assert kcli.main([str(b), "--model", "register",
+                      "--algorithm", "wgl"]) == 1

@@ -277,3 +277,71 @@ def test_independent_checker_copies_stateful_instances():
     ])
     res = independent.checker(Stateful()).check({}, h)
     assert res["valid?"] is True  # no cross-key contamination
+
+
+# ---- causal workload (jepsen/tests/causal.clj equivalent) ----------------
+
+def test_causal_valid_history():
+    from jepsen_tpu.history import history, invoke, ok
+    from jepsen_tpu.workloads import causal
+
+    # serial rmw chain + reads that respect causality
+    h = history([
+        invoke(0, "txn", [("r", "x", None), ("w", "x", 1)]),
+        ok(0, "txn", [("r", "x", None), ("w", "x", 1)]),
+        invoke(1, "txn", [("r", "x", None), ("w", "x", 2)]),
+        ok(1, "txn", [("r", "x", 1), ("w", "x", 2)]),
+        invoke(0, "txn", [("r", "x", None)]),
+        ok(0, "txn", [("r", "x", 2)]),
+    ])
+    res = causal.CausalChecker().check({}, h)
+    assert res["valid?"] is True, res
+
+
+def test_causal_monotonic_read_violation_detected():
+    from jepsen_tpu.history import history, invoke, ok
+    from jepsen_tpu.workloads import causal
+
+    # P1 installs v1 then v2 (rmw chain); P2 reads 2 then 1 — a
+    # monotonic-reads (session/causal) violation
+    h = history([
+        invoke(0, "txn", [("w", "x", 1)]),
+        ok(0, "txn", [("w", "x", 1)]),
+        invoke(0, "txn", [("r", "x", None), ("w", "x", 2)]),
+        ok(0, "txn", [("r", "x", 1), ("w", "x", 2)]),
+        invoke(1, "txn", [("r", "x", None)]),
+        ok(1, "txn", [("r", "x", 2)]),
+        invoke(1, "txn", [("r", "x", None)]),
+        ok(1, "txn", [("r", "x", 1)]),
+    ])
+    res = causal.CausalChecker().check({}, h)
+    assert res["valid?"] is False, res
+    assert any("G-single-process" in a or "G1c-process" in a
+               or "G0-process" in a for a in res["anomaly-types"]), res
+
+
+def test_causal_write_cycle_detected():
+    from jepsen_tpu.history import history, invoke, ok
+    from jepsen_tpu.workloads import causal
+
+    # wr cycle across processes: each reads the other's write before
+    # writing (G1c) — forbidden under causal
+    h = history([
+        invoke(0, "txn", [("w", "x", 1), ("r", "y", None)]),
+        invoke(1, "txn", [("w", "y", 9), ("r", "x", None)]),
+        ok(0, "txn", [("w", "x", 1), ("r", "y", 9)]),
+        ok(1, "txn", [("w", "y", 9), ("r", "x", 1)]),
+    ])
+    res = causal.CausalChecker().check({}, h)
+    assert res["valid?"] is False, res
+
+
+def test_causal_generator_shape():
+    import random
+
+    from jepsen_tpu.workloads import causal
+
+    g = causal.gen(rng=random.Random(1))
+    ops = [g({}, None) for _ in range(20)]
+    assert all(o["f"] == "txn" for o in ops)
+    assert any(len(o["value"]) == 2 for o in ops)  # rmw txns present
