@@ -175,12 +175,15 @@ def _run_size(n_txns: int, repeats: int):
     assert int(bits[-1]) == 1, "sweep did not converge on bench history"
     assert int(bits[:12].sum()) == 0, "bench history must be valid"
 
+    from jepsen_tpu.utils.profiling import trace
+
     best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        bits, over = core_check(h, p.n_keys)
-        jax.block_until_ready(bits)
-        best = min(best, time.perf_counter() - t0)
+    with trace(os.environ.get("BENCH_PROFILE_DIR")):
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            bits, over = core_check(h, p.n_keys)
+            jax.block_until_ready(bits)
+            best = min(best, time.perf_counter() - t0)
 
     ops_per_sec = n_txns / best
     return {

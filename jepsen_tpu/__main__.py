@@ -16,8 +16,8 @@ from .generator import core as g
 
 
 def _wl(name: str, opts: Dict[str, Any]):
-    from .workloads import (append, bank, linearizable_register, long_fork,
-                            queue, sets, wr)
+    from .workloads import (append, bank, causal, linearizable_register,
+                            long_fork, queue, sets, wr)
     from .workloads.mem import MemClient, MemStore
 
     rng = random.Random(opts.get("seed"))
@@ -38,6 +38,8 @@ def _wl(name: str, opts: Dict[str, Any]):
         return sets.workload(rng=rng), MemClient()
     if name == "queue":
         return queue.workload(rng=rng), MemClient()
+    if name == "causal":
+        return causal.workload(rng=rng), MemClient(txn_kind="rw-register")
     raise ValueError(f"unknown workload {name!r}")
 
 
@@ -70,7 +72,7 @@ def _demo_test(name: str):
 
 DEMOS = {n: _demo_test(n) for n in
          ("append", "wr", "lin-register", "bank", "long-fork", "set",
-          "queue")}
+          "queue", "causal")}
 
 if __name__ == "__main__":
     cli.main(cli.test_all_cmd(DEMOS, prog="python -m jepsen_tpu"))

@@ -1,0 +1,68 @@
+"""JAX profiler integration (SURVEY.md §5 "Tracing / profiling").
+
+The reference's post-hoc story is perf plots from history folds
+(`checker/perf.clj`); the TPU-native framework adds kernel-level
+tracing: wrap any checking call in :func:`trace` to capture an XLA/TPU
+profile viewable in TensorBoard or Perfetto (`xprof`), e.g.
+
+    with profiling.trace("/tmp/jax-trace"):
+        core_check(h, n_keys)
+
+The bench honors ``BENCH_PROFILE_DIR`` and wraps its timed repeats, so
+`BENCH_PROFILE_DIR=/tmp/tr python bench.py` yields the trace behind
+PROFILE.md's numbers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+logger = logging.getLogger("jepsen.profiling")
+
+
+@contextlib.contextmanager
+def trace(out_dir: Optional[str]) -> Iterator[None]:
+    """Capture a JAX profiler trace into `out_dir` (no-op when None or
+    when the profiler is unavailable — tracing must never break a
+    check).  Only profiler SETUP/TEARDOWN failures are swallowed; body
+    exceptions propagate untouched (a single yield outside any except —
+    re-yielding after a throw would mask the real error with
+    contextlib's "generator didn't stop" RuntimeError)."""
+    if not out_dir:
+        yield
+        return
+    started = False
+    try:
+        import jax
+
+        os.makedirs(out_dir, exist_ok=True)
+        prof = jax.profiler.trace(out_dir)
+        prof.__enter__()
+        started = True
+    except Exception:  # noqa: BLE001 — profiling is best-effort
+        logger.warning("jax profiler unavailable; continuing untraced",
+                       exc_info=True)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                prof.__exit__(None, None, None)
+                logger.info("jax profiler trace written to %s", out_dir)
+            except Exception:  # noqa: BLE001
+                logger.warning("jax profiler teardown failed",
+                               exc_info=True)
+
+
+def annotate(name: str):
+    """Named span inside a trace (TraceAnnotation), safe no-op without
+    a profiler."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001
+        return contextlib.nullcontext()

@@ -191,3 +191,13 @@ def test_drain_survives_transient_fails():
     d3 = d2.update({}, None, {"type": "fail", "f": "dequeue",
                               "error": "empty"})
     assert d3.done
+
+
+def test_cli_demo_causal(tmp_path, capsys):
+    from jepsen_tpu.__main__ import DEMOS
+    rc = cli.run(cli.test_all_cmd(DEMOS),
+                 ["--store-dir", str(tmp_path / "s"),
+                  "test-all", "--only", "causal", "--time-limit", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "demo-causal" in out and "valid? = True" in out

@@ -284,3 +284,27 @@ def test_packed_rw_history_valid_and_matches_host():
     res_host = rw_register.check(p, ["strict-serializable"],
                                  use_device=False)
     assert res_host["valid?"] is True, res_host["anomaly-types"]
+
+
+def test_fused_fast_path_on_large_history(monkeypatch):
+    # above the threshold a clean history returns via the fused device
+    # path without host inference; a seeded anomaly still gets the full
+    # host report
+    from jepsen_tpu.checkers.elle import rw_register as rw
+
+    monkeypatch.setattr(rw, "FUSED_MIN_TXNS", 1000)
+    p = synth.packed_rw_history(n_txns=2000, n_keys=50, seed=4)
+    res = rw.check(p, ["strict-serializable"])
+    assert res["valid?"] is True
+    assert res.get("fused-device") is True
+
+    h = concurrent_history(
+        ([["w", "x", 1], ["r", "y", None]],
+         [["w", "x", 1], ["r", "y", 9]]),
+        ([["w", "y", 9], ["r", "x", None]],
+         [["w", "y", 9], ["r", "x", 1]]),
+    )
+    # small history: host path with full anomaly report regardless
+    res_bad = rw.check(h, ["read-committed"])
+    assert res_bad["valid?"] is False
+    assert "G1c" in res_bad["anomalies"]

@@ -100,3 +100,18 @@ def test_coll():
     assert u.coll(None) == []
     assert u.coll(3) == [3]
     assert u.coll([1, 2]) == [1, 2]
+
+
+def test_profiler_trace_writes_and_is_safe(tmp_path):
+    import jax.numpy as jnp
+
+    from jepsen_tpu.utils import profiling
+
+    out = str(tmp_path / "tr")
+    with profiling.trace(out):
+        with profiling.annotate("span"):
+            jnp.arange(8).sum().block_until_ready()
+    import os as _os
+    assert _os.path.isdir(out) and _os.listdir(out)  # trace files exist
+    with profiling.trace(None):  # no-op path
+        pass
