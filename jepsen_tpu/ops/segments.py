@@ -46,8 +46,51 @@ def segmented_prefix_or(values: jnp.ndarray, starts: jnp.ndarray,
             [jnp.zeros_like(values[:1]), values[:-1]], axis=0)
         vals = jnp.where(_bcast(starts, shifted), jnp.zeros_like(shifted),
                          shifted)
-        return _seg_scan(vals, starts)
+        return _seg_or_impl(vals, starts)
+    return _seg_or_impl(values, starts)
+
+
+#: above this row count the loop-based scan is used: `associative_scan`
+#: unrolls ~2*log2(n) full-width combine steps into the HLO at trace
+#: time, and XLA:TPU compile time scales with that inflated graph (the
+#: round-3 compile wall, PROFILE.md §2) — the Hillis-Steele fori_loop
+#: body compiles ONCE and iterates log2(n) times at runtime.  O(n log n)
+#: work instead of O(n), but these are int8 OR lanes: compile time, not
+#: FLOPs, is the wall at 1M+-op shapes.
+LOOP_SCAN_MIN_ROWS = 1 << 17
+
+
+def _seg_or_impl(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
+    if values.shape[0] >= LOOP_SCAN_MIN_ROWS:
+        return _seg_scan_loop(values, starts)
     return _seg_scan(values, starts)
+
+
+def _seg_scan_loop(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
+    """Hillis-Steele segmented inclusive prefix-OR with doubling
+    strides: state (v, blocked); blocked[i] = a segment start lies in
+    (i - dist, i], so row i may not absorb the row `dist` back.  One
+    compiled body, ceil(log2(n)) runtime iterations — differential-
+    tested against the associative_scan path."""
+    import numpy as np
+
+    n = values.shape[0]
+    n_steps = max(1, int(np.ceil(np.log2(n))))
+    rows = jnp.arange(n)
+
+    def body(_, state):
+        v, blocked, dist = state
+        idx = rows - dist
+        ok = idx >= 0
+        src = jnp.clip(idx, 0, n - 1)
+        prev_v = jnp.where(_bcast(ok & ~blocked, v), v[src],
+                           jnp.zeros_like(v))
+        prev_blocked = jnp.where(ok, blocked[src], True)
+        return v | prev_v, blocked | prev_blocked, dist * 2
+
+    v, _, _ = jax.lax.fori_loop(
+        0, n_steps, body, (values, starts, jnp.int32(1)))
+    return v
 
 
 def _bcast(flags: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:

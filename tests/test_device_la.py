@@ -6,6 +6,7 @@ must match the exact host oracle.  `_force_no_fallback=True` ensures we are
 actually testing the device path, not the oracle fallback.
 """
 
+import numpy as np
 import pytest
 
 from jepsen_tpu.checkers.elle import list_append, oracle
@@ -161,3 +162,34 @@ def test_explainer_realtime_edge_positions():
     assert rt and "completed-at" in rt[0] and "invoked-at" in rt[0]
     wr = [e for e in cyc if e["rel"] == "wr"]
     assert wr and wr[0]["key"] == "x" and wr[0]["value"] == 1
+
+
+def test_loop_scan_path_matches_assoc_scan(monkeypatch):
+    # the Hillis-Steele fori_loop scan (used at 1M+ shapes to kill the
+    # associative_scan compile blowup, PROFILE.md §2) must give bitwise
+    # the same verdicts as the associative_scan path
+    from jepsen_tpu.checkers.elle.device_core import core_check
+    from jepsen_tpu.checkers.elle.device_infer import pad_packed
+    from jepsen_tpu.history.soa import pack_txns
+    from jepsen_tpu.ops import segments
+
+    cases = []
+    h1 = synth.la_history(n_txns=120, n_keys=5, concurrency=6,
+                          multi_append_prob=0.2, seed=21)
+    cases.append(pack_txns(h1, "list-append"))
+    h2 = synth.la_history(n_txns=120, n_keys=5, concurrency=6, seed=22)
+    synth.inject_rw_cycle(h2)
+    synth.inject_wr_cycle(h2)
+    cases.append(pack_txns(h2, "list-append"))
+
+    orig_threshold = segments.LOOP_SCAN_MIN_ROWS
+    for p in cases:
+        hp = pad_packed(p)
+        ref = np.asarray(core_check(hp, p.n_keys)[0])
+        monkeypatch.setattr(segments, "LOOP_SCAN_MIN_ROWS", 1)
+        core_check.clear_cache()
+        got = np.asarray(core_check(hp, p.n_keys)[0])
+        monkeypatch.setattr(segments, "LOOP_SCAN_MIN_ROWS",
+                            orig_threshold)
+        core_check.clear_cache()
+        assert np.array_equal(ref, got), (ref, got)
