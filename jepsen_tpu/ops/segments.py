@@ -62,6 +62,13 @@ LOOP_SCAN_MIN_ROWS = 1 << 17
 
 def _seg_or_impl(values: jnp.ndarray, starts: jnp.ndarray) -> jnp.ndarray:
     if values.shape[0] >= LOOP_SCAN_MIN_ROWS:
+        from jepsen_tpu.ops import pallas_scan
+
+        if pallas_scan.pallas_scan_enabled(values):
+            # one HBM pass (Pallas kernel, TPU backend) instead of
+            # log2(n) full-width passes; seg_or_auto carries the
+            # vmap-safe batching rule — see ops/pallas_scan.py
+            return pallas_scan.seg_or_auto(values, starts)
         return _seg_scan_loop(values, starts)
     return _seg_scan(values, starts)
 

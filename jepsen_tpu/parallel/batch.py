@@ -46,8 +46,8 @@ def stack_padded(hs: Sequence[PaddedLA]) -> PaddedLA:
     return PaddedLA(n_keys=first.n_keys, n_vals=first.n_vals, **out)
 
 
-def pad_batch(ps: Sequence[PackedTxns]) -> PaddedLA:
-    """Pad a list of PackedTxns to shared capacities and stack them."""
+def batch_caps(ps: Sequence[PackedTxns]) -> tuple:
+    """The shared padded capacities (T, M, R, n_keys) for a batch."""
     from jepsen_tpu.checkers.elle.device_infer import pow2_at_least
 
     T = pow2_at_least(max(p.n_txns for p in ps))
@@ -55,6 +55,15 @@ def pad_batch(ps: Sequence[PackedTxns]) -> PaddedLA:
     R = pow2_at_least(max(max(len(p.rd_elems), p.n_vals, p.n_keys + 1)
                           for p in ps))
     nk = max(p.n_keys for p in ps)
+    return T, M, R, nk
+
+
+def pad_batch(ps: Sequence[PackedTxns], caps: tuple = None) -> PaddedLA:
+    """Pad a list of PackedTxns to shared capacities and stack them.
+
+    `caps` (from `batch_caps`) overrides the per-call maxima so several
+    groups of one larger batch share one compiled executable."""
+    T, M, R, nk = caps if caps is not None else batch_caps(ps)
     padded = []
     for p in ps:
         h = pad_packed(p, t_pad=T, m_pad=M, r_pad=R)
@@ -69,16 +78,17 @@ def _batched_core(batch: PaddedLA, n_keys: int):
 
 
 def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
-                axis: str = "dp") -> List[dict]:
+                axis: str = "dp", caps: tuple = None) -> List[dict]:
     """Check a batch of histories, sharded across the mesh if given.
 
     Returns one summary dict per history: {"valid?", "bits", "exact"}.
     Batches that don't divide the mesh axis are padded internally (padding
     rows are dropped from the results).  Histories whose sweep overflowed
     the default backward-edge budget are re-run alone with a grown budget,
-    so verdicts are definitive whenever the caps allow.
+    so verdicts are definitive whenever the caps allow.  `caps` pins the
+    padded capacities (see `batch_caps`).
     """
-    batch = pad_batch(ps)
+    batch = pad_batch(ps, caps)
     n_keys = batch.n_keys
 
     if mesh is None:
@@ -143,4 +153,99 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
             },
             "exact": converged,
         })
+    return out
+
+
+def check_batch_checkpointed(ps: Sequence[PackedTxns], ckpt_path: str,
+                             mesh: Mesh = None, axis: str = "dp",
+                             group_size: int = 0) -> List[dict]:
+    """`check_batch` with chunk-level progress markers (SURVEY.md §5
+    checkpoint/resume: "checkpointable device checking … since a 10M-op
+    SCC run is minutes").
+
+    The batch is processed in groups of `group_size` histories (default:
+    one mesh row, or 8 unsharded); after each group its verdicts are
+    appended to `ckpt_path` as JSON lines {"i": …, "result": …} and
+    fsync'd.  A rerun with the same path skips every history already
+    judged — a crashed control process resumes mid-batch instead of
+    repaying the full device run.  Grouping also bounds device memory:
+    one group's padded arrays are resident at a time, not the whole
+    batch (the config-5 regime: 100 x 1M-op histories).
+
+    The checkpoint records per-history content digests; a resume against
+    different histories at the same path raises instead of mixing runs.
+    """
+    import hashlib
+    import json
+    import os
+
+    def digest(p: PackedTxns) -> str:
+        # every packed column that inference reads: two runs with the
+        # same op content but a different interleaving (process
+        # assignment, invoke/complete order, read segments) must NOT
+        # share a digest — process/realtime cycle bits depend on them
+        h = hashlib.sha256()
+        for a in (p.txn_type, p.txn_process, p.txn_invoke_pos,
+                  p.txn_complete_pos, p.mop_txn, p.mop_kind, p.mop_key,
+                  p.mop_val, p.mop_rd_start, p.mop_rd_len, p.rd_elems):
+            h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()[:16]
+
+    if not group_size:
+        group_size = mesh.devices.size if mesh is not None else 8
+    done: dict = {}
+    if os.path.exists(ckpt_path):
+        good_bytes = 0
+        with open(ckpt_path, "rb") as f:
+            for line in f:
+                if not line.strip():
+                    good_bytes += len(line)
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # torn trailing record from a crash mid-append — the
+                    # exact scenario checkpoints exist for; drop it and
+                    # resume from the last durable record
+                    break
+                if not line.endswith(b"\n"):
+                    # parseable but unterminated: a later append would
+                    # fuse with it — treat as torn too
+                    break
+                done[rec["i"]] = rec
+                good_bytes += len(line)
+        with open(ckpt_path, "r+b") as f:
+            f.truncate(good_bytes)
+    out: List[dict] = [None] * len(ps)
+    digests = [digest(p) for p in ps]
+    for i, rec in done.items():
+        if i >= len(ps) or rec["digest"] != digests[i]:
+            raise ValueError(
+                f"checkpoint {ckpt_path} is from a different batch "
+                f"(history {i} digest mismatch); refusing to mix runs")
+        out[i] = rec["result"]
+
+    # one set of padded capacities across groups: per-group maxima would
+    # recompile the check whenever a group's largest history crosses a
+    # pow2 bucket (a ~19 min cold compile at TPU 1M-op shapes)
+    caps = batch_caps(ps)
+    with open(ckpt_path, "a") as f:
+        for g0 in range(0, len(ps), group_size):
+            idx = [i for i in range(g0, min(g0 + group_size, len(ps)))
+                   if out[i] is None]
+            if not idx:
+                continue
+            # pad partial/resumed groups to a fixed batch dim (copies of
+            # the first member, dropped below): a smaller leading dim
+            # would recompile _batched_core — the very cost caps pin down
+            group = [ps[i] for i in idx]
+            group += [group[0]] * (group_size - len(group))
+            results = check_batch(group, mesh=mesh, axis=axis,
+                                  caps=caps)[:len(idx)]
+            for i, r in zip(idx, results):
+                out[i] = r
+                f.write(json.dumps(
+                    {"i": i, "digest": digests[i], "result": r}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
     return out

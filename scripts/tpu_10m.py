@@ -1,0 +1,67 @@
+"""Config-4 attempt: a 10M-txn list-append check on the single real TPU
+chip (PROFILE.md §2b did this on CPU only: 353 s steady, 26.8 GB host).
+
+HBM accounting at padded shapes T=2^24, M=2^26, R=2^27:
+  mop arrays   6 x 2^26 x 4B int32 + kinds/masks  ≈ 1.7 GB
+  rd_elems     2^27 x 4B                          ≈ 0.5 GB
+  label plane  (2^25, 128) int8                   ≈ 4   GB
+  sort workspaces (XLA)                           ≈ transient
+Should fit a 16 GB v5e chip; the open risks are compile time at these
+shapes and sort scratch.  The number (even a DNF with a reason) is the
+deliverable.
+
+Usage: python scripts/tpu_10m.py [n_txns]  (default 10M; needs TPU free)
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from jepsen_tpu.utils.backend import enable_compile_cache
+
+
+def main():
+    n_txns = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    enable_compile_cache()
+    print("backend:", jax.default_backend(), flush=True)
+
+    from jepsen_tpu.checkers.elle.device_core import core_check
+    from jepsen_tpu.checkers.elle.device_infer import pad_packed
+    from jepsen_tpu.workloads import synth
+
+    t0 = time.perf_counter()
+    p = synth.packed_la_history(n_txns=n_txns, n_keys=max(64, n_txns // 8),
+                                mops_per_txn=4, read_frac=0.25, seed=7)
+    print(f"gen {time.perf_counter() - t0:.1f}s", flush=True)
+
+    t0 = time.perf_counter()
+    h = jax.device_put(pad_packed(p))
+    jax.block_until_ready(h)
+    print(f"pad+stage {time.perf_counter() - t0:.1f}s "
+          f"T={h.txn_type.shape[0]} M={h.mop_txn.shape[0]} "
+          f"R={h.rd_elems.shape[0]}", flush=True)
+
+    t0 = time.perf_counter()
+    bits, over = core_check(h, p.n_keys)
+    jax.block_until_ready(bits)
+    print(f"compile+first {time.perf_counter() - t0:.1f}s "
+          f"converged={int(np.asarray(bits)[-1])} "
+          f"over={int(np.asarray(over))}", flush=True)
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        bits, over = core_check(h, p.n_keys)
+        jax.block_until_ready(bits)
+        best = min(best, time.perf_counter() - t0)
+    print(f"steady {best:.2f}s = {n_txns / best:,.0f} txns/s "
+          f"(target: 10M in 60s on v5e-8; single chip share = "
+          f"{n_txns / best / (10_000_000 / 60 / 8):.2f}x)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Time the fused rw-register device check at config-3 scale (1M txns)
+on the real TPU (PROFILE.md §4 had CPU numbers only; tunnel was down).
+
+Usage: python scripts/tpu_rw_1m.py [n_txns]
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import numpy as np
+
+from jepsen_tpu.utils.backend import enable_compile_cache
+
+
+def main():
+    n_txns = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    enable_compile_cache()
+    print("backend:", jax.default_backend())
+
+    from jepsen_tpu.checkers.elle import device_rw
+    from jepsen_tpu.workloads import synth
+
+    t0 = time.perf_counter()
+    p = synth.packed_rw_history(n_txns=n_txns, n_keys=max(64, n_txns // 8),
+                                seed=11)
+    print(f"gen {time.perf_counter() - t0:.1f}s; n_txns={p.n_txns}")
+
+    from jepsen_tpu.checkers.elle.device_rw import pad_packed
+
+    t0 = time.perf_counter()
+    h = jax.device_put(pad_packed(p))
+    jax.block_until_ready(h)
+    print(f"pad+stage {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    res = device_rw.check(h)
+    print(f"compile+first {time.perf_counter() - t0:.1f}s; "
+          f"valid?={res['valid?']} exact={res['exact']}")
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = device_rw.check(h)
+        best = min(best, time.perf_counter() - t0)
+    print(f"steady {best:.2f}s = {n_txns / best:,.0f} txns/s")
+
+
+if __name__ == "__main__":
+    main()
