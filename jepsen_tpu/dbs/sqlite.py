@@ -173,12 +173,10 @@ class SqliteClient(Client):
             self.conn = None
 
 
-def append_test(opts: Dict[str, Any]) -> Dict[str, Any]:
-    """List-append over SQLite (the elle flagship on a real DB)."""
+def _make_test(opts: Dict[str, Any], name: str, wl: Dict[str, Any],
+               txn_kind: str) -> Dict[str, Any]:
     from jepsen_tpu.generator import core as g
-    from jepsen_tpu.workloads import append
 
-    wl = append.workload()
     database = SqliteDB()
     test = dict(opts)
     if test.get("remote") is None:
@@ -188,39 +186,29 @@ def append_test(opts: Dict[str, Any]) -> Dict[str, Any]:
         # download) engages — the "nodes" are local for SQLite
         test["remote"] = LoopbackRemote()
     test.update({
-        "name": "sqlite-append",
+        "name": name,
         "nodes": opts.get("nodes") or ["local"],
         "db": database,
-        "client": SqliteClient(database),
+        "client": SqliteClient(database, txn_kind=txn_kind),
         "generator": g.clients(wl["generator"]),
         "checker": wl["checker"],
     })
     return test
+
+
+def append_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    """List-append over SQLite (the elle flagship on a real DB)."""
+    from jepsen_tpu.workloads import append
+
+    return _make_test(opts, "sqlite-append", append.workload(),
+                      "list-append")
 
 
 def wr_test(opts: Dict[str, Any]) -> Dict[str, Any]:
     """rw-register over SQLite."""
-    from jepsen_tpu.generator import core as g
     from jepsen_tpu.workloads import wr
 
-    wl = wr.workload()
-    database = SqliteDB()
-    test = dict(opts)
-    if test.get("remote") is None:
-        from jepsen_tpu.control.local import LoopbackRemote
-
-        # a real remote so the full spine (OS/DB setup, teardown, log
-        # download) engages — the "nodes" are local for SQLite
-        test["remote"] = LoopbackRemote()
-    test.update({
-        "name": "sqlite-wr",
-        "nodes": opts.get("nodes") or ["local"],
-        "db": database,
-        "client": SqliteClient(database, txn_kind="rw-register"),
-        "generator": g.clients(wl["generator"]),
-        "checker": wl["checker"],
-    })
-    return test
+    return _make_test(opts, "sqlite-wr", wr.workload(), "rw-register")
 
 
 if __name__ == "__main__":

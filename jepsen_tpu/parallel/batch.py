@@ -121,20 +121,29 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
 
         bits, over = sharded(batch)
 
-    bits = np.array(bits)
-    over = np.array(over)
-    out = []
+    return summarize_batch_bits(bits, over, batch, n_keys, len(ps))
+
+
+def summarize_batch_bits(bits, over, batch, n_keys: int, n_real: int,
+                         k_floor: int = 128) -> List[dict]:
+    """Per-history summary rows from batched (bits, over) outputs, with
+    the exact-rerun fallback: any inexact verdict (backward-edge
+    overflow or fixpoint truncation) re-runs that history alone through
+    `core_check_exact`, seeding the budget past the observed overflow so
+    the failed config isn't repeated.  Shared by `check_batch` and the
+    hybrid 2D path (verdicts stay identical by construction)."""
     from jepsen_tpu.checkers.elle.device_core import COUNT_NAMES, \
         core_check_exact
-    for i in range(len(ps)):
+
+    bits = np.array(bits)   # writable copies — np.asarray of a jax
+    over = np.array(over)   # array is a read-only view
+    out: List[dict] = []
+    for i in range(n_real):
         row = bits[i]
         if int(over[i]) > 0 or int(row[-1]) != 1:
-            # inexact (backward-edge overflow or fixpoint truncation):
-            # re-run this history alone, seeding the budget past the
-            # overflow already observed so the failed config isn't repeated
             from jepsen_tpu.checkers.elle.device_infer import pow2_at_least
 
-            k0 = pow2_at_least(128 + int(over[i]), floor=128)
+            k0 = pow2_at_least(k_floor + int(over[i]), floor=k_floor)
             h_i = jax.tree_util.tree_map(lambda x: x[i], batch)
             b2, o2 = core_check_exact(h_i, n_keys, max_k=k0)
             row = np.asarray(b2)

@@ -1,0 +1,110 @@
+"""Hybrid 2D checking: batch data-parallelism × K-axis sweep sharding.
+
+The multi-host shape (SURVEY.md §5 "Distributed communication backend":
+ICI collectives within a host/pod slice, DCN across hosts; §2.7 "Batched
+multi-history DP").  The mesh has two axes:
+
+  dcn — one batch shard per row (across hosts on a real pod: the only
+        cross-row traffic is the final per-history bit vectors, so this
+        axis can ride the slow DCN links)
+  k   — the backward-edge windows of `parallel/op_shard.py` within a
+        row (the per-round meta-graph all_gather + convergence psum stay
+        on ICI)
+
+Each (dcn-row, history) pair runs the full fused inference locally
+(replicated along `k`, like `op_shard.shard_padded`'s fallback) and
+sweeps only its (N, max_k/n_k) label-plane window — so a 100 × 1M-op
+batch (BASELINE config 5) divides both ways: histories across rows,
+label-plane memory across `k`.
+
+On a real multi-host pod build the mesh with
+`jax.experimental.mesh_utils.create_hybrid_device_mesh((n_k,), (n_dcn,))`
+so `dcn` crosses hosts; on one host `make_hybrid_mesh` reshapes the
+local devices.  Verdicts are bitwise-identical to unsharded
+`check_batch` (differential-tested on the virtual mesh,
+tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jepsen_tpu.checkers.elle.device_infer import infer
+from jepsen_tpu.history.soa import PackedTxns
+from jepsen_tpu.ops.cycle_sweep import _sweep_window
+from jepsen_tpu.parallel.batch import (
+    batch_caps,
+    pad_batch,
+    summarize_batch_bits,
+)
+from jepsen_tpu.parallel.op_shard import projection_sweep_bits
+
+
+def make_hybrid_mesh(n_dcn: int, n_k: int, devices=None) -> Mesh:
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    assert devs.size >= n_dcn * n_k, (devs.size, n_dcn, n_k)
+    return Mesh(devs[:n_dcn * n_k].reshape(n_dcn, n_k), ("dcn", "k"))
+
+
+@partial(jax.jit, static_argnames=("n_keys", "mesh", "max_k", "max_rounds"))
+def _hybrid_core(batch, n_keys: int, mesh: Mesh, max_k: int = 128,
+                 max_rounds: int = 64):
+    n_k = mesh.shape["k"]
+    assert max_k % n_k == 0, (max_k, n_k)
+    k_local = max_k // n_k
+    T = batch.txn_type.shape[1]
+
+    bspec = P("dcn")
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(bspec,),
+             out_specs=(bspec, bspec))
+    def rows(b):
+        def one(h):
+            out = infer(h, n_keys)
+
+            def sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_):
+                off = jax.lax.axis_index("k") * k_local
+                return _sweep_window(2 * T, max_k, k_local, max_rounds,
+                                     rank_, e_src_, e_dst_, m_, cn_, cs_,
+                                     cm_, k_offset=off, axis_name="k")
+
+            return projection_sweep_bits(out, max_k, sweep)
+
+        return jax.vmap(one)(b)
+
+    return rows(batch)
+
+
+def check_batch_hybrid(ps: Sequence[PackedTxns], mesh: Mesh,
+                       max_k: int = 128, max_rounds: int = 64
+                       ) -> List[dict]:
+    """Check a batch of histories over a 2D ("dcn", "k") mesh; one
+    summary dict per history (the `check_batch` row shape).
+
+    The batch is padded to a multiple of the dcn axis with copies of the
+    first history (dropped from the results).  Inexact verdicts
+    (overflow / non-convergence) are re-run alone through the exact
+    single-device path rather than approximated.
+    """
+    n_dcn = mesh.shape["dcn"]
+    n_k = mesh.shape["k"]
+    if max_k % n_k:
+        max_k = ((max_k // n_k) + 1) * n_k
+
+    caps = batch_caps(ps)
+    n_real = len(ps)
+    fill = (-n_real) % n_dcn
+    batch = pad_batch(list(ps) + [ps[0]] * fill, caps)
+    batch = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P("dcn"))), batch)
+
+    bits, over = _hybrid_core(batch, batch.n_keys, mesh, max_k=max_k,
+                              max_rounds=max_rounds)
+    return summarize_batch_bits(bits, over, batch, batch.n_keys, n_real,
+                                k_floor=max_k)

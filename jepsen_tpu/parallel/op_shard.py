@@ -49,18 +49,17 @@ from jepsen_tpu.history.soa import PackedTxns
 from jepsen_tpu.ops.cycle_sweep import _sweep_window
 
 
-@partial(jax.jit,
-         static_argnames=("n_keys", "mesh", "axis", "max_k", "max_rounds"))
-def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
-                        max_k: int = 128, max_rounds: int = 64):
-    """core_check with the sweep's backward-edge axis sharded over the
-    mesh.  Same bit layout as device_core.core_check."""
-    n_shards = mesh.shape[axis]
-    assert max_k % n_shards == 0, (max_k, n_shards)
-    k_local = max_k // n_shards
+def projection_sweep_bits(out, max_k: int, sweep):
+    """The 5-projection scan over an inferred edge set, with `sweep` a
+    callable (rank, e_src, e_dst, mask, chain_nodes, chain_starts,
+    chain_mask) -> (has_cycle, witness, n_back, converged).
 
-    out = infer(h, n_keys)
-    T = h.txn_type.shape[0]
+    One sweep instantiation scanned over the 5 projections — same
+    compile-time + label-plane-memory rationale as device_core.core_check
+    (5 inlined while_loop kernels measured 125.8 s of XLA compile at
+    100k-txn shapes in round 2).  Shared by the K-axis sharded path and
+    the 2D hybrid (dcn x k) path (`parallel/hybrid.py`).
+    """
     edges = out["edges"]
     chains = out["chains"]
     rank = jnp.concatenate([out["ranks"]["txn"], out["ranks"]["barrier"]])
@@ -78,20 +77,6 @@ def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
     pc_off = jnp.zeros_like(pc_mask)
     bc_off = jnp.zeros_like(bc_mask)
 
-    rep = P()
-
-    @partial(jax.shard_map, mesh=mesh,
-             in_specs=(rep,) * 7, out_specs=(rep, rep, rep, rep))
-    def sharded_sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_):
-        off = jax.lax.axis_index(axis) * k_local
-        return _sweep_window(2 * T, max_k, k_local, max_rounds,
-                             rank_, e_src_, e_dst_, m_, cn_, cs_, cm_,
-                             k_offset=off, axis_name=axis)
-
-    # One sweep instantiation scanned over the 5 projections — same
-    # compile-time + label-plane-memory rationale as device_core.core_check
-    # (5 inlined while_loop kernels measured 125.8 s of XLA compile at
-    # 100k-txn shapes in round 2).
     m_stack = jnp.stack([
         jnp.concatenate([
             masks["ww"] if "ww" in proj else z["ww"],
@@ -109,20 +94,49 @@ def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
     def proj_body(carry, mc):
         conv_all, overflow = carry
         m, cm = mc
-        has, _, n_back, conv = sharded_sweep(
+        has, _, n_back, conv = sweep(
             rank, e_src, e_dst, m, chain_nodes, chain_starts, cm)
         carry = (conv_all & conv,
                  jnp.maximum(overflow, jnp.maximum(n_back - max_k, 0)))
         return carry, has.astype(jnp.int32)
 
+    # carry init derives from the data so its varying-axis type matches
+    # the body outputs when this whole function runs INSIDE a shard_map
+    # (the hybrid dcn-row case) as well as outside (the K-axis case)
+    zero = (rank[0] * 0).astype(jnp.int32)
     (conv_all, overflow), cyc_bits = jax.lax.scan(
-        proj_body, (jnp.array(True), jnp.int32(0)), (m_stack, cm_stack))
+        proj_body, (zero == 0, zero), (m_stack, cm_stack))
 
     counts = jnp.stack([out["counts"][n].astype(jnp.int32)
                         for n in COUNT_NAMES])
     bits = jnp.concatenate(
         [counts, cyc_bits, conv_all.astype(jnp.int32)[None]])
     return bits, overflow
+
+
+@partial(jax.jit,
+         static_argnames=("n_keys", "mesh", "axis", "max_k", "max_rounds"))
+def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
+                        max_k: int = 128, max_rounds: int = 64):
+    """core_check with the sweep's backward-edge axis sharded over the
+    mesh.  Same bit layout as device_core.core_check."""
+    n_shards = mesh.shape[axis]
+    assert max_k % n_shards == 0, (max_k, n_shards)
+    k_local = max_k // n_shards
+
+    out = infer(h, n_keys)
+    T = h.txn_type.shape[0]
+    rep = P()
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(rep,) * 7, out_specs=(rep, rep, rep, rep))
+    def sharded_sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_):
+        off = jax.lax.axis_index(axis) * k_local
+        return _sweep_window(2 * T, max_k, k_local, max_rounds,
+                             rank_, e_src_, e_dst_, m_, cn_, cs_, cm_,
+                             k_offset=off, axis_name=axis)
+
+    return projection_sweep_bits(out, max_k, sharded_sweep)
 
 
 def shard_padded(h: PaddedLA, mesh: Mesh, axis: str = "dp"

@@ -7,11 +7,7 @@ anomalies; and direct dirty-write abuse at the SQL level must be caught
 by the checker when we bypass transactions.
 """
 
-import os
 import sqlite3
-import threading
-
-import pytest
 
 from jepsen_tpu import core
 from jepsen_tpu.dbs import sqlite as sq
