@@ -158,22 +158,39 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
         is_append.astype(jnp.int32))[:V]
     duplicate_appends = jnp.sum((app_count > 1).astype(jnp.int32))
 
+    # ---- (txn, key, pos) run order ---------------------------------------
+    # shared by final-append detection and the internal-consistency pass
+    # (historically two separate M-sized lexsorts; M-sorts are a top
+    # cost).  Two sort keys, not three: a STABLE sort breaks (txn, key)
+    # ties in operand order, which is already mop position — and the
+    # sorted iota payload IS the permutation.
+    _, _, run_sort = jax.lax.sort(
+        (jnp.where(h.mop_mask, h.mop_txn, T),
+         jnp.where(h.mop_mask, h.mop_key, nk),
+         mop_pos),
+        num_keys=2, is_stable=True)
+    inv_run = jnp.zeros(M, jnp.int32).at[run_sort].set(mop_pos)
+    t2 = jnp.where(h.mop_mask, h.mop_txn, T)[run_sort]
+    k2 = jnp.where(h.mop_mask, h.mop_key, nk)[run_sort]
+    app2 = is_append[run_sort]
+    known2 = known_read[run_sort]
+    len2 = h.mop_rd_len[run_sort]
+    val2 = h.mop_val[run_sort]
+    run_start = jnp.concatenate([jnp.ones(1, bool),
+                                 (t2[1:] != t2[:-1]) | (k2[1:] != k2[:-1])])
+    run_end = jnp.concatenate([run_start[1:], jnp.ones(1, bool)])
+    q = jnp.arange(M, dtype=jnp.int32)
+
     # final vs intermediate appends: an append is final iff it is the last
-    # append of its (txn, key) group — detected on mops sorted by
-    # (txn, key, pos)
-    sort_app = jnp.lexsort((mop_pos,
-                            jnp.where(is_append, h.mop_key, nk),
-                            jnp.where(is_append, h.mop_txn, T)))
-    sa_txn = h.mop_txn[sort_app]
-    sa_key = h.mop_key[sort_app]
-    sa_app = is_append[sort_app]
-    sa_val = h.mop_val[sort_app]
-    nxt_same = jnp.concatenate([(sa_txn[1:] == sa_txn[:-1]) &
-                                (sa_key[1:] == sa_key[:-1]) & sa_app[1:],
-                                jnp.zeros(1, bool)])
-    sa_final = sa_app & ~nxt_same
+    # append of its (txn, key) run — i.e. its run's exclusive suffix holds
+    # no append.  Reverse segmented cummax of append positions (scan the
+    # reversed axis; segment starts there are the reversed run ends).
+    suf_app_q = segmented_cummax(
+        jnp.where(app2, q, -1)[::-1], run_end[::-1],
+        exclusive=True, neutral=-1)[::-1]
+    run_final = app2 & (suf_app_q < 0)
     is_final = jnp.zeros(V + 1, bool).at[
-        jnp.where(sa_app, sa_val, V)].max(sa_final)[:V]
+        jnp.where(app2, val2, V)].max(run_final)[:V]
 
     # ---- version orders (longest known read per key) ---------------------
     key_slot = jnp.where(known_read, h.mop_key, nk)
@@ -191,10 +208,16 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
         [jnp.zeros(1, jnp.int32), jnp.cumsum(ord_len)[:-1].astype(jnp.int32)])
     total_ord = jnp.sum(ord_len)
 
-    # materialize ord_elems: slot j belongs to key k(j) at offset o(j)
+    # materialize ord_elems: slot j belongs to key k(j) at offset o(j).
+    # slot_key = max key whose segment start <= slot (starts are monotone;
+    # zero-length keys share a start and the scatter-max picks the last,
+    # which is the containing one) — a scatter + cummax forward fill, an
+    # O(R) replacement for the former O(R log nk) searchsorted
     slot = jnp.arange(R, dtype=jnp.int32)
-    slot_key = jnp.clip(
-        jnp.searchsorted(ord_start, slot, side="right") - 1, 0, nk - 1)
+    key_ids = jnp.arange(nk, dtype=jnp.int32)
+    sk_seed = jnp.full(R + 1, -1, jnp.int32).at[
+        jnp.clip(ord_start, 0, R)].max(key_ids)[:R]
+    slot_key = jnp.clip(jax.lax.cummax(sk_seed), 0, nk - 1)
     slot_off = slot - ord_start[slot_key]
     slot_valid = slot < total_ord
     src_read = ord_read[slot_key]
@@ -233,12 +256,17 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     g1a_count = jnp.sum(g1a.astype(jnp.int32))
     g1a_witness = jnp.argmax(g1a)
 
-    # duplicate elements inside one read: adjacent equal after a
-    # (read, value) sort
-    d_order = jnp.lexsort((jnp.where(elem_in_read, ev, V),
-                           jnp.where(elem_in_read, elem_read, M)))
-    d_read = jnp.where(elem_in_read, elem_read, M)[d_order]
-    d_val = jnp.where(elem_in_read, ev, V)[d_order]
+    # duplicate elements inside one read: adjacent equal (read, value)
+    # pairs after ONE stable single-key sort by value (R-sized sorts are
+    # the top inference cost; the former 2-key lexsort was ~4x this).
+    # Exact because elem_read is monotone over slots: within an
+    # equal-value block a stable sort preserves slot order, and one
+    # read's slots are contiguous, so equal (read, value) pairs land
+    # adjacent.
+    d_val, d_read = jax.lax.sort(
+        (jnp.where(elem_in_read, ev, V),
+         jnp.where(elem_in_read, elem_read, M)),
+        num_keys=1, is_stable=True)
     dups = (d_read[1:] == d_read[:-1]) & (d_val[1:] == d_val[:-1]) & \
         (d_read[1:] < M)
     duplicate_elements = jnp.sum(dups.astype(jnp.int32))
@@ -267,19 +295,8 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # (base = P, or L - n when no previous read) must equal the appended
     # values at run positions q-n .. q-1, in order.  Exact given
     # prefix-compatible reads (see module docstring).
-    run_sort = jnp.lexsort((mop_pos,
-                            jnp.where(h.mop_mask, h.mop_key, nk),
-                            jnp.where(h.mop_mask, h.mop_txn, T)))
-    inv_run = jnp.zeros(M, jnp.int32).at[run_sort].set(mop_pos)
-    t2 = jnp.where(h.mop_mask, h.mop_txn, T)[run_sort]
-    k2 = jnp.where(h.mop_mask, h.mop_key, nk)[run_sort]
-    app2 = is_append[run_sort]
-    known2 = known_read[run_sort]
-    len2 = h.mop_rd_len[run_sort]
-    val2 = h.mop_val[run_sort]
-    run_start = jnp.concatenate([jnp.ones(1, bool),
-                                 (t2[1:] != t2[:-1]) | (k2[1:] != k2[:-1])])
-    q = jnp.arange(M, dtype=jnp.int32)
+    # (run_sort order and its per-run arrays are computed above, beside
+    # the final-append detection that shares them)
     cum_app_excl = segmented_cumsum(app2.astype(jnp.int32), run_start,
                                     exclusive=True)
     prev_q = segmented_cummax(jnp.where(known2, q, -1), run_start,
@@ -346,7 +363,8 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # process chains: ok/info txns by (process, invoke_pos); complete_pos is
     # monotone along a process chain, so ranks increase as required
     pslot = jnp.where(h.txn_mask & graph_txn, h.txn_process, BIG)
-    porder = jnp.lexsort((h.txn_invoke_pos, pslot))
+    _, _, porder = jax.lax.sort(
+        (pslot, h.txn_invoke_pos, tidx), num_keys=2, is_stable=True)
     p_nodes = porder.astype(jnp.int32)
     p_sorted = pslot[porder]
     p_mask = p_sorted < BIG

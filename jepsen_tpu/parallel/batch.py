@@ -88,23 +88,18 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
     so verdicts are definitive whenever the caps allow.  `caps` pins the
     padded capacities (see `batch_caps`).
     """
+    n_real = len(ps)
+    if mesh is not None:
+        # pad the batch with copies of history 0 so it divides the mesh;
+        # padding rows are dropped by summarize_batch_bits (the same
+        # pre-stack fill check_batch_hybrid and _checkpointed use)
+        ps = list(ps) + [ps[0]] * ((-n_real) % mesh.devices.size)
     batch = pad_batch(ps, caps)
     n_keys = batch.n_keys
 
     if mesh is None:
         bits, over = _batched_core(batch, n_keys)
     else:
-        n_dev = mesh.devices.size
-        n_real = len(ps)
-        if n_real % n_dev:
-            # pad the batch with copies of history 0 so it divides the
-            # mesh; padding rows are dropped below
-            n_fill = n_dev - (n_real % n_dev)
-            fill = jax.tree_util.tree_map(
-                lambda x: jnp.concatenate(
-                    [x, jnp.broadcast_to(x[:1], (n_fill,) + x.shape[1:])]),
-                batch)
-            batch = fill
         spec = P(axis)
         in_shard = NamedSharding(mesh, spec)
 
@@ -121,7 +116,7 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
 
         bits, over = sharded(batch)
 
-    return summarize_batch_bits(bits, over, batch, n_keys, len(ps))
+    return summarize_batch_bits(bits, over, batch, n_keys, n_real)
 
 
 def summarize_batch_bits(bits, over, batch, n_keys: int, n_real: int,

@@ -15,3 +15,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from jepsen_tpu.utils.backend import force_cpu_backend
 
 force_cpu_backend(8)
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Release compiled executables between test modules.
+
+    The full suite compiles several hundred XLA:CPU executables in one
+    process; with all of them held live, a late large compile segfaults
+    inside `backend_compile_and_load` (reproducible at the same test
+    with and without background load).  Dropping the jit caches between
+    modules caps live executable memory and keeps the suite green; the
+    cost is re-compiling shared helpers a few times (~1 min over the
+    whole suite).
+    """
+    yield
+    import jax
+
+    jax.clear_caches()
