@@ -115,8 +115,8 @@ def core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
     return bits, overflow
 
 
-MAX_K_CAP = 8192
-MAX_ROUNDS_CAP = 1024
+# budget caps live with the sweep kernel; re-exported here for callers
+from jepsen_tpu.ops.cycle_sweep import MAX_K_CAP, MAX_ROUNDS_CAP  # noqa: E402,F401
 
 
 def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,

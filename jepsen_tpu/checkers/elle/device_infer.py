@@ -164,14 +164,12 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # cost).  Two sort keys, not three: a STABLE sort breaks (txn, key)
     # ties in operand order, which is already mop position — and the
     # sorted iota payload IS the permutation.
-    _, _, run_sort = jax.lax.sort(
+    t2, k2, run_sort = jax.lax.sort(
         (jnp.where(h.mop_mask, h.mop_txn, T),
          jnp.where(h.mop_mask, h.mop_key, nk),
          mop_pos),
         num_keys=2, is_stable=True)
     inv_run = jnp.zeros(M, jnp.int32).at[run_sort].set(mop_pos)
-    t2 = jnp.where(h.mop_mask, h.mop_txn, T)[run_sort]
-    k2 = jnp.where(h.mop_mask, h.mop_key, nk)[run_sort]
     app2 = is_append[run_sort]
     known2 = known_read[run_sort]
     len2 = h.mop_rd_len[run_sort]
@@ -363,10 +361,9 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # process chains: ok/info txns by (process, invoke_pos); complete_pos is
     # monotone along a process chain, so ranks increase as required
     pslot = jnp.where(h.txn_mask & graph_txn, h.txn_process, BIG)
-    _, _, porder = jax.lax.sort(
+    p_sorted, _, porder = jax.lax.sort(
         (pslot, h.txn_invoke_pos, tidx), num_keys=2, is_stable=True)
     p_nodes = porder.astype(jnp.int32)
-    p_sorted = pslot[porder]
     p_mask = p_sorted < BIG
     p_starts = jnp.concatenate([jnp.ones(1, bool),
                                 p_sorted[1:] != p_sorted[:-1]])

@@ -225,6 +225,12 @@ def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
 _sweep = jax.jit(_sweep_arrays,
                  static_argnames=("n_nodes", "max_k", "max_rounds"))
 
+#: budget ceilings shared by every sweep driver (detect_cycles here,
+#: grow_until_exact in device_core): past these, callers fall back to
+#: the host oracle rather than approximate
+MAX_K_CAP = 8192
+MAX_ROUNDS_CAP = 1024
+
 
 @dataclasses.dataclass
 class SweepResult:
@@ -250,6 +256,13 @@ def detect_cycles(g: SweepGraph, max_k: int = 128,
         # too many backward edges for the bit budget: double and retry
         return detect_cycles(g, max_k=max(max_k * 2, _pow2(n_back)),
                              max_rounds=max_rounds)
+    if not bool(conv) and max_rounds < MAX_ROUNDS_CAP:
+        # fixpoint truncated: grow rounds like grow_until_exact does for
+        # the fused path (histories dense with injected cycles can need
+        # hundreds of rounds) before surrendering to the host fallback
+        return detect_cycles(g, max_k=max_k,
+                             max_rounds=min(max_rounds * 2,
+                                            MAX_ROUNDS_CAP))
     wit = np.asarray(wit)
     conv = bool(conv)
     has = bool(has)

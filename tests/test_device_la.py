@@ -193,3 +193,18 @@ def test_loop_scan_path_matches_assoc_scan(monkeypatch):
                             orig_threshold)
         core_check.clear_cache()
         assert np.array_equal(ref, got), (ref, got)
+
+
+def test_device_converges_on_round_hungry_history():
+    """Fuzz regression (2026-07-30): dense injected cycles can need
+    hundreds of propagation rounds; detect_cycles must grow max_rounds
+    (like the fused path's grow_until_exact) instead of surrendering to
+    the host fallback at 64."""
+    h = synth.la_history(n_txns=400, n_keys=2, concurrency=8,
+                         info_prob=0.2, multi_append_prob=0.2,
+                         seed=569558050)
+    synth.inject_wr_cycle(h)
+    synth.inject_rw_cycle(h)
+    r = list_append.check(h, ["serializable"], _force_no_fallback=True)
+    assert r["valid?"] is False
+    assert "G1c" in r["anomaly-types"]
