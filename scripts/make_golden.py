@@ -1,0 +1,166 @@
+"""Generate the golden history corpus (tests/data/*.json).
+
+Reference parity: knossos ships `data/` dirs of known good/bad stored
+histories checked for expected verdicts (SURVEY.md §4 "golden-file
+style").  Each file freezes one history + the verdict established at
+generation time; `tests/test_golden.py` replays every file through the
+host oracle AND the device pipeline and demands the stored verdict —
+pinning today's checker behavior against regressions.
+
+Rerun only to EXTEND the corpus (files are stable given seeds):
+    python scripts/make_golden.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from jepsen_tpu.utils.backend import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "data")
+
+
+def _ops_to_json(h):
+    return [{"type": op.type, "process": op.process, "f": op.f,
+             "value": op.value} for op in h]
+
+
+def la_cases():
+    from jepsen_tpu.checkers.elle import oracle
+    from jepsen_tpu.workloads import synth
+
+    cases = []
+    for name, seed, inject in [
+        ("la-valid-small", 3, None),
+        ("la-valid-concurrent", 11, None),
+        ("la-g1a", 5, "g1a"),
+        ("la-g1b", 21, "g1b"),
+        ("la-wr-cycle", 7, "wr"),
+        ("la-rw-cycle", 9, "rw"),
+        ("la-dense-cycles", 13, "many"),
+    ]:
+        h = synth.la_history(n_txns=80, n_keys=4, concurrency=5,
+                             fail_prob=0.05, info_prob=0.05,
+                             multi_append_prob=0.2, seed=seed)
+        if inject == "g1a":
+            assert synth.inject_g1a(h)
+        elif inject == "g1b":
+            assert synth.inject_g1b(h)
+        elif inject == "wr":
+            assert synth.inject_wr_cycle(h)
+        elif inject == "rw":
+            assert synth.inject_rw_cycle(h)
+        elif inject == "many":
+            for _ in range(3):
+                synth.inject_wr_cycle(h)
+                synth.inject_rw_cycle(h)
+        models = ["strict-serializable"]
+        r = oracle.check(h, models)
+        cases.append((name, {
+            "workload": "list-append", "models": models,
+            "expected": {"valid?": r["valid?"],
+                         "anomaly-types": sorted(r["anomaly-types"])},
+            "history": _ops_to_json(h),
+        }))
+    return cases
+
+
+def _concurrent_txns(*txns):
+    from jepsen_tpu.history import history
+    from jepsen_tpu.history.ops import Op
+
+    inv = [Op(type="invoke", process=i, f="txn", value=mi)
+           for i, (mi, _) in enumerate(txns)]
+    comp = [Op(type="ok", process=i, f="txn", value=mo)
+            for i, (_, mo) in enumerate(txns)]
+    return history(inv + comp)
+
+
+def rw_cases():
+    from jepsen_tpu.checkers.elle import rw_register
+    from jepsen_tpu.workloads import synth
+
+    cases = []
+    for name, seed in [("rw-valid", 2), ("rw-valid-concurrent", 17)]:
+        h = synth.rw_history(n_txns=80, n_keys=4, concurrency=5,
+                             fail_prob=0.05, info_prob=0.05, seed=seed)
+        models = ["strict-serializable"]
+        r = rw_register.check(h, models, use_device=False)
+        cases.append((name, {
+            "workload": "rw-register", "models": models,
+            "expected": {"valid?": r["valid?"],
+                         "anomaly-types": sorted(r["anomaly-types"])},
+            "history": _ops_to_json(h),
+        }))
+    # anomaly families, hand-built (the corpus must pin failures too)
+    anomalous = [
+        ("rw-lost-update", ["snapshot-isolation"], _concurrent_txns(
+            ([["r", "x", None], ["w", "x", 1]],
+             [["r", "x", None], ["w", "x", 1]]),
+            ([["r", "x", None], ["w", "x", 2]],
+             [["r", "x", None], ["w", "x", 2]]))),
+        ("rw-g1c-wr-cycle", ["read-committed"], _concurrent_txns(
+            ([["w", "x", 1], ["r", "y", None]],
+             [["w", "x", 1], ["r", "y", 9]]),
+            ([["w", "y", 9], ["r", "x", None]],
+             [["w", "y", 9], ["r", "x", 1]]))),
+        ("rw-write-skew-g2", ["serializable"], _concurrent_txns(
+            ([["r", "x", None], ["w", "y", 10]],
+             [["r", "x", None], ["w", "y", 10]]),
+            ([["r", "y", None], ["w", "x", 1]],
+             [["r", "y", None], ["w", "x", 1]]))),
+    ]
+    for name, models, h in anomalous:
+        r = rw_register.check(h, models, use_device=False)
+        assert r["valid?"] is False, (name, r)
+        cases.append((name, {
+            "workload": "rw-register", "models": models,
+            "expected": {"valid?": r["valid?"],
+                         "anomaly-types": sorted(r["anomaly-types"])},
+            "history": _ops_to_json(h),
+        }))
+    return cases
+
+
+def lin_cases():
+    from jepsen_tpu.checkers.knossos import wgl
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.workloads import synth
+
+    cases = []
+    for name, kw in [
+        ("lin-valid", dict(n_ops=40, concurrency=3, seed=4)),
+        ("lin-valid-cas", dict(n_ops=40, concurrency=3, cas_prob=0.5,
+                               seed=6)),
+        ("lin-stale-reads", dict(n_ops=40, concurrency=3,
+                                 stale_read_prob=0.5, seed=8)),
+    ]:
+        h = synth.lin_register_history(**kw)
+        r = wgl.check(h, cas_register())
+        cases.append((name, {
+            "workload": "linearizable-register", "models": ["cas-register"],
+            "expected": {"valid?": r["valid?"]},
+            "history": _ops_to_json(h),
+        }))
+    return cases
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    n = 0
+    for name, payload in la_cases() + rw_cases() + lin_cases():
+        path = os.path.join(OUT, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"{name}: valid?={payload['expected']['valid?']} "
+              f"{payload['expected'].get('anomaly-types', '')}")
+        n += 1
+    print(f"wrote {n} golden files to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

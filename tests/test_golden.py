@@ -1,0 +1,75 @@
+"""Golden-corpus replay: stored histories must keep their verdicts.
+
+Reference parity: knossos's `data/` dirs of known good/bad histories
+checked for expected verdicts (SURVEY.md §4).  Every file in tests/data
+replays through the host oracle AND the device pipeline; both must
+reproduce the frozen verdict.  Regenerate/extend with
+scripts/make_golden.py.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from jepsen_tpu.history import history
+from jepsen_tpu.history.ops import Op
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+FILES = sorted(glob.glob(os.path.join(DATA, "*.json")))
+
+
+def _load(path):
+    with open(path) as f:
+        d = json.load(f)
+    h = history([Op(type=o["type"], process=o["process"], f=o["f"],
+                    value=o["value"]) for o in d["history"]])
+    return d, h
+
+
+def test_corpus_present():
+    assert len(FILES) >= 12, FILES
+
+
+@pytest.mark.parametrize(
+    "path", [p for p in FILES if os.path.basename(p).startswith("la-")],
+    ids=os.path.basename)
+def test_golden_list_append(path):
+    from jepsen_tpu.checkers.elle import list_append, oracle
+
+    d, h = _load(path)
+    want = d["expected"]
+    r_o = oracle.check(h, d["models"])
+    r_d = list_append.check(h, d["models"], _force_no_fallback=True)
+    for r in (r_o, r_d):
+        assert r["valid?"] == want["valid?"], (path, r)
+        assert sorted(r["anomaly-types"]) == want["anomaly-types"], (path, r)
+
+
+@pytest.mark.parametrize(
+    "path", [p for p in FILES if os.path.basename(p).startswith("rw-")],
+    ids=os.path.basename)
+def test_golden_rw_register(path):
+    from jepsen_tpu.checkers.elle import rw_register
+
+    d, h = _load(path)
+    want = d["expected"]
+    for use_device in (False, True):
+        r = rw_register.check(h, d["models"], use_device=use_device)
+        assert r["valid?"] == want["valid?"], (path, use_device, r)
+        assert sorted(r["anomaly-types"]) == want["anomaly-types"], \
+            (path, use_device, r)
+
+
+@pytest.mark.parametrize(
+    "path", [p for p in FILES if os.path.basename(p).startswith("lin-")],
+    ids=os.path.basename)
+def test_golden_linearizable(path):
+    from jepsen_tpu.checkers.knossos import competition
+    from jepsen_tpu.models import cas_register
+
+    d, h = _load(path)
+    want = d["expected"]
+    r = competition.analysis(h, cas_register(), algorithm="competition")
+    assert r["valid?"] == want["valid?"], (path, r)
