@@ -253,11 +253,13 @@ def detect_cycles(g: SweepGraph, max_k: int = 128,
         g.chain_nodes, g.chain_starts, g.chain_mask)
     n_back = int(n_back)
     if n_back > max_k:
-        if max_k >= MAX_K_CAP:
-            # bit budget exhausted (an (n_nodes, max_k) label plane past
-            # the cap would chew through memory): report inexact — the
-            # caller falls back to the host oracle, same contract as
-            # grow_until_exact
+        if n_back > MAX_K_CAP or max_k >= MAX_K_CAP:
+            # bit budget unreachable or exhausted (an (n_nodes, max_k)
+            # label plane past the cap would chew through memory; and
+            # n_back is a property of the graph, so a capped retry that
+            # still cannot fit it would be a guaranteed-wasted sweep):
+            # report inexact — the caller falls back to the host oracle,
+            # same contract as grow_until_exact
             return SweepResult(has_cycle=bool(has),
                                witness_edge_ids=np.zeros(0, np.int64),
                                n_backward=n_back, converged=False)

@@ -3,7 +3,9 @@ every definitive linearizability verdict (unknown = budget cap, allowed).
 Env: FUZZ_N (default 150), FUZZ_SEED.
 """
 import signal, sys, random, time
-sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 from jepsen_tpu.utils.backend import force_cpu_backend
 force_cpu_backend()
 import jax
@@ -22,7 +24,6 @@ def _alarm(sig, frame):
 
 signal.signal(signal.SIGALRM, _alarm)
 
-import os
 rng = random.Random(int(os.environ.get("FUZZ_SEED", 5150)))
 n_fail = n_to = 0
 t_start = time.time()

@@ -270,3 +270,25 @@ def test_check_batch_hybrid_overflow_fallback():
     got = check_batch_hybrid(ps, make_hybrid_mesh(2, 2), max_k=4)
     assert got[0]["valid?"] is True
     assert got[1]["valid?"] is False and got[1]["exact"] is True
+
+
+@pytest.mark.skipif(not os.environ.get("JT_SCALE_TESTS"),
+                    reason="set JT_SCALE_TESTS=1: ~15 min, 4 x 500k-txn "
+                           "hybrid (dcn x k) differential")
+def test_check_batch_hybrid_500k():
+    # config-5 rehearsal at scale: 4 x 500k-txn histories over a (2, 4)
+    # mesh — batch rows x sweep windows — bitwise-equal to the unsharded
+    # batch path.  500k, not 1M: on the VIRTUAL mesh all 8 devices'
+    # replicated inference intermediates live in one host's RAM (4 x 1M
+    # aborts in the XLA:CPU allocator here); on real chips each device
+    # owns its HBM and the per-device footprint is ~1 GB at 1M.
+    from jepsen_tpu.parallel.hybrid import check_batch_hybrid, \
+        make_hybrid_mesh
+
+    ps = [synth.packed_la_history(n_txns=500_000, n_keys=62_500,
+                                  mops_per_txn=4, read_frac=0.25, seed=s)
+          for s in range(4)]
+    got = check_batch_hybrid(ps, make_hybrid_mesh(2, 4))
+    want = check_batch(ps)
+    assert got == want
+    assert all(r["valid?"] is True and r["exact"] for r in got)
