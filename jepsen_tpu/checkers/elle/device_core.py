@@ -35,6 +35,7 @@ from jepsen_tpu.ops.cycle_sweep import (  # noqa: F401
     MAX_K_CAP,
     MAX_ROUNDS_CAP,
     _sweep_arrays,
+    backward_test,
 )
 
 N_COUNT_BITS = 7
@@ -96,12 +97,16 @@ def core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
             bc_mask if "realtime" in proj else bc_off,
         ]) for proj in PROJECTIONS])
 
+    # projection-independent backward test, hoisted out of the scan (two
+    # E-sized rank gathers once instead of per projection)
+    back_raw = backward_test(rank, e_src, e_dst, 2 * T)
+
     def proj_body(carry, mc):
         conv_all, overflow = carry
         m, cm = mc
         has, _, n_back, conv = _sweep_arrays(
             2 * T, max_k, max_rounds, rank, e_src, e_dst, m,
-            chain_nodes, chain_starts, cm)
+            chain_nodes, chain_starts, cm, back_raw=back_raw)
         carry = (conv_all & conv,
                  jnp.maximum(overflow, jnp.maximum(n_back - max_k, 0)))
         return carry, has.astype(jnp.int32)

@@ -254,20 +254,40 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     g1a_count = jnp.sum(g1a.astype(jnp.int32))
     g1a_witness = jnp.argmax(g1a)
 
-    # duplicate elements inside one read: adjacent equal (read, value)
-    # pairs after ONE stable single-key sort by value (R-sized sorts are
-    # the top inference cost; the former 2-key lexsort was ~4x this).
-    # Exact because elem_read is monotone over slots: within an
-    # equal-value block a stable sort preserves slot order, and one
-    # read's slots are contiguous, so equal (read, value) pairs land
-    # adjacent.
-    d_val, d_read = jax.lax.sort(
-        (jnp.where(elem_in_read, ev, V),
-         jnp.where(elem_in_read, elem_read, M)),
-        num_keys=1, is_stable=True)
-    dups = (d_read[1:] == d_read[:-1]) & (d_val[1:] == d_val[:-1]) & \
-        (d_read[1:] < M)
-    duplicate_elements = jnp.sum(dups.astype(jnp.int32))
+    # duplicate elements inside one read.  Fast path: value ids are
+    # key-scoped (interned per (key, content)), so duplicates in the
+    # version ORDERS are one scatter-add over the order table; and when
+    # every read element agrees with its key's order
+    # (incompatible_order == 0), a read holds a duplicate iff its key's
+    # order does (reads are elementwise prefixes of the orders).  Only a
+    # disagreeing — already-invalid — history can hide a read-dup from
+    # the orders, and only then does the exact per-read R-sized sort run
+    # (that sort is ~70% of inference runtime at 1M: PROFILE.md §2d).
+    # Caveats: (a) under vmap (the batched checking paths) lax.cond
+    # lowers to select_n and BOTH branches run — batched checks keep
+    # paying the sort, as before this change, plus the cheap scatter;
+    # (b) the reported COUNT is per-order multiplicity on the fast path
+    # and per-read adjacent pairs on the slow one — presence (> 0) is
+    # the exactness contract, matched against the oracle either way.
+    ord_cnt = jnp.zeros(V + 1, jnp.int32).at[
+        jnp.where(slot_valid, cv, V)].add(1)[:V]
+    dup_fast = jnp.sum(jnp.maximum(ord_cnt - 1, 0))
+
+    def dup_slow(_):
+        # adjacent equal (read, value) pairs after one stable single-key
+        # sort by value — exact because elem_read is monotone over
+        # slots, so within an equal-value block one read's slots stay
+        # contiguous
+        d_val, d_read = jax.lax.sort(
+            (jnp.where(elem_in_read, ev, V),
+             jnp.where(elem_in_read, elem_read, M)),
+            num_keys=1, is_stable=True)
+        dups = (d_read[1:] == d_read[:-1]) & (d_val[1:] == d_val[:-1]) & \
+            (d_read[1:] < M)
+        return jnp.sum(dups.astype(jnp.int32))
+
+    duplicate_elements = jax.lax.cond(
+        incompatible_order > 0, dup_slow, lambda _: dup_fast, operand=None)
 
     # G1b: last element of a read is an intermediate append of another txn
     is_last_elem = elem_in_read & (elem_off == h.mop_rd_len[er] - 1)

@@ -73,10 +73,19 @@ class SweepGraph:
     chain_mask: jnp.ndarray    # (C,) bool
 
 
+def backward_test(rank, nc_src, nc_dst, n_nodes: int):
+    """The projection-independent backward-edge test (edge goes backward
+    iff rank does not increase).  Single source of truth for callers that
+    hoist it out of a projection scan AND for `_sweep_window`'s internal
+    fallback — the two must stay bit-identical."""
+    return rank[jnp.clip(nc_src, 0, n_nodes - 1)] >= \
+        rank[jnp.clip(nc_dst, 0, n_nodes - 1)]
+
+
 def _sweep_window(n_nodes: int, k_total: int, k_local: int, max_rounds: int,
                   rank, nc_src, nc_dst, nc_mask,
                   chain_nodes, chain_starts, chain_mask,
-                  k_offset, axis_name=None):
+                  k_offset, axis_name=None, back_raw=None):
     """Sweep kernel over a window of the backward-edge axis.
 
     Each caller owns backward edges with global ids in
@@ -94,10 +103,13 @@ def _sweep_window(n_nodes: int, k_total: int, k_local: int, max_rounds: int,
     """
     # ---- split edges: backward iff rank[src] >= rank[dst] -----------------
     # (chain edges are forward by construction: caller guarantees ranks
-    # increase along chains)
-    r_src = rank[jnp.clip(nc_src, 0, n_nodes - 1)]
-    r_dst = rank[jnp.clip(nc_dst, 0, n_nodes - 1)]
-    is_back = nc_mask & (r_src >= r_dst)
+    # increase along chains).  `back_raw` lets a caller scanning over
+    # several projections hoist the two E-sized rank gathers out of the
+    # scan — the comparison is projection-independent, only the mask
+    # varies (1 byte/edge hoisted vs 8 bytes/edge re-gathered 5x).
+    if back_raw is None:
+        back_raw = backward_test(rank, nc_src, nc_dst, n_nodes)
+    is_back = nc_mask & back_raw
     n_back = jnp.sum(is_back.astype(jnp.int32))
 
     # stable enumeration of backward edges: order by edge position
@@ -207,7 +219,7 @@ def _sweep_window(n_nodes: int, k_total: int, k_local: int, max_rounds: int,
 
 def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
                   rank, nc_src, nc_dst, nc_mask,
-                  chain_nodes, chain_starts, chain_mask):
+                  chain_nodes, chain_starts, chain_mask, back_raw=None):
     """Core kernel (single window).  Returns (has_cycle, witness_bits,
     n_backward, converged).
 
@@ -219,7 +231,8 @@ def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
     return _sweep_window(n_nodes, max_k, max_k, max_rounds,
                          rank, nc_src, nc_dst, nc_mask,
                          chain_nodes, chain_starts, chain_mask,
-                         k_offset=jnp.int32(0), axis_name=None)
+                         k_offset=jnp.int32(0), axis_name=None,
+                         back_raw=back_raw)
 
 
 _sweep = jax.jit(_sweep_arrays,

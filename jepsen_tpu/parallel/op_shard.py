@@ -52,7 +52,8 @@ from jepsen_tpu.ops.cycle_sweep import _sweep_window
 def projection_sweep_bits(out, max_k: int, sweep):
     """The 5-projection scan over an inferred edge set, with `sweep` a
     callable (rank, e_src, e_dst, mask, chain_nodes, chain_starts,
-    chain_mask) -> (has_cycle, witness, n_back, converged).
+    chain_mask, back_raw) -> (has_cycle, witness, n_back, converged);
+    back_raw is the hoisted projection-independent backward-edge test.
 
     One sweep instantiation scanned over the 5 projections — same
     compile-time + label-plane-memory rationale as device_core.core_check
@@ -91,11 +92,15 @@ def projection_sweep_bits(out, max_k: int, sweep):
             bc_mask if "realtime" in proj else bc_off,
         ]) for proj in PROJECTIONS])
 
+    from jepsen_tpu.ops.cycle_sweep import backward_test
+
+    back_raw = backward_test(rank, e_src, e_dst, rank.shape[0])
+
     def proj_body(carry, mc):
         conv_all, overflow = carry
         m, cm = mc
         has, _, n_back, conv = sweep(
-            rank, e_src, e_dst, m, chain_nodes, chain_starts, cm)
+            rank, e_src, e_dst, m, chain_nodes, chain_starts, cm, back_raw)
         carry = (conv_all & conv,
                  jnp.maximum(overflow, jnp.maximum(n_back - max_k, 0)))
         return carry, has.astype(jnp.int32)
@@ -129,12 +134,12 @@ def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
     rep = P()
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(rep,) * 7, out_specs=(rep, rep, rep, rep))
-    def sharded_sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_):
+             in_specs=(rep,) * 8, out_specs=(rep, rep, rep, rep))
+    def sharded_sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_, br_):
         off = jax.lax.axis_index(axis) * k_local
         return _sweep_window(2 * T, max_k, k_local, max_rounds,
                              rank_, e_src_, e_dst_, m_, cn_, cs_, cm_,
-                             k_offset=off, axis_name=axis)
+                             k_offset=off, axis_name=axis, back_raw=br_)
 
     return projection_sweep_bits(out, max_k, sharded_sweep)
 

@@ -208,3 +208,29 @@ def test_device_converges_on_round_hungry_history():
     r = list_append.check(h, ["serializable"], _force_no_fallback=True)
     assert r["valid?"] is False
     assert "G1c" in r["anomaly-types"]
+
+
+def test_device_duplicate_elements_fast_path():
+    # dup visible in the version order (reads agree with the order):
+    # the cond-gated fast path must flag it without the R-sort
+    h = concurrent_history(
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["r", "x", None]], [["r", "x", [1, 1]]]),
+    )
+    r = both(h, ["serializable"])
+    assert "duplicate-elements" in r["anomaly-types"]
+
+
+def test_device_duplicate_elements_slow_path():
+    # dup hidden from the orders: the longest read [1, 2] defines the
+    # order, a second read [1, 1] disagrees (incompatible-order) AND
+    # holds the dup — only the exact per-read sort path can see it
+    h = concurrent_history(
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["append", "x", 2]], [["append", "x", 2]]),
+        ([["r", "x", None]], [["r", "x", [1, 2]]]),
+        ([["r", "x", None]], [["r", "x", [1, 1]]]),
+    )
+    r = both(h, ["serializable"])
+    assert "duplicate-elements" in r["anomaly-types"]
+    assert "incompatible-order" in r["anomaly-types"]
