@@ -43,6 +43,12 @@ CYCLE_ANOMALY_SPECS: Dict[str, CycleSpec] = {
     "G2-item-realtime": CycleSpec(_BASE | {REL_REALTIME}, "some"),
 }
 
+#: the one anomaly family whose search is a budgeted simple-cycle DFS
+#: ("never a false positive, may give up"): differential comparisons may
+#: see a legitimate device-vs-oracle asymmetry here on dense graphs
+NONADJACENT_FAMILY = frozenset({
+    "G-nonadjacent", "G-nonadjacent-process", "G-nonadjacent-realtime"})
+
 # Search order: report the strongest (most specific / weakest-model-violating)
 # anomalies first, as the reference does.
 SPEC_ORDER = [

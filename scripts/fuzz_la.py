@@ -1,9 +1,12 @@
 """Differential fuzz: list-append device checker vs host oracle.
 
-Random history parameters x injected anomalies; every definitive verdict
-and anomaly set must match exactly (SURVEY.md §4 generative-testing
-strategy).  Campaign of 2026-07-30: 300/300 exact matches (after fixing
-detect_cycles round growth, found by case 0 of the first run).
+Random history parameters x injected anomalies; verdicts must match and
+anomaly sets must match exactly EXCEPT the budget-limited G-nonadjacent
+family, where the device may legitimately find more on large dense
+graphs (see the in-loop comment; SURVEY.md §4 generative-testing
+strategy).  Campaigns of 2026-07-30: 300/300 + 100/100 (after fixing
+detect_cycles round growth, found by case 0 of the first run; the one
+seed-999 flag was the tolerated nonadjacent asymmetry).
 Env: FUZZ_N (cases, default 300), FUZZ_SEED.
 """
 import sys, random, time
@@ -14,6 +17,7 @@ from jepsen_tpu.utils.backend import force_cpu_backend
 force_cpu_backend()
 import jax
 from jepsen_tpu.checkers.elle import list_append, oracle
+from jepsen_tpu.checkers.elle.specs import NONADJACENT_FAMILY
 from jepsen_tpu.workloads import synth
 
 MODELS_POOL = [["strict-serializable"], ["serializable"],
@@ -49,8 +53,18 @@ for case in range(N):
     try:
         r_o = oracle.check(h, models)
         r_d = list_append.check(h, models, _force_no_fallback=True)
-        if r_o["valid?"] != r_d["valid?"] or \
-           set(r_o["anomaly-types"]) != set(r_d["anomaly-types"]):
+        so = set(r_o["anomaly-types"])
+        sd = set(r_d["anomaly-types"])
+        # One tolerated asymmetry, in one direction, on large graphs
+        # only: the nonadjacent family's search is a BUDGETED
+        # simple-cycle DFS, and on dense graphs the device's small
+        # witness regions can crack what the oracle's whole-SCC DFS
+        # gives up on (900-txn case pinned in tests/test_device_la.py).
+        # A device MISS, or any disagreement on a small graph where the
+        # oracle's budget is authoritative, still fails.
+        if params["n_txns"] >= 400 and sd - so <= NONADJACENT_FAMILY:
+            so |= sd & NONADJACENT_FAMILY
+        if r_o["valid?"] != r_d["valid?"] or so != sd:
             n_fail += 1
             print(f"MISMATCH case={case} params={params} inject={inject} "
                   f"models={models}\n  oracle={r_o['valid?']} {sorted(r_o['anomaly-types'])}"

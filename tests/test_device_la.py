@@ -2,7 +2,9 @@
 
 The reference's pattern of checking parallel folds against serial folds
 (SURVEY.md §4), upgraded to device-vs-host: every verdict and anomaly set
-must match the exact host oracle.  `_force_no_fallback=True` ensures we are
+must match the exact host oracle (one exception: the budget-limited
+G-nonadjacent family, where the device can be MORE complete — see
+test_device_finds_nonadjacent_oracle_budget_misses).  `_force_no_fallback=True` ensures we are
 actually testing the device path, not the oracle fallback.
 """
 
@@ -234,3 +236,36 @@ def test_device_duplicate_elements_slow_path():
     r = both(h, ["serializable"])
     assert "duplicate-elements" in r["anomaly-types"]
     assert "incompatible-order" in r["anomaly-types"]
+
+
+def test_device_finds_nonadjacent_oracle_budget_misses():
+    """Fuzz find (2026-07-30, seed 999 case 33): on a dense 900-txn
+    graph the device's witness-region search finds a genuine
+    G-nonadjacent cycle that the oracle's whole-SCC budgeted DFS gives
+    up on.  Pins (a) the device's stronger completeness, (b) the
+    structural validity of its reported cycle, and (c) that the
+    verdicts still agree (a nonadjacent cycle is also a G2-item cycle).
+    """
+    h = synth.la_history(n_txns=900, n_keys=5, concurrency=8,
+                         fail_prob=0.05, info_prob=0.05,
+                         multi_append_prob=0.2, seed=737240089)
+    for _ in range(4):
+        synth.inject_wr_cycle(h)
+        synth.inject_rw_cycle(h)
+    r_d = list_append.check(h, ["strict-serializable"],
+                            _force_no_fallback=True)
+    r_o = oracle.check(h, ["strict-serializable"])
+    assert r_d["valid?"] is False and r_o["valid?"] is False
+    na = r_d["anomalies"]["G-nonadjacent"]
+    rels = [e["rel"] for e in na[0]["cycle"]]
+    # structural spec check: >= 2 rw, none cyclically adjacent
+    assert rels.count("rw") >= 2
+    for i, rel in enumerate(rels):
+        assert not (rel == "rw" and rels[(i + 1) % len(rels)] == "rw"), rels
+    # every edge carries concrete evidence (the Explainer filled it in)
+    assert all(e.get("why") for e in na[0]["cycle"])
+    # apart from the budget-limited nonadjacent family, the sets agree
+    from jepsen_tpu.checkers.elle.specs import NONADJACENT_FAMILY
+
+    assert set(r_o["anomaly-types"]) - NONADJACENT_FAMILY == \
+        set(r_d["anomaly-types"]) - NONADJACENT_FAMILY
