@@ -5,8 +5,20 @@ a DAG of consistency models ordered by strength, a mapping from observed
 anomalies to the models they rule out, and `friendly_boundary` reporting —
 "not(serializable) but maybe(snapshot-isolation)".
 
-The model set is the load-bearing core of the reference's ~40-model lattice
-(Adya PL levels, the snapshot-isolation family, session/strong variants).
+The lattice covers the reference's full ~40-model surface: the Adya PL
+hierarchy (PL-1 … PL-3, PL-CS, PL-2L, PL-MSR, PL-2+, PL-FCV, PL-3U), the
+snapshot-isolation family (incl. prefix-consistent and parallel SI), the
+Cerone transactional models (read-atomic, causal-cerone, prefix), the
+session-guarantee family (monotonic-reads/writes, read-your-writes,
+writes-follow-reads, PRAM, causal, sequential), single-object realtime
+(linearizable), and the strong-session / strong (realtime) variants built
+from process- and realtime-edge cycle anomalies.
+
+Sources for the implication edges: Adya's thesis (PL hierarchy and G-x
+phenomena), Bailis et al. HAT, Cerone et al. (RA/causal/prefix/PSI),
+Terry et al. session guarantees, Daudjee & Salem strong-session models,
+Viotti & Vukolić's survey (session lattice: linearizable > sequential >
+causal > PRAM > {MR, MW, RYW}).
 """
 
 from __future__ import annotations
@@ -15,27 +27,58 @@ from typing import Dict, FrozenSet, Iterable, List, Set
 
 # model -> models it directly implies (stronger -> weaker edges)
 IMPLIES: Dict[str, List[str]] = {
+    # --- serializability column (Adya PL-3 and realtime/session variants)
     "strict-serializable": ["serializable", "strong-session-serializable",
-                            "strong-snapshot-isolation", "linearizable"],
-    "strong-session-serializable": ["serializable"],
-    "serializable": ["repeatable-read", "view-serializable", "read-atomic"],
+                            "strong-snapshot-isolation", "linearizable",
+                            "conflict-serializable", "strong-read-committed"],
+    "strong-session-serializable": ["serializable",
+                                    "strong-session-read-committed"],
+    "serializable": ["repeatable-read", "view-serializable", "read-atomic",
+                     "update-serializable"],
+    "conflict-serializable": ["view-serializable"],
     "view-serializable": [],
+    # Adya PL-3U: serializability w.r.t. update transactions only
+    "update-serializable": ["forward-consistent-view"],
+    # Adya PL-FCV / PL-2+ / PL-2L column
+    "forward-consistent-view": ["consistent-view"],
     "repeatable-read": ["cursor-stability", "consistent-view"],
-    "strong-snapshot-isolation": ["snapshot-isolation",
-                                  "strong-session-snapshot-isolation"],
-    "strong-session-snapshot-isolation": ["snapshot-isolation"],
-    "snapshot-isolation": ["consistent-view", "monotonic-atomic-view",
-                           "read-atomic"],
     "consistent-view": ["monotonic-view"],
     "monotonic-view": ["read-committed"],
+    "monotonic-snapshot-read": ["read-committed"],
     "cursor-stability": ["read-committed"],
-    "causal-cerone": ["read-atomic"],
+    # --- snapshot-isolation family
+    "strong-snapshot-isolation": ["snapshot-isolation",
+                                  "strong-session-snapshot-isolation"],
+    "strong-session-snapshot-isolation":
+        ["prefix-consistent-snapshot-isolation"],
+    "prefix-consistent-snapshot-isolation": ["snapshot-isolation"],
+    "snapshot-isolation": ["consistent-view", "monotonic-atomic-view",
+                           "read-atomic", "monotonic-snapshot-read"],
     "parallel-snapshot-isolation": ["causal-cerone"],
+    # --- Cerone transactional models
+    "causal-cerone": ["read-atomic", "causal"],
+    "prefix": ["causal-cerone"],
     "read-atomic": ["monotonic-atomic-view"],
     "monotonic-atomic-view": ["read-committed"],
+    # --- weak isolation floor
     "read-committed": ["read-uncommitted"],
     "read-uncommitted": [],
-    "linearizable": [],
+    # --- session guarantees (Terry et al.; Viotti & Vukolić ordering)
+    "linearizable": ["sequential"],
+    "sequential": ["causal"],
+    "causal": ["PRAM", "writes-follow-reads"],
+    "PRAM": ["monotonic-reads", "monotonic-writes", "read-your-writes"],
+    "monotonic-reads": [],
+    "monotonic-writes": [],
+    "read-your-writes": [],
+    "writes-follow-reads": [],
+    # --- strong-session / strong (realtime) weak-isolation variants
+    "strong-session-read-uncommitted": ["read-uncommitted"],
+    "strong-session-read-committed": ["read-committed",
+                                      "strong-session-read-uncommitted"],
+    "strong-read-uncommitted": ["strong-session-read-uncommitted"],
+    "strong-read-committed": ["read-committed", "strong-read-uncommitted",
+                              "strong-session-read-committed"],
 }
 
 ALL_MODELS = sorted(IMPLIES.keys())
@@ -44,17 +87,33 @@ ALL_MODELS = sorted(IMPLIES.keys())
 ALIASES = {
     "strict-1SR": "strict-serializable",
     "strong-serializable": "strict-serializable",
+    "PL-SS": "strict-serializable",
     "PL-3": "serializable",
+    "PL-3U": "update-serializable",
+    "PL-FCV": "forward-consistent-view",
     "PL-2.99": "repeatable-read",
     "PL-2+": "consistent-view",
+    "PL-2L": "monotonic-view",
+    "PL-MSR": "monotonic-snapshot-read",
+    "PL-CS": "cursor-stability",
     "PL-2": "read-committed",
     "PL-1": "read-uncommitted",
     "SI": "snapshot-isolation",
+    "strong-SI": "strong-snapshot-isolation",
+    "strong-session-SI": "strong-session-snapshot-isolation",
+    "prefix-consistent-SI": "prefix-consistent-snapshot-isolation",
+    "PSI": "parallel-snapshot-isolation",
     "serializability": "serializable",
+    "sequential-consistency": "sequential",
+    "causal-consistency": "causal",
+    "pipelined-RAM": "PRAM",
+    "pram": "PRAM",
 }
 
 # model -> anomalies it directly proscribes (closed downward over IMPLIES:
-# a model also proscribes everything its weaker models do).
+# a model also proscribes everything its weaker models do).  The session
+# leaves use "<model>-violation" tokens for per-session ordering
+# violations (checkers that scan per-process read/write orders emit them).
 PROSCRIBED: Dict[str, Set[str]] = {
     "read-uncommitted": {"G0", "duplicate-elements", "incompatible-order",
                          "cyclic-versions", "duplicate-writes"},
@@ -63,15 +122,24 @@ PROSCRIBED: Dict[str, Set[str]] = {
     "monotonic-atomic-view": {"monotonic-atomic-view-violation"},
     "read-atomic": {"internal", "fractured-read"},
     "causal-cerone": {"G1c-process", "G0-process"},
+    "prefix": set(),
     "parallel-snapshot-isolation": set(),
-    "monotonic-view": set(),
+    "monotonic-view": {"G-monotonic"},
+    "monotonic-snapshot-read": {"G-MSR"},
     "consistent-view": {"G-single"},
+    "forward-consistent-view": {"G-SIb"},
+    "update-serializable": {"G-update"},
     "cursor-stability": {"G-cursor", "lost-update"},
-    "snapshot-isolation": {"G-single", "G-SI", "lost-update"},
+    "snapshot-isolation": {"G-single", "G-SI", "G-SIa", "G-SIb",
+                           "lost-update"},
+    "prefix-consistent-snapshot-isolation": set(),
     "repeatable-read": {"G2-item", "lost-update"},
     "serializable": {"G2-item", "G2", "G-nonadjacent", "G-single"},
+    "conflict-serializable": {"G0", "G1c", "G2-item", "G2", "G-single",
+                              "G-nonadjacent"},
     "view-serializable": {"G2-item"},
     "strong-session-serializable": {"G2-item-process", "G-single-process",
+                                    "G-nonadjacent-process",
                                     "G1c-process", "G0-process"},
     "strong-session-snapshot-isolation": {"G-single-process", "G1c-process"},
     "strong-snapshot-isolation": {"G-single-realtime", "G1c-realtime"},
@@ -79,7 +147,21 @@ PROSCRIBED: Dict[str, Set[str]] = {
                             "G1c-realtime", "G0-realtime",
                             "G-nonadjacent-realtime"},
     "linearizable": set(),
+    "sequential": set(),
+    "causal": set(),
+    "PRAM": set(),
+    "monotonic-reads": {"monotonic-reads-violation"},
+    "monotonic-writes": {"monotonic-writes-violation"},
+    "read-your-writes": {"read-your-writes-violation"},
+    "writes-follow-reads": {"writes-follow-reads-violation"},
+    "strong-session-read-uncommitted": {"G0-process"},
+    "strong-session-read-committed": {"G1c-process"},
+    "strong-read-uncommitted": {"G0-realtime"},
+    "strong-read-committed": {"G1c-realtime"},
 }
+
+assert set(PROSCRIBED) == set(IMPLIES), \
+    sorted(set(PROSCRIBED) ^ set(IMPLIES))
 
 
 def canonical(model: str) -> str:
@@ -119,6 +201,12 @@ def anomaly_impossible_models(anomalies: Iterable[str]) -> Set[str]:
     return {m for m in IMPLIES if proscribed_anomalies(m) & obs}
 
 
+# niche formalisms kept out of the headline "not" line when a friendlier
+# violated model exists (they still appear in "also-not") — the
+# "friendly" in the reference's friendly-boundary
+_NONFRIENDLY = frozenset({"conflict-serializable", "view-serializable"})
+
+
 def friendly_boundary(anomalies: Iterable[str]) -> Dict[str, List[str]]:
     """Reference `elle.consistency-model/friendly-boundary`:
 
@@ -132,6 +220,8 @@ def friendly_boundary(anomalies: Iterable[str]) -> Dict[str, List[str]]:
         weaker = _DESC[m] - {m}
         if not (weaker & impossible):
             boundary.add(m)
+    if boundary - _NONFRIENDLY:
+        boundary -= _NONFRIENDLY
     return {
         "not": sorted(boundary),
         "also-not": sorted(impossible - boundary),

@@ -286,8 +286,13 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
             (d_read[1:] < M)
         return jnp.sum(dups.astype(jnp.int32))
 
-    duplicate_elements = jax.lax.cond(
-        incompatible_order > 0, dup_slow, lambda _: dup_fast, operand=None)
+    # presence flag only (0/1): the two branches count different things
+    # (per-order multiplicity vs per-read adjacent pairs), so surfacing
+    # the raw number would make the same history report path-dependent
+    # counts on batched vs single paths — presence is the contract
+    duplicate_elements = jnp.minimum(jax.lax.cond(
+        incompatible_order > 0, dup_slow, lambda _: dup_fast,
+        operand=None), 1)
 
     # G1b: last element of a read is an intermediate append of another txn
     is_last_elem = elem_in_read & (elem_off == h.mop_rd_len[er] - 1)

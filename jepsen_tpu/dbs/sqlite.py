@@ -147,13 +147,23 @@ class SqliteClient(Client):
                     done.append([f, k, v])
                 else:
                     raise ValueError(f"unknown mop {f!r}")
-        except sqlite3.OperationalError as e:
-            # mid-txn failure: nothing committed — clean abort
+        except sqlite3.Error as e:
+            # mid-txn failure (busy, integrity, …): nothing committed —
+            # clean abort so the reused connection is left outside a txn
             try:
                 conn.execute("ROLLBACK")
-            except sqlite3.OperationalError:
+            except sqlite3.Error:
                 pass
             return dict(op, type="fail", error=str(e))
+        except BaseException:
+            # non-SQLite error (e.g. unknown mop): the txn is still open
+            # on the reused connection — roll back before propagating or
+            # every later BEGIN fails with "within a transaction"
+            try:
+                conn.execute("ROLLBACK")
+            except sqlite3.Error:
+                pass
+            raise
         try:
             conn.execute("COMMIT")
         except sqlite3.OperationalError as e:

@@ -189,6 +189,11 @@ def check_batch_checkpointed(ps: Sequence[PackedTxns], ckpt_path: str,
         # assignment, invoke/complete order, read segments) must NOT
         # share a digest — process/realtime cycle bits depend on them
         h = hashlib.sha256()
+        # declared metadata first: n_keys/n_vals feed padding caps and
+        # inference sentinels, so identical arrays under different
+        # declared spaces must not share a digest
+        h.update(np.int64([p.n_keys, p.n_vals, p.n_txns,
+                           p.n_mops]).tobytes())
         for a in (p.txn_type, p.txn_process, p.txn_invoke_pos,
                   p.txn_complete_pos, p.mop_txn, p.mop_kind, p.mop_key,
                   p.mop_val, p.mop_rd_start, p.mop_rd_len, p.rd_elems):

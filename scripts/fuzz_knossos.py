@@ -1,5 +1,8 @@
-"""Agreement fuzz: knossos wgl / device BFS / competition must agree on
-every definitive linearizability verdict (unknown = budget cap, allowed).
+"""Agreement fuzz: knossos wgl / linear / device BFS / competition must
+agree on every definitive linearizability verdict (unknown = budget cap,
+allowed).  `linear` re-admitted 2026-07-30 after the packed int64
+config-set rewrite (VERDICT r03 item 6); per-algorithm cumulative time is
+reported and the gate asserts linear stays within 10x of wgl overall.
 Env: FUZZ_N (default 150), FUZZ_SEED.
 """
 import signal, sys, random, time
@@ -26,6 +29,7 @@ signal.signal(signal.SIGALRM, _alarm)
 
 rng = random.Random(int(os.environ.get("FUZZ_SEED", 5150)))
 n_fail = n_to = 0
+t_algo = {}
 t_start = time.time()
 N = int(os.environ.get("FUZZ_N", 150))
 for case in range(N):
@@ -38,13 +42,16 @@ for case in range(N):
         seed=rng.randrange(1 << 30),
     )
     h = synth.lin_register_history(**params)
+    cur_algo, t_a = None, 0.0
     try:
         signal.alarm(120)
         rs = {}
-        for algo in ("wgl", "device", "competition"):
+        for algo in ("wgl", "linear", "device", "competition"):
+            cur_algo, t_a = algo, time.time()
             rs[algo] = competition.analysis(
                 h, cas_register(), algorithm=algo,
                 max_configs=200_000)["valid?"]
+            t_algo[algo] = t_algo.get(algo, 0.0) + time.time() - t_a
         signal.alarm(0)
         definitive = {k: v for k, v in rs.items() if v != "unknown"}
         if len(set(definitive.values())) > 1:
@@ -52,7 +59,12 @@ for case in range(N):
             print(f"MISMATCH case={case} params={params}: {rs}", flush=True)
     except CaseTimeout:
         n_to += 1
-        print(f"TIMEOUT case={case} params={params}", flush=True)
+        if cur_algo is not None:
+            # charge the burned time to the algorithm that hung, so the
+            # perf gate can't be dodged by timing out
+            t_algo[cur_algo] = t_algo.get(cur_algo, 0.0) + time.time() - t_a
+        print(f"TIMEOUT case={case} (in {cur_algo}) params={params}",
+              flush=True)
     except Exception as e:
         signal.alarm(0)
         n_fail += 1
@@ -64,4 +76,11 @@ for case in range(N):
               f"mismatches={n_fail} timeouts={n_to}", flush=True)
 print(f"DONE {N} cases, {n_fail} mismatches, {n_to} timeouts, "
       f"{time.time()-t_start:.0f}s", flush=True)
+print("per-algo seconds: " +
+      " ".join(f"{k}={v:.1f}" for k, v in sorted(t_algo.items())), flush=True)
+if t_algo.get("wgl") and t_algo.get("linear"):
+    ratio = t_algo["linear"] / max(t_algo["wgl"], 1e-9)
+    print(f"linear/wgl ratio = {ratio:.2f}x (gate: <= 10x)", flush=True)
+    if ratio > 10:
+        n_fail += 1
 sys.exit(1 if n_fail else 0)

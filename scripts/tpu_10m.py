@@ -62,6 +62,13 @@ def main():
           f"(target: 10M in 60s on v5e-8; single chip share = "
           f"{n_txns / best / (10_000_000 / 60 / 8):.2f}x)", flush=True)
 
+    stats = jax.devices()[0].memory_stats() or {}
+    peak = stats.get("peak_bytes_in_use")
+    if peak:
+        print(f"HBM peak {peak / 2**30:.2f} GiB "
+              f"(limit {stats.get('bytes_limit', 0) / 2**30:.2f} GiB)",
+              flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,140 @@
+"""Consistency-lattice tests — mirrors the reference's
+`elle/test/elle/consistency_model_test.clj` surface: canonicalization,
+implication closure, anomaly→impossible-models, friendly_boundary.
+"""
+
+import pytest
+
+from jepsen_tpu.checkers.elle import consistency as cm
+
+
+def test_all_models_well_formed():
+    # every model has proscriptions defined and canonicalizes to itself
+    for m in cm.ALL_MODELS:
+        assert cm.canonical(m) == m
+        cm.proscribed_anomalies(m)  # no KeyError
+    # the reference's lattice is ~40 models; ours must match that scale
+    assert len(cm.ALL_MODELS) >= 35
+    assert len(cm.ALL_MODELS) + len(cm.ALIASES) >= 40
+
+
+def test_aliases_resolve():
+    assert cm.canonical("PL-3") == "serializable"
+    assert cm.canonical("PL-3U") == "update-serializable"
+    assert cm.canonical("PL-FCV") == "forward-consistent-view"
+    assert cm.canonical("PL-MSR") == "monotonic-snapshot-read"
+    assert cm.canonical("PL-2L") == "monotonic-view"
+    assert cm.canonical("strong-serializable") == "strict-serializable"
+    assert cm.canonical("prefix-consistent-SI") == \
+        "prefix-consistent-snapshot-isolation"
+    assert cm.canonical("PSI") == "parallel-snapshot-isolation"
+    assert cm.canonical("sequential-consistency") == "sequential"
+    with pytest.raises(ValueError):
+        cm.canonical("nope")
+
+
+def test_dag_is_antisymmetric():
+    # no two distinct models imply each other (the lattice is a DAG)
+    for m in cm.ALL_MODELS:
+        for n in cm._DESC[m]:
+            if n != m:
+                assert m not in cm._DESC[n], (m, n)
+
+
+def test_implication_closure_spot_checks():
+    # strict-serializable sits on top: implies the serializable column,
+    # the SI family, and (via linearizable) every session guarantee
+    top = cm._DESC["strict-serializable"]
+    for weaker in ("serializable", "snapshot-isolation", "read-committed",
+                   "read-atomic", "sequential", "causal", "PRAM",
+                   "monotonic-reads", "read-your-writes",
+                   "update-serializable", "forward-consistent-view",
+                   "strong-read-committed", "view-serializable"):
+        assert weaker in top, weaker
+    # Adya column ordering: PL-3 > PL-3U > PL-FCV > PL-2+ > PL-2L > PL-2
+    assert "update-serializable" in cm._DESC["serializable"]
+    assert "forward-consistent-view" in cm._DESC["update-serializable"]
+    assert "consistent-view" in cm._DESC["forward-consistent-view"]
+    assert "monotonic-view" in cm._DESC["consistent-view"]
+    assert "read-committed" in cm._DESC["monotonic-view"]
+    # session column: sequential > causal > PRAM > {MR, MW, RYW}
+    assert "causal" in cm._DESC["sequential"]
+    assert {"monotonic-reads", "monotonic-writes",
+            "read-your-writes"} <= cm._DESC["PRAM"]
+    assert "writes-follow-reads" in cm._DESC["causal"]
+    # SI family: strong > strong-session > prefix-consistent > SI
+    assert "prefix-consistent-snapshot-isolation" in \
+        cm._DESC["strong-session-snapshot-isolation"]
+    assert "snapshot-isolation" in \
+        cm._DESC["prefix-consistent-snapshot-isolation"]
+    # serializability does NOT imply snapshot isolation (incomparable)
+    assert "snapshot-isolation" not in cm._DESC["serializable"]
+    # nor does SI imply serializability
+    assert "serializable" not in cm._DESC["snapshot-isolation"]
+
+
+def test_proscribed_anomalies_select_right_sets():
+    # the VERDICT r03 acceptance probe: these must answer, not KeyError
+    mr = cm.anomalies_for_models(["monotonic-reads"])
+    assert mr == {"monotonic-reads-violation"}
+    us = cm.anomalies_for_models(["update-serializable"])
+    assert "G-update" in us
+    assert "G-SIb" in us          # via forward-consistent-view
+    assert "G-single" in us       # via consistent-view
+    assert "G1a" in us and "G0" in us
+    assert "G2-item" not in us    # full PL-3 territory, not PL-3U
+    # serializable searches its whole downward closure
+    ser = cm.anomalies_for_models(["serializable"])
+    assert {"G2-item", "G1c", "G0", "G-update", "internal"} <= ser
+    assert "G-single-realtime" not in ser
+    # strict adds the realtime variants
+    strict = cm.anomalies_for_models(["strict-serializable"])
+    assert {"G2-item-realtime", "G0-realtime",
+            "G-nonadjacent-realtime"} <= strict
+
+
+def test_anomaly_impossible_models():
+    out = cm.anomaly_impossible_models(["G1a"])
+    assert "read-committed" in out
+    assert "serializable" in out
+    assert "strict-serializable" in out
+    assert "read-uncommitted" not in out
+    assert "monotonic-reads" not in out
+    # a session violation knocks out the session column and everything
+    # above it, but not transactional isolation
+    out = cm.anomaly_impossible_models(["monotonic-reads-violation"])
+    assert {"monotonic-reads", "PRAM", "causal", "sequential",
+            "linearizable", "strict-serializable"} <= out
+    assert "serializable" not in out
+    assert "snapshot-isolation" not in out
+
+
+def test_friendly_boundary():
+    b = cm.friendly_boundary(["G1a"])
+    assert b["not"] == ["read-committed"]
+    assert "serializable" in b["also-not"]
+    b = cm.friendly_boundary(["G-single"])
+    assert b["not"] == ["consistent-view"]
+    assert "snapshot-isolation" in b["also-not"]
+    b = cm.friendly_boundary(["internal"])
+    assert b["not"] == ["read-atomic"]
+    b = cm.friendly_boundary(["G-update"])
+    assert b["not"] == ["update-serializable"]
+    b = cm.friendly_boundary(["monotonic-reads-violation"])
+    assert b["not"] == ["monotonic-reads"]
+    assert "PRAM" in b["also-not"] and "linearizable" in b["also-not"]
+    # two independent anomalies -> two boundary models
+    b = cm.friendly_boundary(["G-cursor", "G-MSR"])
+    assert b["not"] == ["cursor-stability", "monotonic-snapshot-read"]
+    # nothing observed -> nothing violated
+    b = cm.friendly_boundary([])
+    assert b == {"not": [], "also-not": []}
+
+
+def test_g2_vs_g2_item():
+    # G2 (predicate) rules out serializable but not repeatable-read
+    out = cm.anomaly_impossible_models(["G2"])
+    assert "serializable" in out
+    assert "repeatable-read" not in out
+    out = cm.anomaly_impossible_models(["G2-item"])
+    assert "repeatable-read" in out and "view-serializable" in out
