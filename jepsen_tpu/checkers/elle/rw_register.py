@@ -54,6 +54,12 @@ NO_PREV = -3
 FUSED_MIN_TXNS = 100_000
 
 
+def sessions_guarantees():
+    from jepsen_tpu.checkers.elle import sessions
+
+    return sessions.GUARANTEES
+
+
 def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
           anomalies: Sequence[str] = (), use_device: bool = True,
           max_reported: int = 8) -> Dict[str, Any]:
@@ -71,13 +77,60 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
         return {"valid?": "unknown", "anomaly-types": [], "anomalies": {},
                 "not": [], "also-not": []}
 
+    # session-guarantee tokens in the requested set run the dedicated
+    # per-process checker (needs the op-level history; a PackedTxns-only
+    # caller skips them — the packed form drops per-process sequencing)
+    want = set(consistency.anomalies_for_models(
+        [consistency.canonical(m) for m in consistency_models]))
+    want |= set(anomalies)
+    want |= {"duplicate-writes", "cyclic-versions"}
+    sess_found: Dict[str, List[Any]] = {}
+    suffix = "-violation"
+    sess_want = {w for w in want if w.endswith(suffix)
+                 and w[:-len(suffix)] in sessions_guarantees()}
+    # packed input drops the op-level view the session checker walks: a
+    # session-family request then cannot be session-checked.  When the
+    # request also proscribes process-edge cycles (strict/strong-session
+    # class), per-session ordering violations surface as process-edge
+    # cycles in the transactional graph (the reference's own treatment),
+    # so the verdict stands; a BARE session request (e.g. just
+    # ["monotonic-reads"]) has no such coverage and must degrade to
+    # unknown rather than silently report valid
+    proc_covered = bool({"G-single-process", "G1c-process",
+                         "G0-process"} & want)
+    sess_unchecked = sorted(w[:-len(suffix)] for w in sess_want) \
+        if (sess_want and isinstance(history, PackedTxns)
+            and not proc_covered) else []
+    if sess_want and not isinstance(history, PackedTxns):
+        from jepsen_tpu.checkers.elle import sessions
+
+        sres = sessions.check(history,
+                              guarantees=[w[:-len(suffix)]
+                                          for w in sess_want])
+        sess_found = sres["anomalies"]
+
+    def finalize(result: Dict[str, Any]) -> Dict[str, Any]:
+        if sess_unchecked and result["valid?"] is True:
+            result["valid?"] = "unknown"
+            result["unchecked-guarantees"] = sess_unchecked
+        return result
+
     if use_device and p.n_txns >= FUSED_MIN_TXNS:
         from jepsen_tpu.checkers.elle import device_rw
 
         fast = device_rw.check(p)
         if fast["valid?"] is True and fast["exact"]:
-            return {"valid?": True, "anomaly-types": [], "anomalies": {},
-                    "not": [], "also-not": [], "fused-device": True}
+            anomaly_types = sorted(sess_found)
+            boundary = consistency.friendly_boundary(anomaly_types)
+            bad = set(boundary["not"]) | set(boundary["also-not"])
+            requested_bad = bad & {consistency.canonical(m)
+                                   for m in consistency_models}
+            return finalize({"valid?": not requested_bad,
+                             "anomaly-types": anomaly_types,
+                             "anomalies": sess_found,
+                             "not": boundary["not"],
+                             "also-not": boundary["also-not"],
+                             "fused-device": True})
         # invalid or inexact: fall through for the detailed host report
 
     T = p.n_txns
@@ -282,10 +335,7 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
     rank = np.concatenate([2 * comp, b_ranks]).astype(np.int32)
 
     # ---- cycle anomalies --------------------------------------------------
-    want = set(consistency.anomalies_for_models(
-        [consistency.canonical(m) for m in consistency_models]))
-    want |= set(anomalies)
-    want |= {"duplicate-writes", "cyclic-versions"}
+    found.update(sess_found)
     from jepsen_tpu.checkers.elle.explain import rw_explainer
 
     expl = rw_explainer(p, writer, v_src, v_dst,
@@ -301,13 +351,13 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
     bad = set(boundary["not"]) | set(boundary["also-not"])
     requested_bad = bad & {consistency.canonical(m)
                            for m in consistency_models}
-    return {
+    return finalize({
         "valid?": not requested_bad,
         "anomaly-types": anomaly_types,
         "anomalies": found,
         "not": boundary["not"],
         "also-not": boundary["also-not"],
-    }
+    })
 
 
 def _seg_reverse_max(vals: np.ndarray, seg_id: np.ndarray) -> np.ndarray:

@@ -1,0 +1,174 @@
+"""Session-guarantee checker: monotonic reads / monotonic writes /
+read-your-writes / writes-follow-reads over rw-register histories.
+
+Completes the lattice's session family (reference:
+`elle/consistency_model.clj` models the guarantees; the checking idea is
+the classic Terry et al. formulation over a per-key version order).  The
+version order is the same one the rw-register checker infers — per-key
+edges u -> v when a committed txn externally reads u (or writes blind,
+u = init) and then writes v, chained through the txn's write sequence.
+Only *definite* violations are reported: two versions are compared only
+when one is an ancestor of the other in the version DAG, so branching
+(itself an anomaly, reported elsewhere as cyclic-versions/lost-update)
+never manufactures a false session violation.
+
+Guarantees (each emits the lattice's "<model>-violation" token):
+- monotonic-reads: a session's successive reads of a key never go
+  backwards in the version order.
+- read-your-writes: after a session writes v, its later reads of that
+  key return v or a successor.
+- monotonic-writes: a session's writes to a key are installed in
+  session order.
+- writes-follow-reads: a session's write to a key is ordered after the
+  versions the session previously read from that key (the same-key
+  projection of WFR — cross-key propagation needs a global causal
+  order; the transactional checkers cover that via G1c-process).
+
+Scope notes: ok txns only (an indeterminate txn's effects are not
+session-ordered), external reads only (txn-internal read-own-write is
+`internal`'s job), sessions = processes (the reference's convention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+from jepsen_tpu.checkers.elle import consistency
+from jepsen_tpu.history.ops import INVOKE, OK, History
+
+GUARANTEES = ("monotonic-reads", "monotonic-writes", "read-your-writes",
+              "writes-follow-reads")
+
+#: the unwritten initial version of every key (a read returning None
+#: observes it; it precedes every written version of its key)
+INIT = "__init__"
+
+
+def _sessions(h: History):
+    """Per-process list of (invoke_index, [mops]) for ok client txns."""
+    out: Dict[Any, List] = {}
+    for op in h.ops:
+        if op.type != INVOKE or not op.is_client_op():
+            continue
+        ci = h.pair_index(op.index)
+        if ci < 0 or h.ops[ci].type != OK:
+            continue
+        out.setdefault(op.process, []).append((op.index,
+                                               h.ops[ci].value))
+    for seq in out.values():
+        seq.sort()
+    return out
+
+
+def _version_dag(sessions) -> Dict[Any, Dict[Any, set]]:
+    """Per-key successor sets: succ[k][u] = direct successor versions."""
+    succ: Dict[Any, Dict[Any, set]] = {}
+    for seq in sessions.values():
+        for _, mops in seq:
+            cur: Dict[Any, Any] = {}  # txn-local last version per key
+            for f, k, v in mops:
+                if f == "r":
+                    if k not in cur:
+                        cur[k] = v if v is not None else INIT
+                elif f == "w":
+                    u = cur.get(k, INIT)
+                    succ.setdefault(k, {}).setdefault(
+                        u, set()).add(v)
+                    cur[k] = v
+    return succ
+
+
+def _ancestors(succ_k: Dict[Any, set]) -> Dict[Any, set]:
+    """version -> set of strict ancestors, via DFS over the (small,
+    chain-shaped in valid histories) per-key DAG."""
+    anc: Dict[Any, set] = {}
+    # build predecessor map
+    preds: Dict[Any, set] = {}
+    for u, vs in succ_k.items():
+        for v in vs:
+            preds.setdefault(v, set()).add(u)
+
+    def walk(v, seen):
+        if v in anc:
+            return anc[v]
+        if v in seen:
+            return set()  # cycle: cyclic-versions territory; stay sound
+        seen.add(v)
+        out = set()
+        for u in preds.get(v, ()):
+            out.add(u)
+            out |= walk(u, seen)
+        anc[v] = out
+        return out
+
+    for v in list(preds):
+        walk(v, set())
+    return anc
+
+
+def check(history, guarantees: Sequence[str] = GUARANTEES,
+          max_reported: int = 8) -> Dict[str, Any]:
+    """Check session guarantees; result shape matches the elle checkers."""
+    h = history if isinstance(history, History) else History(history)
+    sessions = _sessions(h)
+    dag = _version_dag(sessions)
+    anc_of = {k: _ancestors(sk) for k, sk in dag.items()}
+
+    found: Dict[str, List[dict]] = {}
+
+    def report(name, item):
+        lst = found.setdefault(name + "-violation", [])
+        if len(lst) < max_reported:
+            lst.append(item)
+
+    def precedes(k, a, b) -> bool:
+        """a is a strict ancestor of b in key k's version order."""
+        return a in anc_of.get(k, {}).get(b, ())
+
+    want = set(guarantees)
+    for proc, seq in sessions.items():
+        last_read: Dict[Any, Any] = {}   # key -> last externally read ver
+        last_write: Dict[Any, Any] = {}  # key -> last written ver
+        for inv, mops in seq:
+            cur: Dict[Any, Any] = {}
+            for f, k, v in mops:
+                if f == "r":
+                    if k in cur:
+                        continue  # internal read: `internal`'s job
+                    if v is None:
+                        v = INIT  # observed the unwritten initial state
+                    if "monotonic-reads" in want and k in last_read and \
+                            precedes(k, v, last_read[k]):
+                        report("monotonic-reads",
+                               {"process": proc, "op": inv, "key": k,
+                                "read": v, "after-reading": last_read[k]})
+                    if "read-your-writes" in want and k in last_write and \
+                            precedes(k, v, last_write[k]):
+                        report("read-your-writes",
+                               {"process": proc, "op": inv, "key": k,
+                                "read": v, "after-writing": last_write[k]})
+                    last_read[k] = v
+                    cur[k] = v
+                elif f == "w":
+                    if "monotonic-writes" in want and k in last_write and \
+                            precedes(k, v, last_write[k]):
+                        report("monotonic-writes",
+                               {"process": proc, "op": inv, "key": k,
+                                "wrote": v, "after-writing": last_write[k]})
+                    if "writes-follow-reads" in want and k in last_read \
+                            and precedes(k, v, last_read[k]):
+                        report("writes-follow-reads",
+                               {"process": proc, "op": inv, "key": k,
+                                "wrote": v, "after-reading": last_read[k]})
+                    last_write[k] = v
+                    cur[k] = v
+
+    anomaly_types = sorted(found)
+    boundary = consistency.friendly_boundary(anomaly_types)
+    return {
+        "valid?": not found,
+        "anomaly-types": anomaly_types,
+        "anomalies": found,
+        "not": boundary["not"],
+        "also-not": boundary["also-not"],
+    }

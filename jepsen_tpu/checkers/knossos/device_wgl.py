@@ -45,11 +45,20 @@ without this, every wave pays F x n work and long serial histories are
 hopeless; with it, per-wave cost tracks the real concurrency window.
 Differentially tested per-wave against an exact Python set-BFS.
 
-BFS-vs-DFS caveat: each crashed (`info`) op stays forever-concurrent and
-multiplies the per-wave config count (the subsets that did/didn't
-linearize it) — BFS enumerates them; the reference's DFS often finds a
-witness first.  That asymmetry is why `competition.analysis` races this
-search against the host DFS rather than replacing it.
+Crash-heavy histories (`info` ops) no longer blow the frontier up: each
+crashed op stays forever-concurrent, so a naive BFS enumerates every
+did/didn't-linearize-it subset per wave.  The blocked search prunes that
+dimension with a sound cross-wave dominance rule: a config
+(state, R, X₁) — R the linearized *returned* ops, X the linearized
+*crashed* ops — simulates every future of (state, R, X₂) when X₁ ⊂ X₂.
+Crashed ops never drive `minret` (their returns sit at the 2^29 cap,
+above every real invoke), so the extra unlinearized crashed ops on the
+X₁ side only add options, never constraints: any schedule from the X₂
+config replays verbatim from the X₁ config.  The search keeps a host-
+side store of minimal X-sets per (state, R) and drops dominated
+children as they are generated — the config count then tracks the
+DFS-competitive measure (states x returned-schedules x X-antichain)
+instead of the crashed-subset lattice.
 """
 
 from __future__ import annotations
@@ -349,6 +358,41 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
     # (k+1)-th smallest real return bounds every wave-k config's minret
     real_rets = np.sort(returns[returns < 2 ** 29])
 
+    # crashed-op dominance prune (see module doc): minimal linearized-
+    # crashed bitsets per (state, returned-lin) key.  Engaged only when
+    # crashed ops are numerous enough for subset blowup to matter — the
+    # per-row host loop costs more than it saves on a near-clean history
+    # (blowup is bounded by 2^n_info), and skipping both the prune AND
+    # the store is sound: pruning only ever removes simulated configs.
+    n_info = int(np.sum(returns[:n] == 2 ** 29))
+    use_dominance = n_info >= 3
+    info_mask = ~must  # words: bits of crashed (+ padding, always-0) ops
+    dom: Dict[bytes, list] = {}
+
+    def dominance_prune(s, b, h1u, h2u):
+        """Drop configs whose crashed-lin set is a strict superset of a
+        previously kept one at the same (state, returned-lin).  Keeps
+        (and records) the survivors.  The store holds a python LIST of
+        minimal-X rows per key (append is O(1); antichains stay small)."""
+        R = b & must_row
+        X = b & info_mask[None, :]
+        keep_rows = np.ones(len(s), bool)
+        for i in range(len(s)):
+            key = s[i].tobytes() + R[i].tobytes()
+            stored = dom.get(key)
+            xi = X[i]
+            if stored is not None:
+                # dominated iff some stored X' ⊆ X (strict or equal;
+                # equal can't happen across waves, and within a wave the
+                # exact dedup already removed duplicates)
+                if any(bool(np.all((x & ~xi) == 0)) for x in stored):
+                    keep_rows[i] = False
+                    continue
+                stored.append(xi.copy())
+            else:
+                dom[key] = [xi.copy()]
+        return s[keep_rows], b[keep_rows], h1u[keep_rows], h2u[keep_rows]
+
     def active_window(blocks, k):
         """Op ids that can still be candidates at wave k: not linearized
         in EVERY config, and invokable below the wave's minret bound."""
@@ -366,6 +410,32 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
         # one config can have up to A children, so C >= A guarantees a
         # single-row block never needs splitting (split progress)
         return min(max(4 * F, A), F * A)
+
+    # Small waves skip the device entirely: per-wave jit dispatch plus
+    # host<->device round-trips dominate when the frontier is a few
+    # hundred rows (the crash-heavy regime after dominance pruning), and
+    # the expansion math is trivial in numpy at that size.
+    HOST_EXPAND_MAX = 4096
+
+    def expand_host(act, states, bits, h1, h2):
+        """Exact children of a small frontier over the active window —
+        the numpy mirror of `_expand_block` (no caps, no splitting)."""
+        aw = word_idx_h[act]
+        ab = bit_h[act]
+        in_s = ((bits[:, aw] >> ab) & 1).astype(bool)          # (m, A)
+        ret = np.where(in_s, np.int64(2 ** 30), returns[act][None, :])
+        minret = ret.min(axis=1)
+        cand = (~in_s) & (invokes[act][None, :] < minret[:, None])
+        nxt = table[states[:, None], op_sym[act][None, :]]
+        cand &= nxt >= 0
+        rows, cols = np.nonzero(cand)
+        ch_state = nxt[rows, cols].astype(np.int32)
+        ch_h1 = h1[rows] ^ z1[act][cols]
+        ch_h2 = h2[rows] ^ z2[act][cols]
+        ch_bits = bits[rows].copy()
+        ch_bits[np.arange(len(rows)), aw[cols]] |= (
+            np.uint32(1) << ab[cols].astype(np.uint32))
+        return ch_state, ch_bits, ch_h1, ch_h2
 
     def pad_block(states, bits, h1, h2, m):
         # right-size the block: a sparse wave (serial history) must not
@@ -403,6 +473,28 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
         ch_h2: List[np.ndarray] = []
 
         act = active_window(blocks, k)
+        total_rows = int(sum(b[4].sum() for b in blocks))
+
+        if _DEBUG and k % 50 == 0:
+            import time as _t
+            print(f"wave {k}: blocks={len(blocks)} rows={total_rows} "
+                  f"A={len(act)} t={_t.perf_counter():.1f}", flush=True)
+
+        if total_rows <= HOST_EXPAND_MAX and len(act):
+            st = np.concatenate([b[0][b[4]] for b in blocks])
+            bi = np.concatenate([b[1][b[4]] for b in blocks])
+            a1 = np.concatenate([b[2][b[4]] for b in blocks])
+            a2 = np.concatenate([b[3][b[4]] for b in blocks])
+            o_st, o_bi, o_h1, o_h2 = expand_host(act, st, bi, a1, a2)
+            if len(o_st):
+                ch_s.append(o_st)
+                ch_b.append(o_bi)
+                ch_h1.append(o_h1)
+                ch_h2.append(o_h2)
+            work = []
+        else:
+            work = list(blocks)
+
         A = 8
         while A < len(act):
             A *= 2
@@ -410,18 +502,14 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
         act_mask[:len(act)] = True
         act_pad = np.zeros(A, np.int32)
         act_pad[:len(act)] = act
-        win = (jnp.asarray(act_mask), jnp.asarray(invokes[act_pad]),
-               jnp.asarray(returns[act_pad]), jnp.asarray(op_sym[act_pad]),
-               jnp.asarray(z1[act_pad]), jnp.asarray(z2[act_pad]),
-               jnp.asarray(word_idx_h[act_pad]),
-               jnp.asarray(bit_h[act_pad]))
-
-        if _DEBUG and k % 50 == 0:
-            import time as _t
-            print(f"wave {k}: blocks={len(blocks)} "
-                  f"rows={sum(b[4].sum() for b in blocks)} A={A} "
-                  f"t={_t.perf_counter():.1f}", flush=True)
-        work = list(blocks)
+        win = None
+        if work:
+            win = (jnp.asarray(act_mask), jnp.asarray(invokes[act_pad]),
+                   jnp.asarray(returns[act_pad]),
+                   jnp.asarray(op_sym[act_pad]),
+                   jnp.asarray(z1[act_pad]), jnp.asarray(z2[act_pad]),
+                   jnp.asarray(word_idx_h[act_pad]),
+                   jnp.asarray(bit_h[act_pad]))
         while work:
             st, bi, a1, a2, va = work.pop()
             F = len(st)
@@ -472,6 +560,11 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
                        axis=1).any()):
             return {"valid?": True, "op-count": n, "hash_dedup": True,
                     "blocked": True}
+        if use_dominance:
+            s, b, h1u, h2u = dominance_prune(s, b, h1u, h2u)
+            if not len(s):
+                return {"valid?": False, "op-count": n,
+                        "hash_dedup": True, "blocked": True}
         total_seen += len(s)
         if total_seen > max_configs:
             return {"valid?": "unknown", "op-count": n,

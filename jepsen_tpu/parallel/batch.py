@@ -135,7 +135,14 @@ def summarize_batch_bits(bits, over, batch, n_keys: int, n_real: int,
     out: List[dict] = []
     for i in range(n_real):
         row = bits[i]
-        if int(over[i]) > 0 or int(row[-1]) != 1:
+        counts = {n: int(row[j]) for j, n in enumerate(COUNT_NAMES)}
+        # a positive count is computed BEFORE the cycle sweep and is
+        # exact regardless of sweep convergence: the history is
+        # definitively invalid, so skip the (compile-heavy at 1M-op
+        # shapes) exact rerun — it could only refine the cycle list
+        invalid_by_counts = any(v > 0 for v in counts.values())
+        if (int(over[i]) > 0 or int(row[-1]) != 1) \
+                and not invalid_by_counts:
             from jepsen_tpu.checkers.elle.device_infer import pow2_at_least
 
             k0 = pow2_at_least(k_floor + int(over[i]), floor=k_floor)
@@ -143,26 +150,31 @@ def summarize_batch_bits(bits, over, batch, n_keys: int, n_real: int,
             b2, o2 = core_check_exact(h_i, n_keys, max_k=k0)
             row = np.asarray(b2)
             over[i] = max(0, int(np.asarray(o2)))
-        counts = {n: int(row[j]) for j, n in enumerate(COUNT_NAMES)}
+            counts = {n: int(row[j]) for j, n in enumerate(COUNT_NAMES)}
         cycles = [bool(x) for x in row[len(COUNT_NAMES):-1]]
         converged = bool(row[-1]) and int(over[i]) == 0
         invalid = any(v > 0 for v in counts.values()) or any(cycles)
         out.append({
-            "valid?": (not invalid) if converged else "unknown",
+            "valid?": False if invalid else
+                      (True if converged else "unknown"),
             "counts": counts,
             "cycles": {
                 "G0": cycles[0], "G1c": cycles[1], "G2-family": cycles[2],
                 "G2-family-process": cycles[3],
                 "G2-family-realtime": cycles[4],
             },
-            "exact": converged,
+            # the VERDICT is exact when the sweep converged or when the
+            # invalidity stands on counts alone (the cycle dict may
+            # then be under-reported — counts already decide validity)
+            "exact": bool(converged or invalid),
         })
     return out
 
 
 def check_batch_checkpointed(ps: Sequence[PackedTxns], ckpt_path: str,
                              mesh: Mesh = None, axis: str = "dp",
-                             group_size: int = 0) -> List[dict]:
+                             group_size: int = 0,
+                             on_group=None) -> List[dict]:
     """`check_batch` with chunk-level progress markers (SURVEY.md §5
     checkpoint/resume: "checkpointable device checking … since a 10M-op
     SCC run is minutes").
@@ -178,10 +190,15 @@ def check_batch_checkpointed(ps: Sequence[PackedTxns], ckpt_path: str,
 
     The checkpoint records per-history content digests; a resume against
     different histories at the same path raises instead of mixing runs.
+
+    `on_group(info)` (optional) is called after each group's checkpoint
+    record is durable, with {"group", "indices", "wall_s", "done"} —
+    progress reporting and crash-injection for the config-5 artifact.
     """
     import hashlib
     import json
     import os
+    import time as _time
 
     def digest(p: PackedTxns) -> str:
         # every packed column that inference reads: two runs with the
@@ -249,6 +266,7 @@ def check_batch_checkpointed(ps: Sequence[PackedTxns], ckpt_path: str,
             # would recompile _batched_core — the very cost caps pin down
             group = [ps[i] for i in idx]
             group += [group[0]] * (group_size - len(group))
+            t_g = _time.monotonic()
             results = check_batch(group, mesh=mesh, axis=axis,
                                   caps=caps)[:len(idx)]
             for i, r in zip(idx, results):
@@ -257,4 +275,8 @@ def check_batch_checkpointed(ps: Sequence[PackedTxns], ckpt_path: str,
                     {"i": i, "digest": digests[i], "result": r}) + "\n")
             f.flush()
             os.fsync(f.fileno())
+            if on_group is not None:
+                on_group({"group": g0 // group_size, "indices": idx,
+                          "wall_s": round(_time.monotonic() - t_g, 2),
+                          "done": sum(r is not None for r in out)})
     return out

@@ -1,0 +1,147 @@
+"""Config 5 at its stated scale (BASELINE.json: batched 100 x 1M):
+100 one-million-txn list-append histories, checked as a checkpointed
+batch on the 8-virtual-device CPU mesh, with seeded-invalid members and
+a deliberate mid-run kill + resume (VERDICT r03 item 4).
+
+Two-invocation protocol (driven by the caller):
+  1. C5_KILL_AFTER_GROUPS=k python scripts/config5_batch.py
+       -> os._exit(1) after k durable group checkpoints (the "crash")
+  2. python scripts/config5_batch.py
+       -> resumes from the checkpoint, finishes, verifies verdicts
+          (every 10th history carries a seeded duplicate-append and must
+          come back invalid; the rest valid), writes the artifact.
+
+Artifact: scripts/config5_r04.json — per-group wall times, resume
+bookkeeping (how many groups were skipped), verdict tallies, peak RSS.
+Env: C5_N (100), C5_TXNS (1_000_000), C5_GROUP (8), C5_CKPT, C5_OUT,
+C5_KILL_AFTER_GROUPS.
+"""
+
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.utils.backend import enable_compile_cache, force_cpu_backend
+
+N = int(os.environ.get("C5_N", 100))
+TXNS = int(os.environ.get("C5_TXNS", 1_000_000))
+GROUP = int(os.environ.get("C5_GROUP", 8))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CKPT = os.environ.get("C5_CKPT", os.path.join(REPO, "store",
+                                              "config5_r04.ckpt"))
+OUT = os.environ.get("C5_OUT", os.path.join(REPO, "scripts",
+                                            "config5_r04.json"))
+KILL_AFTER = int(os.environ.get("C5_KILL_AFTER_GROUPS", 0))
+
+
+def seed_invalid(p):
+    """Flip one observed append's writer txn to FAIL — an aborted read
+    (G1a) every reader of that value exposes.  Invalid AND convergent:
+    unlike a seeded duplicate-append (which perturbs the version order
+    into sweep-budget growth and a ~30-min exact-rerun recompile per
+    group at 1M shapes, measured in this run's first attempt), a failed
+    writer only flips counts, so the batched verdict stays exact with no
+    rerun."""
+    import numpy as np
+
+    from jepsen_tpu.history.soa import MOP_READ, TXN_FAIL, TXN_OK
+
+    kinds = np.asarray(p.mop_kind)
+    keys = np.asarray(p.mop_key)
+    vals = np.asarray(p.mop_val)
+    txns = np.asarray(p.mop_txn)
+    app = np.flatnonzero(kinds != MOP_READ)
+    reads = np.flatnonzero((kinds == MOP_READ) & (p.mop_rd_len > 0))
+    for r in reads[:500]:
+        start, ln = int(p.mop_rd_start[r]), int(p.mop_rd_len[r])
+        for off in range(ln):
+            vid = p.rd_elems[start + off]
+            for wi in app[(vals[app] == vid) & (keys[app] == keys[r])]:
+                wt = int(txns[wi])
+                if wt != int(txns[r]) and p.txn_type[wt] == TXN_OK \
+                        and p.txn_type[int(txns[r])] == TXN_OK:
+                    p.txn_type[wt] = TXN_FAIL
+                    return p
+    raise AssertionError("no seedable observed append found")
+
+
+def main():
+    force_cpu_backend(8)
+    enable_compile_cache()
+    import jax
+
+    from jepsen_tpu.parallel.batch import check_batch_checkpointed, make_mesh
+    from jepsen_tpu.workloads import synth
+
+    os.makedirs(os.path.dirname(CKPT), exist_ok=True)
+    t0 = time.monotonic()
+    print(f"[config5] generating {N} x {TXNS} histories "
+          f"(every 10th seeded-invalid)", flush=True)
+    ps = []
+    for i in range(N):
+        p = synth.packed_la_history(n_txns=TXNS, n_keys=max(64, TXNS // 8),
+                                    mops_per_txn=4, read_frac=0.25, seed=i)
+        if i % 10 == 9:
+            p = seed_invalid(p)
+        ps.append(p)
+        if i % 10 == 9:
+            print(f"[config5] gen {i + 1}/{N} "
+                  f"({time.monotonic() - t0:.0f}s)", flush=True)
+    t_gen = time.monotonic() - t0
+
+    had_ckpt_groups = 0
+    if os.path.exists(CKPT):
+        with open(CKPT) as f:
+            had_ckpt_groups = sum(1 for line in f if line.strip())
+
+    groups = []
+
+    def on_group(info):
+        groups.append(info)
+        print(f"[config5] group {info['group']} ok in {info['wall_s']}s "
+              f"({info['done']}/{N} done)", flush=True)
+        if KILL_AFTER and len(groups) >= KILL_AFTER:
+            print(f"[config5] simulated crash after "
+                  f"{KILL_AFTER} groups", flush=True)
+            os._exit(1)
+
+    mesh = make_mesh(8)
+    t1 = time.monotonic()
+    results = check_batch_checkpointed(ps, CKPT, mesh=mesh,
+                                       group_size=GROUP, on_group=on_group)
+    t_check = time.monotonic() - t1
+
+    bad = [i for i, r in enumerate(results) if r["valid?"] is not False
+           and i % 10 == 9]
+    good = [i for i, r in enumerate(results) if r["valid?"] is not True
+            and i % 10 != 9]
+    ok = not bad and not good
+    art = {
+        "metric": "config5-batched-check",
+        "n_histories": N,
+        "txns_each": TXNS,
+        "mesh": "8-virtual-cpu",
+        "group_size": GROUP,
+        "gen_s": round(t_gen, 1),
+        "check_s": round(t_check, 1),
+        "groups_this_run": groups,
+        "resumed_with_records": had_ckpt_groups,
+        "seeded_invalid_caught": not bad,
+        "valid_verdicts_correct": not good,
+        "peak_rss_gb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20, 2),
+        "ok": ok,
+    }
+    with open(OUT, "w") as f:
+        f.write(json.dumps(art, indent=1) + "\n")
+    print(json.dumps(art), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,10 +166,7 @@ def test_analysis_competition():
 
 def test_device_wgl_blocked_above_singlejit_cap():
     # past the single-jit cutoff the blocked (host-spill) path must give
-    # a definitive verdict (round-2 VERDICT item 7: the 4096-op wall).
-    # info_prob=0: crashed ops multiply BFS config counts (see module
-    # docstring) — that regime belongs to the DFS side of the
-    # competition, not this capability test.
+    # a definitive verdict (round-2 VERDICT item 7: the 4096-op wall)
     h = synth.lin_register_history(n_ops=1400, concurrency=3,
                                    info_prob=0.0, seed=5)
     ops = prepare(h)
@@ -177,6 +174,37 @@ def test_device_wgl_blocked_above_singlejit_cap():
     r = device_wgl.check(ops, cas_register())
     assert r["valid?"] is True, r
     assert r.get("blocked") is True
+
+
+def test_device_wgl_crash_heavy_dominance_prune():
+    """VERDICT r03 item 8: crashed (`info`) ops used to multiply BFS
+    frontiers until the device path ceded the regime to the host DFS.
+    The crashed-op dominance prune (see device_wgl module doc) bounds
+    it: a large crash-heavy history now completes on the device path
+    with the host verdict."""
+    h = synth.lin_register_history(n_ops=300, concurrency=6,
+                                   info_prob=0.15, cas_prob=0.2, seed=5)
+    ops = prepare(h)
+    n_info = sum(1 for o in ops if o.is_info)
+    assert n_info >= 20  # genuinely crash-heavy
+    r_host = wgl.check(list(ops), cas_register())
+    r_dev = device_wgl._blocked_and_check(list(ops), cas_register())
+    assert r_dev["valid?"] == r_host["valid?"], (r_host, r_dev)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_device_wgl_crash_heavy_differential(seed):
+    # dominance prune differential: mixed info rates and stale reads,
+    # device blocked search vs host DFS on every definitive verdict
+    h = synth.lin_register_history(
+        n_ops=120, concurrency=5,
+        stale_read_prob=0.25 if seed % 2 else 0.0,
+        info_prob=(0.1, 0.2, 0.3)[seed % 3], seed=seed)
+    ops = prepare(h)
+    r_host = wgl.check(list(ops), cas_register())
+    r_dev = device_wgl._blocked_and_check(list(ops), cas_register())
+    if r_host["valid?"] != "unknown" and r_dev["valid?"] != "unknown":
+        assert r_dev["valid?"] == r_host["valid?"], (seed, r_host, r_dev)
 
 
 def test_device_wgl_blocked_invalid_detected():

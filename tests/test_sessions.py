@@ -1,0 +1,106 @@
+"""Session-guarantee checker tests (monotonic reads/writes, RYW, WFR)."""
+
+from jepsen_tpu.checkers.elle import sessions
+from jepsen_tpu.history import history, invoke, ok
+
+
+def seq(*txns):
+    """Sequential history: each (process, invoked-mops, ok-mops) txn
+    completes before the next invokes (session order = list order)."""
+    ops = []
+    for p, mi, mo in txns:
+        ops.append(invoke(p, "txn", mi))
+        ops.append(ok(p, "txn", mo))
+    return history(ops)
+
+
+# version chain for key x: INIT -> 1 -> 2, built by process 0's txns
+CHAIN = [
+    (0, [["r", "x", None], ["w", "x", 1]],
+        [["r", "x", None], ["w", "x", 1]]),
+    (0, [["r", "x", None], ["w", "x", 2]],
+        [["r", "x", 1], ["w", "x", 2]]),
+]
+
+
+def test_valid_session_history():
+    h = seq(*CHAIN,
+            (1, [["r", "x", None]], [["r", "x", 1]]),
+            (1, [["r", "x", None]], [["r", "x", 2]]))
+    res = sessions.check(h)
+    assert res["valid?"] is True, res
+
+
+def test_monotonic_reads_violation():
+    h = seq(*CHAIN,
+            (1, [["r", "x", None]], [["r", "x", 2]]),
+            (1, [["r", "x", None]], [["r", "x", 1]]))  # went backwards
+    res = sessions.check(h)
+    assert res["valid?"] is False
+    assert res["anomaly-types"] == ["monotonic-reads-violation"]
+    assert res["not"] == ["monotonic-reads"]
+    assert "PRAM" in res["also-not"]
+
+
+def test_read_backwards_to_nil_is_monotonic_reads():
+    h = seq(*CHAIN,
+            (1, [["r", "x", None]], [["r", "x", 1]]),
+            (1, [["r", "x", None]], [["r", "x", None]]))  # back to init
+    res = sessions.check(h)
+    assert "monotonic-reads-violation" in res["anomaly-types"]
+
+
+def test_read_your_writes_violation():
+    h = seq(CHAIN[0],
+            (1, [["r", "x", None], ["w", "x", 2]],
+                [["r", "x", 1], ["w", "x", 2]]),   # proc 1 installs 2
+            (1, [["r", "x", None]], [["r", "x", 1]]))  # then reads 1
+    res = sessions.check(h)
+    assert "read-your-writes-violation" in res["anomaly-types"]
+    assert "read-your-writes" in res["not"]
+
+
+def test_monotonic_writes_violation():
+    h = seq(*CHAIN,
+            (1, [["w", "x", 2]], [["w", "x", 2]]),   # blind write 2
+            (1, [["w", "x", 1]], [["w", "x", 1]]))   # then 1 (1 < 2)
+    res = sessions.check(h)
+    assert "monotonic-writes-violation" in res["anomaly-types"]
+
+
+def test_writes_follow_reads_violation():
+    h = seq(*CHAIN,
+            (1, [["r", "x", None]], [["r", "x", 2]]),  # read 2
+            (1, [["w", "x", 1]], [["w", "x", 1]]))     # then write 1 < 2
+    res = sessions.check(h)
+    assert "writes-follow-reads-violation" in res["anomaly-types"]
+
+
+def test_incomparable_versions_no_false_positive():
+    # two blind writes: versions 5 and 6 are incomparable — reading one
+    # then the other is NOT a definite violation
+    h = seq((0, [["w", "x", 5]], [["w", "x", 5]]),
+            (0, [["w", "y", 6]], [["w", "y", 6]]),
+            (1, [["r", "x", None]], [["r", "x", 5]]),
+            (1, [["r", "x", None]], [["r", "x", 5]]))
+    res = sessions.check(h)
+    assert res["valid?"] is True, res
+
+
+def test_indeterminate_txns_excluded():
+    from jepsen_tpu.history import info as info_op
+
+    ops = [invoke(0, "txn", [["w", "x", 1]]),
+           info_op(0, "txn", [["w", "x", 1]]),
+           invoke(1, "txn", [["r", "x", None]]),
+           ok(1, "txn", [["r", "x", None]])]
+    res = sessions.check(history(ops))
+    assert res["valid?"] is True
+
+
+def test_guarantee_selection():
+    h = seq(*CHAIN,
+            (1, [["r", "x", None]], [["r", "x", 2]]),
+            (1, [["r", "x", None]], [["r", "x", 1]]))
+    res = sessions.check(h, guarantees=("monotonic-writes",))
+    assert res["valid?"] is True  # MR not requested
