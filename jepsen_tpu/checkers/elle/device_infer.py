@@ -78,6 +78,16 @@ class PaddedLA:
     rd_elem_mask: jnp.ndarray      # (R,) bool
     n_keys: int                    # static
     n_vals: int                    # static
+    # Static layout facts, host-verified at padding time (False/0 = unknown,
+    # infer falls back to device sorts).  They hold by construction for
+    # TxnPacker output; pad_packed re-checks so hand-built PackedTxns with
+    # exotic layouts stay correct through the fallback.
+    txn_major: bool = False        # static: mop_txn nondecreasing, valid
+    #                                mops contiguous before the padding tail
+    run_cap: int = 0               # static: pow2 bucket >= max mops/txn
+    #                                (0 = unknown or > _RUN_CAP_MAX)
+    complete_monotone: bool = False  # static: txn_complete_pos strictly
+    #                                  increasing over valid txns
 
 
 jax.tree_util.register_dataclass(
@@ -86,8 +96,13 @@ jax.tree_util.register_dataclass(
                  "txn_complete_pos", "txn_mask", "mop_txn", "mop_kind",
                  "mop_key", "mop_val", "mop_rd_start", "mop_rd_len",
                  "mop_mask", "rd_elems", "rd_elem_mask"],
-    meta_fields=["n_keys", "n_vals"],
+    meta_fields=["n_keys", "n_vals", "txn_major", "run_cap",
+                 "complete_monotone"],
 )
+
+# Above this many mops in one txn the shifted-compare ranking (2*(cap-1)
+# M-sized passes) stops beating the O(M log^2 M) bitonic sort it replaces.
+_RUN_CAP_MAX = 32
 
 
 def pow2_at_least(n: int, floor: int = 8) -> int:
@@ -97,12 +112,39 @@ def pow2_at_least(n: int, floor: int = 8) -> int:
     return x
 
 
+def run_cap_of(longest: int) -> int:
+    """Pow2 bucket for the longest per-txn mop run; 0 = too long, use the
+    device-sort fallback.  Single definition so the pad_packed and
+    streamed-staging paths can't drift apart on compile-cache keys."""
+    return pow2_at_least(max(longest, 1), floor=1) \
+        if longest <= _RUN_CAP_MAX else 0
+
+
+def _layout_facts(p: PackedTxns) -> tuple[bool, int, bool]:
+    """Host-verify the packing-layout invariants that let `infer` skip
+    device sorts (cheap numpy scans; ~ms at 1M txns)."""
+    txn_major = bool(
+        p.n_mops == 0
+        or (np.all(np.diff(p.mop_txn) >= 0)
+            and p.mop_txn[0] >= 0 and p.mop_txn[-1] < p.n_txns))
+    run_cap = 0
+    if txn_major:
+        longest = int(np.bincount(
+            p.mop_txn, minlength=max(p.n_txns, 1)).max()) if p.n_mops \
+            else 1
+        run_cap = run_cap_of(longest)
+    complete_monotone = bool(np.all(np.diff(p.txn_complete_pos) > 0)) \
+        if p.n_txns > 1 else True
+    return txn_major, run_cap, complete_monotone
+
+
 def pad_packed(p: PackedTxns, t_pad: int = 0, m_pad: int = 0,
                r_pad: int = 0) -> PaddedLA:
     """Pad a PackedTxns to pow2 capacities (host-side, cheap numpy)."""
     T = t_pad or pow2_at_least(p.n_txns)
     M = m_pad or pow2_at_least(p.n_mops)
     R = r_pad or pow2_at_least(max(len(p.rd_elems), p.n_vals, p.n_keys + 1))
+    txn_major, run_cap, complete_monotone = _layout_facts(p)
 
     def pad(a, n, fill=0):
         out = np.full(n, fill, dtype=a.dtype)
@@ -126,6 +168,9 @@ def pad_packed(p: PackedTxns, t_pad: int = 0, m_pad: int = 0,
         rd_elem_mask=jnp.asarray(np.arange(R) < len(p.rd_elems)),
         n_keys=p.n_keys,
         n_vals=p.n_vals,
+        txn_major=txn_major,
+        run_cap=run_cap,
+        complete_monotone=complete_monotone,
     )
 
 
@@ -164,12 +209,40 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # cost).  Two sort keys, not three: a STABLE sort breaks (txn, key)
     # ties in operand order, which is already mop position — and the
     # sorted iota payload IS the permutation.
-    t2, k2, run_sort = jax.lax.sort(
-        (jnp.where(h.mop_mask, h.mop_txn, T),
-         jnp.where(h.mop_mask, h.mop_key, nk),
-         mop_pos),
-        num_keys=2, is_stable=True)
-    inv_run = jnp.zeros(M, jnp.int32).at[run_sort].set(mop_pos)
+    txn_eff = jnp.where(h.mop_mask, h.mop_txn, T)
+    key_eff = jnp.where(h.mop_mask, h.mop_key, nk)
+    if h.txn_major and h.run_cap:
+        # Sort-free: mops are packed txn-major (host-verified static
+        # flag), so the global (txn, key, pos) order decomposes into a
+        # within-txn ranking by (key, pos) over runs of <= run_cap mops.
+        # rank(i) = |{j in txn(i): (key_j, j) < (key_i, i)}| via
+        # 2*(run_cap-1) shifted compares — O(M * run_cap) elementwise
+        # work instead of an O(M log^2 M) device bitonic sort (the top
+        # TPU inference cost at 1M shapes, PROFILE.md §2d).  Exactness:
+        # stability matches lax.sort (earlier pos wins key ties: the
+        # backward compare uses <=, the forward one <), and the padding
+        # tail maps to itself, exactly where the masked sort keys
+        # (T, nk) would stably place it.
+        rank = jnp.zeros(M, jnp.int32)
+        for d in range(1, h.run_cap):
+            same_p = txn_eff[d:] == txn_eff[:-d]
+            zpad = jnp.zeros(d, bool)
+            le_p = key_eff[:-d] <= key_eff[d:]
+            lt_n = key_eff[d:] < key_eff[:-d]
+            rank += jnp.concatenate([zpad, same_p & le_p]).astype(jnp.int32) \
+                + jnp.concatenate([same_p & lt_n, zpad]).astype(jnp.int32)
+        first_mop = jnp.full(T + 1, M, jnp.int32).at[
+            jnp.where(h.mop_mask, mop_txn_c, T)].min(
+            jnp.where(h.mop_mask, mop_pos, M))[:T]
+        inv_run = jnp.where(h.mop_mask, first_mop[mop_txn_c] + rank,
+                            mop_pos)
+        run_sort = jnp.zeros(M, jnp.int32).at[inv_run].set(mop_pos)
+        t2 = txn_eff[run_sort]
+        k2 = key_eff[run_sort]
+    else:
+        t2, k2, run_sort = jax.lax.sort(
+            (txn_eff, key_eff, mop_pos), num_keys=2, is_stable=True)
+        inv_run = jnp.zeros(M, jnp.int32).at[run_sort].set(mop_pos)
     app2 = is_append[run_sort]
     known2 = known_read[run_sort]
     len2 = h.mop_rd_len[run_sort]
@@ -395,7 +468,20 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
 
     # realtime barriers: one per ok txn, ordered by completion
     bslot = jnp.where(h.txn_mask & ok, h.txn_complete_pos, BIG)
-    border = jnp.argsort(bslot)
+    if h.complete_monotone:
+        # complete_pos is strictly increasing over valid txns
+        # (host-verified static flag: TxnPacker emits txns in completion
+        # order), so argsort(bslot) is a stable partition — ok txns keep
+        # index order, everything else follows — an O(T) cumsum+scatter
+        # instead of a T-sized device sort
+        okm = bslot < BIG
+        n_ok_incl = jnp.cumsum(okm.astype(jnp.int32))
+        dest_b = jnp.where(
+            okm, n_ok_incl - 1,
+            n_ok_incl[-1] + jnp.cumsum((~okm).astype(jnp.int32)) - 1)
+        border = jnp.zeros(T, jnp.int32).at[dest_b].set(tidx)
+    else:
+        border = jnp.argsort(bslot)
     b_txn = border.astype(jnp.int32)
     b_mask = bslot[border] < BIG
     barrier_node = (T + tidx).astype(jnp.int32)

@@ -31,7 +31,11 @@ from jepsen_tpu.checkers.elle.device_core import (
     core_check,
     grow_until_exact,
 )
-from jepsen_tpu.checkers.elle.device_infer import PaddedLA, pow2_at_least
+from jepsen_tpu.checkers.elle.device_infer import (
+    PaddedLA,
+    pow2_at_least,
+    run_cap_of,
+)
 from jepsen_tpu.history.soa import TxnPacker
 
 _FILLS = {
@@ -53,10 +57,29 @@ def stage_chunks(chunks: Iterable, workload: str = "list-append"
     """
     pk = TxnPacker(workload)
     dev_chunks: List[dict] = []
+    # verify the sort-free layout facts on the actual host columns as
+    # they stream by (cheap numpy diffs per chunk) instead of asserting
+    # them — a packer-order regression then degrades to the device-sort
+    # fallback rather than corrupting the fast path's permutation scatter
+    layout_ok = True
+    prev_mop_txn = 0  # also rejects negative sentinels in chunk 0
+    prev_cpos = -1
     for ops in chunks:
         cols = pk.feed(ops)
+        mt, cp = cols["mop_txn"], cols["txn_complete_pos"]
+        if len(mt):
+            layout_ok = bool(layout_ok and np.all(np.diff(mt) >= 0)
+                             and mt[0] >= prev_mop_txn)
+            prev_mop_txn = int(mt[-1])
+        if len(cp):
+            layout_ok = bool(layout_ok and np.all(np.diff(cp) > 0)
+                             and cp[0] > prev_cpos)
+            prev_cpos = int(cp[-1])
         dev_chunks.append({k: jax.device_put(v) for k, v in cols.items()
                            if k != "txn_orig_index"})
+
+    # final range bound: every mop_txn must name a real txn
+    layout_ok = bool(layout_ok and prev_mop_txn < max(pk.n_txns, 1))
 
     T = pow2_at_least(max(pk.n_txns, 1))
     M = pow2_at_least(max(pk.n_mops, 1))
@@ -86,6 +109,10 @@ def stage_chunks(chunks: Iterable, workload: str = "list-append"
         rd_elem_mask=jnp.arange(R) < pk.n_rd_elems,
         n_keys=len(pk.key_names),
         n_vals=len(pk.val_names),
+        # layout facts verified on the streamed host columns above
+        txn_major=layout_ok,
+        run_cap=run_cap_of(pk.max_mops_txn) if layout_ok else 0,
+        complete_monotone=layout_ok,
     )
     return h, pk
 

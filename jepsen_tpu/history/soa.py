@@ -125,6 +125,8 @@ class TxnPacker:
         self.pos = 0             # global event position
         self.n_txns = 0
         self.n_mops = 0
+        self.max_mops_txn = 0  # longest single txn seen (layout fact
+        #                        consumed by streamed device staging)
         self.n_rd_elems = 0
 
     def _key_id(self, k) -> int:
@@ -164,6 +166,7 @@ class TxnPacker:
                 mops, known_reads = _mops_of(src), False
             t = self.n_txns
             self.n_txns += 1
+            self.max_mops_txn = max(self.max_mops_txn, len(mops))
             cols["txn_type"].append(ttype)
             cols["txn_process"].append(int(op.process))
             cols["txn_invoke_pos"].append(inv.index if inv is not None

@@ -43,7 +43,15 @@ def stack_padded(hs: Sequence[PaddedLA]) -> PaddedLA:
               "mop_key", "mop_val", "mop_rd_start", "mop_rd_len", "mop_mask",
               "rd_elems", "rd_elem_mask"):
         out[f] = jnp.stack([getattr(h, f) for h in hs])
-    return PaddedLA(n_keys=first.n_keys, n_vals=first.n_vals, **out)
+    # static layout facts must hold for EVERY stacked history (vmap shares
+    # one program): AND the flags, take the widest run bucket
+    return PaddedLA(
+        n_keys=first.n_keys, n_vals=first.n_vals,
+        txn_major=all(h.txn_major for h in hs),
+        run_cap=(max(h.run_cap for h in hs)
+                 if all(h.run_cap for h in hs) else 0),
+        complete_monotone=all(h.complete_monotone for h in hs),
+        **out)
 
 
 def batch_caps(ps: Sequence[PackedTxns]) -> tuple:

@@ -12,9 +12,22 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from jepsen_tpu.utils.backend import force_cpu_backend
+from jepsen_tpu.utils.backend import enable_compile_cache, force_cpu_backend
 
 force_cpu_backend(8)
+
+# Persistent test-scoped XLA compile cache: the suite compiles several
+# hundred CPU executables and the inter-module jit-cache purge below
+# re-compiles shared helpers; pointing jax at an on-disk cache makes both
+# the purge re-compiles and full suite re-runs disk hits instead of XLA
+# invocations (only compiles > 1 s are persisted, so the dir stays small).
+# Disable with JT_NO_TEST_CACHE=1 when chasing a suspected stale-cache bug.
+if not os.environ.get("JT_NO_TEST_CACHE"):
+    os.environ.setdefault(
+        "BENCH_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache_tests"))
+    enable_compile_cache()
 
 import pytest
 

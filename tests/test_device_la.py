@@ -269,3 +269,81 @@ def test_device_finds_nonadjacent_oracle_budget_misses():
 
     assert set(r_o["anomaly-types"]) - NONADJACENT_FAMILY == \
         set(r_d["anomaly-types"]) - NONADJACENT_FAMILY
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_sort_free_run_order_matches_lax_sort(seed):
+    """The layout-aware inference paths (sort-free run order via
+    within-txn shifted-compare ranking; barrier order via stable
+    partition) must be bit-identical to the lax.sort paths they replace.
+    Seeds cover valid, fail/info-bearing, and anomaly-injected histories.
+    """
+    import dataclasses
+
+    import jax
+
+    from jepsen_tpu.checkers.elle.device_infer import infer, pad_packed
+    from jepsen_tpu.history.soa import pack_txns
+
+    h = synth.la_history(n_txns=160, n_keys=5, concurrency=6,
+                         fail_prob=0.08, info_prob=0.08,
+                         multi_append_prob=0.25, max_mops=6, seed=seed)
+    if seed % 3 == 1:
+        synth.inject_g1a(h)
+    elif seed % 3 == 2:
+        synth.inject_wr_cycle(h)
+    p = pack_txns(h)
+    hp = pad_packed(p)
+    assert hp.txn_major and hp.run_cap and hp.complete_monotone
+    off = dataclasses.replace(hp, txn_major=False, run_cap=0,
+                              complete_monotone=False)
+    fast = infer(hp, p.n_keys)
+    slow = infer(off, p.n_keys)
+    for a, b in zip(jax.tree_util.tree_leaves(fast),
+                    jax.tree_util.tree_leaves(slow)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_layout_facts_reject_non_txn_major():
+    """Hand-built packings that violate the txn-major layout must fall
+    back to the sort path (flags off) and still check correctly."""
+    from jepsen_tpu.checkers.elle.device_infer import infer, pad_packed
+    from jepsen_tpu.history.soa import pack_txns
+
+    h = synth.la_history(n_txns=60, n_keys=4, concurrency=4, seed=3)
+    synth.inject_g1a(h)  # a nonzero count the fallback must reproduce
+    p = pack_txns(h)
+    ref = infer(pad_packed(p), p.n_keys)
+
+    # Equivalent packing with txn mop-blocks in REVERSE txn order:
+    # within-txn mop order is preserved (stable argsort) and the
+    # read-element extents are rebuilt to match the new mop order, so
+    # the packing means the same history but violates txn-major layout.
+    order = np.argsort(-p.mop_txn, kind="stable")
+    for f in ("mop_txn", "mop_kind", "mop_key", "mop_val",
+              "mop_rd_start", "mop_rd_len"):
+        setattr(p, f, getattr(p, f)[order])
+    elems, new_starts, cur = [], np.full(p.n_mops, -1, np.int32), 0
+    for i in range(p.n_mops):
+        s, ln = p.mop_rd_start[i], p.mop_rd_len[i]
+        if s >= 0:
+            new_starts[i] = cur
+            elems.extend(p.rd_elems[s:s + max(ln, 0)])
+            cur += max(ln, 0)
+    p.mop_rd_start, p.rd_elems = new_starts, np.asarray(elems, np.int32)
+    hp = pad_packed(p)
+    assert not hp.txn_major
+    # the device-sort fallback still checks the reordered packing, and
+    # the anomaly counts match the txn-major packing's exactly
+    scr = infer(hp, p.n_keys)
+    for name, v in ref["counts"].items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(scr["counts"][name]),
+                                      err_msg=name)
+    assert int(np.asarray(ref["counts"]["G1a"])) > 0
+
+    # negative sentinel rows must disable the fast path, not crash
+    p.mop_txn = np.sort(p.mop_txn)
+    p.mop_txn[0] = -1
+    hp2 = pad_packed(p)
+    assert not hp2.txn_major
