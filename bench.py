@@ -23,7 +23,7 @@ failure the bench falls back to the CPU backend (recorded in the
 Env knobs: BENCH_TXNS (single fixed size, disables the ladder),
 BENCH_SIZES (comma-separated ladder, default "100000,1000000"),
 BENCH_KEYS, BENCH_REPEATS, BENCH_FORCE_CPU=1, BENCH_INIT_TIMEOUT (s,
-default 120), BENCH_TPU_RETRY_S (keep re-probing a down TPU tunnel for
+default 180), BENCH_TPU_RETRY_S (keep re-probing a down TPU tunnel for
 this long before the CPU fallback, default 450), BENCH_DEADLINE (s,
 default 1500), BENCH_CACHE_DIR (persistent XLA compilation cache,
 default <repo>/.jax_cache).
@@ -80,8 +80,10 @@ def _init_backend():
 
         return jax.devices()[0].platform, None
 
-    probe_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 120))
-    # default window: ~3 probes when each hangs the full 120 s, while
+    # cold axon dials have measured ~140 s (2026-07-31); 120 s misreads
+    # a slow-but-live tunnel as down
+    probe_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 180))
+    # default window: ~2-3 probes when each hangs the full 180 s, while
     # leaving most of the default 1500 s deadline for the CPU fallback
     retry_window = float(os.environ.get("BENCH_TPU_RETRY_S", 450))
     t_start = time.monotonic()

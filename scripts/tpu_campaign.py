@@ -17,7 +17,8 @@ exactly the shapes the driver's bench.py will request):
   la_10m   scripts/tpu_10m.py           (config 4, cold+steady+HBM)
 
 Usage: nohup python scripts/tpu_campaign.py >> scripts/tpu_campaign.log 2>&1 &
-Env: CAMPAIGN_PROBE_EVERY_S (default 240), CAMPAIGN_MAX_ATTEMPTS (3).
+Env: CAMPAIGN_PROBE_EVERY_S (default 240), CAMPAIGN_MAX_ATTEMPTS (3),
+CAMPAIGN_PROBE_TIMEOUT_S (default 300 — cold dials measured ~140 s).
 """
 
 import json
@@ -30,6 +31,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "scripts", "tpu_campaign.jsonl")
 PROBE_EVERY = float(os.environ.get("CAMPAIGN_PROBE_EVERY_S", 240))
 MAX_ATTEMPTS = int(os.environ.get("CAMPAIGN_MAX_ATTEMPTS", 3))
+# The axon tunnel can take >2 min just to dial on a cold backend init
+# (measured 140 s on 2026-07-31); a 120 s probe misreads that as down.
+PROBE_TIMEOUT = float(os.environ.get("CAMPAIGN_PROBE_TIMEOUT_S", 300))
 
 STAGES = [
     # (name, argv, extra_env, deadline_s)
@@ -54,7 +58,7 @@ def record(rec):
         os.fsync(f.fileno())
 
 
-def probe(timeout_s=120.0) -> str:
+def probe(timeout_s=PROBE_TIMEOUT) -> str:
     """'' when the default backend is a live TPU, else an error string."""
     try:
         r = subprocess.run(

@@ -81,44 +81,73 @@ def test_sqlite_busy_completes_as_fail(tmp_path):
 def test_sqlite_checker_catches_injected_corruption(tmp_path):
     """Bypass the client and corrupt the la table mid-run (duplicate an
     element): the append checker must flag the history invalid — the
-    negative control proving the suite's checker has teeth."""
-    t = sq.append_test(_opts(tmp_path))
-    db_path = None
+    negative control proving the suite's checker has teeth.
 
+    The injection races the live workload: it triggers on the first
+    ok-append COMPLETION, and completion order is arbitrary under real
+    concurrency — that completion can land after every other op in the
+    run (seen in practice: the trigger append's completion delayed past
+    11 later appends), leaving no subsequent read to observe the
+    duplicate, in which case a valid verdict is CORRECT.  So the test
+    asserts on the real precondition — some ok read actually CONTAINS
+    the duplicated element — and reruns the (inherently racy) workload
+    until the duplicate was observable; only then is the verdict
+    checked."""
     orig_open = sq.SqliteClient.open
-    state = {"done": False}
-
-    def patched_open(self, test, node):
-        nonlocal db_path
-        c = orig_open(self, test, node)
-        db_path = c._path
-        return c
-
     orig_invoke = sq.SqliteClient.invoke
 
-    def patched_invoke(self, test, op):
-        out = orig_invoke(self, test, op)
-        # after the first successful append, duplicate that element
-        if not state["done"] and out["type"] == "ok":
-            apps = [m for m in out["value"] if m[0] == "append"]
-            if apps:
-                state["done"] = True
-                k, v = apps[0][1], apps[0][2]
-                dup = sqlite3.connect(db_path)
-                dup.execute(
-                    "INSERT INTO la (k, pos, v) VALUES (?, 1 + "
-                    "(SELECT MAX(pos) FROM la WHERE k=?), ?)", (k, k, v))
-                dup.commit()
-                dup.close()
-        return out
+    for attempt in range(4):
+        t = sq.append_test(_opts(tmp_path / f"a{attempt}"))
+        db_path = None
+        state = {"done": False, "k": None}
 
-    sq.SqliteClient.open = patched_open
-    sq.SqliteClient.invoke = patched_invoke
-    try:
-        done = _run(t, 80)
-    finally:
-        sq.SqliteClient.open = orig_open
-        sq.SqliteClient.invoke = orig_invoke
-    assert state["done"], "corruption was never injected"
+        def patched_open(self, test, node):
+            nonlocal db_path
+            c = orig_open(self, test, node)
+            db_path = c._path
+            return c
+
+        def patched_invoke(self, test, op):
+            # once corrupted, force later txns to read the corrupted key
+            # so observation doesn't depend on the workload's random keys
+            if state["done"] and op.get("f") == "txn":
+                op = dict(op, value=list(op["value"]) +
+                          [["r", state["k"], None]])
+            out = orig_invoke(self, test, op)
+            # after the first successful append, duplicate that element
+            if not state["done"] and out["type"] == "ok":
+                apps = [m for m in out["value"] if m[0] == "append"]
+                if apps:
+                    state["done"] = True
+                    state["k"] = apps[0][1]
+                    k, v = apps[0][1], apps[0][2]
+                    dup = sqlite3.connect(db_path)
+                    dup.execute(
+                        "INSERT INTO la (k, pos, v) VALUES (?, 1 + "
+                        "(SELECT MAX(pos) FROM la WHERE k=?), ?)", (k, k, v))
+                    dup.commit()
+                    dup.close()
+            return out
+
+        sq.SqliteClient.open = patched_open
+        sq.SqliteClient.invoke = patched_invoke
+        try:
+            done = _run(t, 80)
+        finally:
+            sq.SqliteClient.open = orig_open
+            sq.SqliteClient.invoke = orig_invoke
+        assert state["done"], "corruption was never injected"
+        dup_observed = any(
+            m[0] == "r" and m[1] == state["k"] and
+            isinstance(m[2], list) and len(set(m[2])) < len(m[2])
+            for op in done["history"]
+            if op.type == "ok" and op.f == "txn"
+            for m in op.value)
+        if dup_observed:
+            break
+    else:
+        raise AssertionError(
+            "duplicate never observable in 4 runs (trigger completion "
+            "kept landing after the last read)")
     res = done["results"]
     assert res["valid?"] is not True, res
