@@ -285,10 +285,18 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # which is the containing one) — a scatter + cummax forward fill, an
     # O(R) replacement for the former O(R log nk) searchsorted
     slot = jnp.arange(R, dtype=jnp.int32)
-    key_ids = jnp.arange(nk, dtype=jnp.int32)
-    sk_seed = jnp.full(R + 1, -1, jnp.int32).at[
-        jnp.clip(ord_start, 0, R)].max(key_ids)[:R]
-    slot_key = jnp.clip(jax.lax.cummax(sk_seed), 0, nk - 1)
+    if nk == 1:
+        # single key: every slot is key 0.  Also dodges a real compile
+        # cost: with nk == 1 the scatter seed below is compile-time
+        # constant (ord_start == [0], key_ids == [0]) and XLA:CPU
+        # constant-folds the cummax's R-sized reduce-window tree
+        # interpretively — measured 1-18 s of compile per shape.
+        slot_key = jnp.zeros(R, jnp.int32)
+    else:
+        key_ids = jnp.arange(nk, dtype=jnp.int32)
+        sk_seed = jnp.full(R + 1, -1, jnp.int32).at[
+            jnp.clip(ord_start, 0, R)].max(key_ids)[:R]
+        slot_key = jnp.clip(jax.lax.cummax(sk_seed), 0, nk - 1)
     slot_off = slot - ord_start[slot_key]
     slot_valid = slot < total_ord
     src_read = ord_read[slot_key]
