@@ -1,0 +1,144 @@
+"""Generative tests for the session-guarantee checker.
+
+Reference pattern: elle's test.check generative suites (SURVEY.md §4) —
+random histories from a model that satisfies the property by
+construction, plus targeted injections that violate exactly one
+guarantee, asserting the checker flags precisely that.
+
+The simulator is a single-copy per-key register store: every write txn
+reads the current version then writes a fresh one, so the inferred
+version DAG is a chain per key and session reads of the live store are
+trivially monotone.  Injections rewrite READS in read-only txns only, so
+the inferred version DAG (built from read->write chains inside write
+txns) is untouched and the violation is unambiguous.
+"""
+
+import random
+
+from jepsen_tpu.checkers.elle import sessions
+from jepsen_tpu.history import history, invoke, ok
+
+
+def _simulate(seed, n_procs=4, n_keys=3, n_txns=60):
+    """Returns a mutable txn list [(proc, mops)] where every session's
+    reads are monotone by construction."""
+    rng = random.Random(seed)
+    cur = {k: None for k in range(n_keys)}  # live version per key
+    next_v = [0]
+    txns = []
+    for _ in range(n_txns):
+        proc = rng.randrange(n_procs)
+        if rng.random() < 0.5:
+            # write txn: read current, install successor (chains the DAG)
+            k = rng.randrange(n_keys)
+            v = next_v[0]
+            next_v[0] += 1
+            txns.append((proc, [["r", k, cur[k]], ["w", k, v]]))
+            cur[k] = v
+        else:
+            # read-only txn over 1-2 keys at the live versions
+            ks = rng.sample(range(n_keys), rng.choice([1, 2]))
+            txns.append((proc, [["r", k, cur[k]] for k in ks]))
+    return txns
+
+
+def _to_history(txns):
+    ops = []
+    for proc, mops in txns:
+        ops.append(invoke(proc, "txn", [list(m) for m in mops]))
+        ops.append(ok(proc, "txn", [list(m) for m in mops]))
+    return history(ops)
+
+
+def _read_only_reads(txns, proc):
+    """(txn_pos, mop_pos, key, version) for reads in read-only txns."""
+    out = []
+    for i, (p, mops) in enumerate(txns):
+        if p != proc or any(m[0] == "w" for m in mops):
+            continue
+        for j, m in enumerate(mops):
+            if m[0] == "r":
+                out.append((i, j, m[1], m[2]))
+    return out
+
+
+def test_valid_sessions_fuzz():
+    for seed in range(25):
+        res = sessions.check(_to_history(_simulate(seed)))
+        assert res["valid?"] is True, (seed, res)
+
+
+def test_monotonic_reads_injection_fuzz():
+    injected = 0
+    for seed in range(60):
+        txns = _simulate(seed)
+        # find a session with two read-only reads of the same key at
+        # different written versions and swap them -> the later read
+        # goes backwards in the (chain) version order
+        done = False
+        for proc in range(4):
+            reads = _read_only_reads(txns, proc)
+            for a in range(len(reads)):
+                for b in range(a + 1, len(reads)):
+                    ia, ja, ka, va = reads[a]
+                    ib, jb, kb, vb = reads[b]
+                    if ka == kb and va != vb and va is not None:
+                        txns[ia][1][ja][2] = vb
+                        txns[ib][1][jb][2] = va
+                        done = True
+                        break
+                if done:
+                    break
+            if done:
+                break
+        if not done:
+            continue
+        injected += 1
+        res = sessions.check(_to_history(txns))
+        assert res["valid?"] is False, (seed, res)
+        assert "monotonic-reads-violation" in res["anomaly-types"], \
+            (seed, res)
+        assert "monotonic-reads" in res["not"] + res["also-not"], res
+    assert injected >= 30, f"only {injected} injectable cases"
+
+
+def test_read_your_writes_injection_fuzz():
+    injected = 0
+    for seed in range(60):
+        txns = _simulate(seed)
+        # find a session write txn [r k prior, w k v] followed by a
+        # read-only read of k in the same session; rewrite that read to
+        # `prior` (a strict ancestor of v)
+        done = False
+        for proc in range(4):
+            writes = []  # (txn_pos, key, prior_version)
+            for i, (p, mops) in enumerate(txns):
+                if p != proc:
+                    continue
+                for j in range(len(mops) - 1):
+                    if mops[j][0] == "r" and mops[j + 1][0] == "w" and \
+                            mops[j][1] == mops[j + 1][1] and \
+                            mops[j][2] is not None:
+                        writes.append((i, mops[j][1], mops[j][2]))
+            for i, (p, mops) in enumerate(txns):
+                if done or p != proc or any(m[0] == "w" for m in mops):
+                    continue
+                for wpos, wk, prior in writes:
+                    if wpos < i:
+                        for j, m in enumerate(mops):
+                            if m[0] == "r" and m[1] == wk:
+                                txns[i][1][j][2] = prior
+                                done = True
+                                break
+                    if done:
+                        break
+            if done:
+                break
+        if not done:
+            continue
+        injected += 1
+        res = sessions.check(_to_history(txns))
+        assert res["valid?"] is False, (seed, res)
+        assert "read-your-writes-violation" in res["anomaly-types"], \
+            (seed, res)
+    assert injected >= 30, f"only {injected} injectable cases"
