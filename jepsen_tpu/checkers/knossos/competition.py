@@ -5,75 +5,172 @@ Equivalent of `knossos/competition.clj` (SURVEY.md §2.4), which races
 answer.  Here three contestants exist: JIT-linear (`linear.py`), host WGL
 (`wgl.py`, C++-accelerated via `jepsen_tpu.native`), and the TPU batched
 frontier search (`device_wgl.py`).  Small histories race linear vs wgl on
-threads (losers aborted via `search.Search`); large ones go to the
-device first, with the host as fallback for "unknown".
+threads (losers aborted via `search.Search`), falling back to the device
+on "unknown"; large histories race all THREE legs concurrently — first
+definitive verdict wins, losers are aborted.
 """
 
 from __future__ import annotations
 
-import concurrent.futures as _fut
+import inspect
 import logging
+import queue as _queue
+import threading
 from typing import Any, Dict
 
 logger = logging.getLogger("jepsen.knossos")
 
 from jepsen_tpu.checkers.knossos import device_wgl, linear, wgl
 from jepsen_tpu.checkers.knossos.prep import prepare
-from jepsen_tpu.checkers.knossos.search import Search
+from jepsen_tpu.checkers.knossos.search import ChildSearch
 from jepsen_tpu.history.ops import History
 from jepsen_tpu.models import Model
 
 HOST_FIRST_MAX_OPS = 256
 
 
-def _race_host(ops, model, **kw) -> Dict[str, Any]:
-    """linear vs wgl on two threads; first definitive answer wins and the
-    loser is aborted (reference competition semantics).  The executor is
-    shut down without waiting — the loser notices `ctl` and exits."""
-    ctl = Search()
-    ex = _fut.ThreadPoolExecutor(max_workers=2)
-    futs = {
-        ex.submit(linear.check, list(ops), model, ctl=ctl, **kw): "linear",
-        ex.submit(wgl.check, list(ops), model, ctl=ctl, **kw): "wgl",
-    }
+def _race(contestants, ops, model, ctl, **kw) -> Dict[str, Any]:
+    """Race checkers on threads; first definitive answer wins and the
+    losers are aborted via the shared `ctl` (reference competition
+    semantics).  Threads are NON-daemon — a daemon straggler killed at
+    interpreter exit inside native XLA code SIGABRTs ("FATAL: exception
+    not rethrown") — so every leg must stay cancellable: with a ctl the
+    device leg always takes the pollable blocked search, never the
+    unabortable single-jit while_loop.  The wait loop polls `ctl` so an
+    expired deadline ends the race even while every leg is mid-flight.
+    """
+    q: _queue.Queue = _queue.Queue()
+
+    def run(name, fn):
+        try:
+            # per-leg kwarg filter: the legs' signatures differ (e.g.
+            # max_frontier is device-only) and a TypeError here would
+            # silently kill a leg instead of racing it
+            params = inspect.signature(fn).parameters
+            leg_kw = {k: v for k, v in kw.items() if k in params}
+            q.put((name, fn(list(ops), model, ctl=ctl, **leg_kw), None))
+        except Exception as e:  # noqa: BLE001 — let the others finish
+            logger.warning("%s contestant crashed", name, exc_info=True)
+            q.put((name, None, e))
+
     fallback: Dict[str, Any] = {"valid?": "unknown"}
+    pending = 0
     try:
-        for fut in _fut.as_completed(futs):
+        # starts inside the try: if the Nth start raises (thread
+        # pressure), the finally still aborts the already-running legs
+        for name, fn in contestants:
+            threading.Thread(target=run, args=(name, fn),
+                             name=f"knossos-race-{name}").start()
+            pending += 1
+        while pending:
             try:
-                res = fut.result()
-            except Exception:  # noqa: BLE001 — let the other finish
-                logger.warning("%s contestant crashed", futs[fut],
-                               exc_info=True)
-                fallback = {"valid?": "unknown",
-                            "error": f"{futs[fut]} crashed"}
+                name, res, err = q.get(timeout=0.25)
+            except _queue.Empty:
+                if ctl.aborted():  # deadline fired / caller cancelled
+                    # drain: a leg may have enqueued a definitive
+                    # verdict in the poll window — don't discard it
+                    try:
+                        while True:
+                            name, res, err = q.get_nowait()
+                            if err is None and \
+                                    res.get("valid?") != "unknown":
+                                res.setdefault("algorithm", name)
+                                return res
+                    except _queue.Empty:
+                        pass
+                    return dict(fallback, reason="aborted")
+                continue
+            pending -= 1
+            if err is not None:
+                fallback = {"valid?": "unknown", "error": f"{name} crashed"}
                 continue
             if res.get("valid?") != "unknown":
+                res.setdefault("algorithm", name)
                 return res
             fallback = res
         return fallback
     finally:
         ctl.abort()
-        ex.shutdown(wait=False)
+
+
+HOST_LEGS = (("linear", linear.check), ("wgl", wgl.check))
+
+
+def _polled(root, fn):
+    """Run `fn` with a background poller driving `root.aborted()`.
+
+    Deadline/parent-abort propagation is poll-driven (see
+    `search.ChildSearch`), and the native C++ DFS only watches
+    `root.flag` — on the direct-algorithm paths nothing else polls, so
+    without this a `deadline_s` (or a caller ctl abort) would never
+    reach a flag-only leg.  The poller is a daemon thread but touches
+    no native code, so interpreter exit cannot SIGABRT inside it."""
+    if root is None:
+        return fn()
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            if root.aborted():
+                return
+            stop.wait(0.25)
+
+    threading.Thread(target=poll, daemon=True,
+                     name="knossos-deadline-poll").start()
+    try:
+        return fn()
+    finally:
+        stop.set()
 
 
 def analysis(history: History, model: Model,
-             algorithm: str = "auto", **kw) -> Dict[str, Any]:
+             algorithm: str = "auto", deadline_s=None,
+             **kw) -> Dict[str, Any]:
     """Linearizability analysis.
-    algorithm: auto | wgl | linear | device | competition."""
+    algorithm: auto | wgl | linear | device | competition.
+
+    auto: small histories race linear vs wgl (cheap memoization, host
+    DFS usually instant), then try the device on "unknown"; large ones
+    race all THREE legs concurrently — the device frontier BFS is the
+    expected winner at scale, but crash-heavy (`info`-dense) histories
+    can blow up any single leg, and sequential device-first stalls the
+    analysis for exactly the histories where the host DFS would answer
+    (measured: a 1300-op 185-info history held the device leg >25 min
+    while racing legs bound it).  `deadline_s` bounds the WHOLE
+    analysis (race + fallback), anchored here; a caller-supplied `ctl`
+    is never aborted by the race — losers are cancelled through linked
+    child ctls (`search.ChildSearch`), so one ctl can bound a whole
+    campaign of analyses.  Remaining `**kw` (e.g. max_configs) is
+    forwarded to EVERY leg, device included: an explicit budget bounds
+    the whole analysis, not just the host algorithms.
+    """
     ops = prepare(history)
+    parent = kw.pop("ctl", None)
+    # one root per analysis: carries this call's deadline (absolute from
+    # here) and observes the caller's ctl; everything below aborts
+    # through children of it, so neither root nor parent gets poisoned.
+    # No parent and no deadline -> no root at all: a ctl-less device
+    # check keeps its single-jit fast path, and there is nothing to
+    # poll anyway.
+    # `is not None`, not truthiness: deadline_s=0 means "already
+    # expired, abort promptly", the opposite of unbounded
+    root = (ChildSearch(parent, deadline_s=deadline_s)
+            if parent is not None or deadline_s is not None else None)
     if algorithm == "wgl":
-        return wgl.check(ops, model, **kw)
+        return _polled(root, lambda: wgl.check(ops, model, ctl=root, **kw))
     if algorithm == "linear":
-        return linear.check(ops, model, **kw)
+        return _polled(root,
+                       lambda: linear.check(ops, model, ctl=root, **kw))
     if algorithm == "device":
-        return device_wgl.check(ops, model, **kw)
+        return _polled(root,
+                       lambda: device_wgl.check(ops, model, ctl=root, **kw))
     if len(ops) <= HOST_FIRST_MAX_OPS:
-        res = _race_host(ops, model, **kw)
+        res = _race(HOST_LEGS, ops, model, ChildSearch(root), **kw)
         if res["valid?"] != "unknown":
             return res
-        dres = device_wgl.check(ops, model)
+        dres = device_wgl.check(
+            ops, model, ctl=ChildSearch(root) if root is not None else None,
+            **kw)
         return dres if dres["valid?"] != "unknown" else res
-    res = device_wgl.check(ops, model)
-    if res["valid?"] != "unknown":
-        return res
-    return _race_host(ops, model, **kw)
+    return _race(HOST_LEGS + (("device", device_wgl.check),),
+                 ops, model, ChildSearch(root), **kw)

@@ -207,14 +207,24 @@ def _setup(ops: Sequence[LinOp], memo: Memo):
 
 def check(ops: Sequence[LinOp], model: Model,
           max_frontier: int = 16384,
-          max_configs: int = 20_000_000) -> Dict[str, Any]:
-    """Device linearizability check of prepared ops against a model."""
+          max_configs: int = 20_000_000, ctl=None) -> Dict[str, Any]:
+    """Device linearizability check of prepared ops against a model.
+
+    `ctl` (a `search.Search`) aborts the blocked search between waves,
+    between blocks, and inside the dominance-prune row loop — a
+    competition can cancel this leg, and a deadline bounds it.  Passing
+    a ctl also forces the blocked search for small histories: the
+    single-jit path is one unabortable `lax.while_loop`, fine standalone
+    but not as a cancellable race leg."""
     n = len(ops)
     if n == 0:
         return {"valid?": "unknown", "op-count": 0}
     if n > MAX_DEVICE_OPS:
         return {"valid?": "unknown", "op-count": n,
                 "reason": "too many ops for device WGL"}
+    if ctl is not None and ctl.aborted():
+        # an expired/cancelled ctl skips the memoize/setup/transfer cost
+        return {"valid?": "unknown", "op-count": n, "reason": "aborted"}
     try:
         memo = memoize(model, ops)
     except StateExplosion:
@@ -227,7 +237,11 @@ def check(ops: Sequence[LinOp], model: Model,
     # frontier occupancy — past ~1k ops a serial history pays thousands
     # of full-width waves and the blocked search (blocks sized to the
     # live frontier) is strictly faster as well as memory-spilled.
-    if n <= 1024:
+    # With a ctl we go blocked regardless of size: the single-jit path
+    # is one unabortable `lax.while_loop`, and a competition loser must
+    # stay cancellable (non-daemon racer threads join at process exit —
+    # daemon threads SIGABRT inside native XLA teardown).
+    if n <= 1024 and ctl is None:
         lin, exhausted, overflow = _frontier_search(
             n_pad, W, max_frontier, n + 1,
             jnp.asarray(invokes), jnp.asarray(returns),
@@ -241,15 +255,18 @@ def check(ops: Sequence[LinOp], model: Model,
 
     return _blocked_search(n, n_pad, W, invokes, returns, op_sym, must,
                            table, memo.init_state, z1, z2,
-                           max_frontier, max_configs)
+                           max_frontier, max_configs, ctl)
 
 
 def _blocked_and_check(ops: Sequence[LinOp], model: Model,
                        max_frontier: int = 16384,
-                       max_configs: int = 20_000_000) -> Dict[str, Any]:
+                       max_configs: int = 20_000_000,
+                       ctl=None) -> Dict[str, Any]:
     """Route straight to the blocked (host-spill) search — used by tests
     and by callers that know the frontier will overflow."""
     n = len(ops)
+    if ctl is not None and ctl.aborted():
+        return {"valid?": "unknown", "op-count": n, "reason": "aborted"}
     try:
         memo = memoize(model, ops)
     except StateExplosion:
@@ -258,7 +275,7 @@ def _blocked_and_check(ops: Sequence[LinOp], model: Model,
     n_pad, W, invokes, returns, op_sym, must, z1, z2 = _setup(ops, memo)
     return _blocked_search(n, n_pad, W, invokes, returns, op_sym, must,
                            memo.table, memo.init_state, z1, z2,
-                           max_frontier, max_configs)
+                           max_frontier, max_configs, ctl)
 
 
 # ---------------------------------------------------------------------------
@@ -338,9 +355,13 @@ def _expand_block(A: int, W: int, F: int, C: int,
     return out_states, out_bits, out_h1, out_h2, out_valid, n_unique
 
 
+class _Aborted(Exception):
+    """Raised inside long per-row host loops when `ctl` aborts mid-wave."""
+
+
 def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
-                    init_state, z1, z2, max_frontier, max_configs
-                    ) -> Dict[str, Any]:
+                    init_state, z1, z2, max_frontier, max_configs,
+                    ctl=None) -> Dict[str, Any]:
     """Breadth-first over waves; frontier spilled to host as block lists.
 
     Every wave holds configs with the same linearized-count, so the
@@ -373,11 +394,18 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
         """Drop configs whose crashed-lin set is a strict superset of a
         previously kept one at the same (state, returned-lin).  Keeps
         (and records) the survivors.  The store holds a python LIST of
-        minimal-X rows per key (append is O(1); antichains stay small)."""
+        minimal-X rows per key (append is O(1); antichains stay small).
+
+        Polls `ctl` every 1024 rows: this per-row python loop is the
+        longest uninterruptible stretch in a crash-heavy wave (minutes
+        at 100k-row frontiers), and an aborted competition loser must
+        not keep burning the core until the wave ends."""
         R = b & must_row
         X = b & info_mask[None, :]
         keep_rows = np.ones(len(s), bool)
         for i in range(len(s)):
+            if ctl is not None and i % 1024 == 1023 and ctl.aborted():
+                raise _Aborted
             key = s[i].tobytes() + R[i].tobytes()
             stored = dom.get(key)
             xi = X[i]
@@ -461,8 +489,12 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
         return {"valid?": True, "op-count": n, "hash_dedup": True,
                 "blocked": True}
 
+    aborted = {"valid?": "unknown", "op-count": n, "reason": "aborted",
+               "hash_dedup": True, "blocked": True}
     total_seen = 0
     for k in range(n + 1):
+        if ctl is not None and ctl.aborted():
+            return dict(aborted, explored=total_seen)
         # collect every block's (block-deduped) children, then do ONE
         # vectorized cross-block dedup + success check for the wave.
         # Configs in different waves have different popcounts, so no
@@ -511,6 +543,8 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
                    jnp.asarray(word_idx_h[act_pad]),
                    jnp.asarray(bit_h[act_pad]))
         while work:
+            if ctl is not None and ctl.aborted():
+                return dict(aborted, explored=total_seen)
             st, bi, a1, a2, va = work.pop()
             F = len(st)
             C = cap_of(F, A)
@@ -541,6 +575,8 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
         if not ch_s or not sum(len(x) for x in ch_s):
             return {"valid?": False, "op-count": n, "hash_dedup": True,
                     "blocked": True}
+        if ctl is not None and ctl.aborted():
+            return dict(aborted, explored=total_seen)
         s = np.concatenate(ch_s)
         b = np.concatenate(ch_b)
         h1_all = np.concatenate(ch_h1)
@@ -561,7 +597,10 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
             return {"valid?": True, "op-count": n, "hash_dedup": True,
                     "blocked": True}
         if use_dominance:
-            s, b, h1u, h2u = dominance_prune(s, b, h1u, h2u)
+            try:
+                s, b, h1u, h2u = dominance_prune(s, b, h1u, h2u)
+            except _Aborted:
+                return dict(aborted, explored=total_seen)
             if not len(s):
                 return {"valid?": False, "op-count": n,
                         "hash_dedup": True, "blocked": True}

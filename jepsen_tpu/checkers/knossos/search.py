@@ -25,8 +25,10 @@ class Search:
     def __init__(self, *, deadline_s: Optional[float] = None):
         self._abort = threading.Event()
         self.flag = np.zeros(1, dtype=np.int32)
+        # `is not None`: deadline_s=0 means already expired, not "no
+        # deadline"
         self.deadline = (time.monotonic() + deadline_s
-                         if deadline_s else None)
+                         if deadline_s is not None else None)
         self.explored = 0
         self.result: Optional[dict] = None
 
@@ -45,3 +47,45 @@ class Search:
     def report(self, result: dict) -> dict:
         self.result = result
         return result
+
+
+class ChildSearch(Search):
+    """A Search linked to a parent: aborting the child never touches the
+    parent (so a competition can abort its losers while the caller's ctl
+    stays reusable), while a parent abort — or the parent's deadline —
+    propagates to the child at the child's next `aborted()` poll.  The
+    child inherits the parent's deadline implicitly through that poll;
+    its own `deadline_s` (if any) is additional.  Note the propagation
+    is poll-driven: a leg that only watches the shared `flag` memory
+    (the native C++ DFS) sees a parent abort once any python-side
+    participant polls this child."""
+
+    def __init__(self, parent: Optional[Search] = None, *,
+                 deadline_s: Optional[float] = None):
+        super().__init__(deadline_s=deadline_s)
+        self._parent = parent
+
+    def aborted(self) -> bool:
+        p = self._parent
+        if p is not None and p.aborted():
+            self.abort()
+        return super().aborted()
+
+    # `explored` forwards up the chain so a campaign polling ITS handle
+    # still sees progress when the work runs under a derived child (the
+    # base-class ctor's `explored = 0` lands in the local slot — the
+    # parent is not attached yet — so attaching never resets the
+    # parent's count).
+    @property
+    def explored(self) -> int:
+        p = getattr(self, "_parent", None)
+        return p.explored if p is not None else \
+            getattr(self, "_explored_local", 0)
+
+    @explored.setter
+    def explored(self, v: int) -> None:
+        p = getattr(self, "_parent", None)
+        if p is not None:
+            p.explored = v
+        else:
+            self._explored_local = v

@@ -100,8 +100,11 @@ def _final_info(ops, seen, memo):
 
 
 def _search_direct(ops: Sequence[LinOp], model: Model,
-                   max_configs: int = 1_000_000):
-    """Unmemoized DFS for models whose state space explodes."""
+                   max_configs: int = 1_000_000, ctl=None):
+    """Unmemoized DFS for models whose state space explodes.  Polls
+    `ctl` every 4096 configs so a competition/deadline can abort this
+    leg too (it is a race contestant via `check`'s StateExplosion
+    fallback, and non-daemon racer threads must stay cancellable)."""
     n = len(ops)
     must = 0
     for i, op in enumerate(ops):
@@ -141,6 +144,8 @@ def _search_direct(ops: Sequence[LinOp], model: Model,
         explored += 1
         if explored > max_configs:
             return None, {"reason": "config budget exhausted"}
+        if ctl is not None and explored % 4096 == 0 and ctl.aborted():
+            return None, {"reason": "aborted"}
         stack.append((S2, m2, candidates(S2), 0))
     return False, {"op-count": n}
 
@@ -193,7 +198,7 @@ def check(history: History | Sequence[LinOp], model: Model,
         if ok is NotImplemented:
             ok, info = _search_memo(ops, memo, max_configs, ctl)
     except StateExplosion:
-        ok, info = _search_direct(ops, model, max_configs)
+        ok, info = _search_direct(ops, model, max_configs, ctl)
     if ok is None:
         return {"valid?": "unknown", **(info or {})}
     out: Dict[str, Any] = {"valid?": bool(ok), "op-count": len(ops)}
