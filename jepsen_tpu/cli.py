@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import re
 import sys
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -54,6 +55,11 @@ def base_parser(prog: str = "jepsen-tpu") -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog=prog)
     p.add_argument("--store-dir", default=store.BASE,
                    help="store directory (default ./store)")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU jax backend (skip TPU dial; also "
+                        "honored via JT_FORCE_CPU=1). On a machine whose "
+                        "TPU tunnel is down, backend init HANGS rather "
+                        "than raising — this flag is the way out.")
     return p
 
 
@@ -236,6 +242,14 @@ def run(parser_dispatch, argv: Optional[Sequence[str]] = None) -> int:
     """-main scaffold: parse, set up logging, dispatch, exit code."""
     p, dispatch = parser_dispatch
     opts = p.parse_args(argv)
+    env_cpu = os.environ.get("JT_FORCE_CPU", "").strip().lower()
+    if getattr(opts, "cpu", False) or env_cpu not in ("", "0", "false",
+                                                      "no"):
+        # must happen before the first jax backend init (checkers);
+        # see utils.backend for why JAX_PLATFORMS=cpu alone is not enough
+        from jepsen_tpu.utils.backend import force_cpu_backend
+
+        force_cpu_backend()
     if getattr(opts, "logging_json", False):
         h = logging.StreamHandler()
         h.setFormatter(_JsonFormatter())

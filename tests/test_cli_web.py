@@ -201,3 +201,45 @@ def test_cli_demo_causal(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "demo-causal" in out and "valid? = True" in out
+
+
+def test_cli_cpu_flag_forces_cpu_backend(tmp_path, monkeypatch):
+    """--cpu (or JT_FORCE_CPU) must drop the TPU/axon backend factories
+    before the checkers' first jax init — on a box whose tunnel is down,
+    backend init hangs rather than raising, so this is the only exit.
+    The spy pins that the flag actually CALLS the force (the conftest
+    already CPU-forces this process, so the backend alone proves
+    nothing); JT_FORCE_CPU=0/false/no must NOT trigger it."""
+    from jepsen_tpu import cli
+    from jepsen_tpu.__main__ import DEMOS
+    from jepsen_tpu.utils import backend as backend_mod
+
+    calls = []
+    real = backend_mod.force_cpu_backend
+    monkeypatch.setattr(backend_mod, "force_cpu_backend",
+                        lambda *a, **k: (calls.append(1), real(*a, **k)))
+    rc = cli.run(cli.test_all_cmd(DEMOS, prog="demo"),
+                 ["--store-dir", str(tmp_path), "--cpu",
+                  "test-all", "--only", "set", "--time-limit", "1"])
+    assert rc == 0
+    assert calls, "--cpu did not invoke force_cpu_backend"
+    import jax
+
+    assert jax.default_backend() == "cpu"
+
+    # falsy env spellings must not silently downgrade a TPU box
+    calls.clear()
+    monkeypatch.setenv("JT_FORCE_CPU", "0")
+    rc = cli.run(cli.test_all_cmd(DEMOS, prog="demo"),
+                 ["--store-dir", str(tmp_path / "b"),
+                  "test-all", "--only", "set", "--time-limit", "1"])
+    assert rc == 0
+    assert not calls, "JT_FORCE_CPU=0 must not force the CPU backend"
+    # and a truthy spelling does
+    calls.clear()
+    monkeypatch.setenv("JT_FORCE_CPU", "1")
+    rc = cli.run(cli.test_all_cmd(DEMOS, prog="demo"),
+                 ["--store-dir", str(tmp_path / "c"),
+                  "test-all", "--only", "set", "--time-limit", "1"])
+    assert rc == 0
+    assert calls, "JT_FORCE_CPU=1 must force the CPU backend"
