@@ -34,8 +34,7 @@ from jepsen_tpu.checkers.elle.device_infer import PaddedLA, infer
 from jepsen_tpu.ops.cycle_sweep import (  # noqa: F401
     MAX_K_CAP,
     MAX_ROUNDS_CAP,
-    _sweep_arrays,
-    backward_test,
+    projection_scan,
 )
 
 N_COUNT_BITS = 7
@@ -49,6 +48,22 @@ PROJECTIONS = (
 COUNT_NAMES = ("duplicate-appends", "duplicate-elements",
                "incompatible-order", "G1a", "G1b", "dirty-update",
                "internal")
+
+
+def proj_include_stack(projections=PROJECTIONS) -> jnp.ndarray:
+    """(P, 5) family-include flags for the ww/wr/rw/tb/bt edge families
+    (tb/bt are the realtime-barrier families)."""
+    return jnp.asarray([
+        [int("ww" in p), int("wr" in p), int("rw" in p),
+         int("realtime" in p), int("realtime" in p)]
+        for p in projections], jnp.int32)
+
+
+def chain_include_stack(projections=PROJECTIONS) -> jnp.ndarray:
+    """(P, 2) chain-group include flags for [process, barrier] chains."""
+    return jnp.asarray([
+        [int("process" in p), int("realtime" in p)]
+        for p in projections], jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("n_keys", "max_k", "max_rounds"))
@@ -69,54 +84,24 @@ def core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
     e_dst = jnp.concatenate([edges[k][1] for k in ("ww", "wr", "rw", "tb",
                                                    "bt")])
     masks = {k: edges[k][2] for k in ("ww", "wr", "rw", "tb", "bt")}
-    z = {k: jnp.zeros_like(v) for k, v in masks.items()}
 
     pc_nodes, pc_starts, pc_mask = chains["process"]
     bc_nodes, bc_starts, bc_mask = chains["barrier"]
     chain_nodes = jnp.concatenate([pc_nodes, bc_nodes])
     chain_starts = jnp.concatenate([pc_starts, bc_starts])
-    pc_off = jnp.zeros_like(pc_mask)
-    bc_off = jnp.zeros_like(bc_mask)
 
     # One sweep instantiation scanned over the 5 projections (a Python loop
     # would inline 5 copies of the while_loop kernel and quintuple XLA
     # compile time — measured 125.8 s at 100k-txn shapes in round 2).  The
-    # scan also keeps exactly one (N, max_k) label plane live, which is
-    # what bounds HBM at 10M ops.
-    m_stack = jnp.stack([
-        jnp.concatenate([
-            masks["ww"] if "ww" in proj else z["ww"],
-            masks["wr"] if "wr" in proj else z["wr"],
-            masks["rw"] if "rw" in proj else z["rw"],
-            masks["tb"] if "realtime" in proj else z["tb"],
-            masks["bt"] if "realtime" in proj else z["bt"],
-        ]) for proj in PROJECTIONS])
-    cm_stack = jnp.stack([
-        jnp.concatenate([
-            pc_mask if "process" in proj else pc_off,
-            bc_mask if "realtime" in proj else bc_off,
-        ]) for proj in PROJECTIONS])
-
-    # projection-independent backward test, hoisted out of the scan (two
-    # E-sized rank gathers once instead of per projection)
-    back_raw = backward_test(rank, e_src, e_dst, 2 * T)
-
-    def proj_body(carry, mc):
-        conv_all, overflow = carry
-        m, cm = mc
-        has, _, n_back, conv = _sweep_arrays(
-            2 * T, max_k, max_rounds, rank, e_src, e_dst, m,
-            chain_nodes, chain_starts, cm, back_raw=back_raw)
-        carry = (conv_all & conv,
-                 jnp.maximum(overflow, jnp.maximum(n_back - max_k, 0)))
-        return carry, has.astype(jnp.int32)
-
-    # carry init derives from traced inputs so its varying-axis type
-    # matches the body outputs when core_check runs inside a shard_map
-    # (the batched dp path) — same trick as _sweep_window's carry
-    zero0 = e_src[0] * 0
-    (conv_all, overflow), cyc_bits = jax.lax.scan(
-        proj_body, (zero0 == 0, zero0), (m_stack, cm_stack))
+    # scan keeps exactly one (N, max_k) label plane live (bounds HBM at
+    # 10M ops) and consumes family-include flags instead of (5, E) mask
+    # stacks — see projection_scan / PROFILE.md §0b for the hoist.
+    conv_all, overflow, cyc_bits = projection_scan(
+        2 * T, max_k, max_rounds, rank, e_src, e_dst,
+        [masks[k] for k in ("ww", "wr", "rw", "tb", "bt")],
+        proj_include_stack(PROJECTIONS),
+        chain_nodes, chain_starts, [pc_mask, bc_mask],
+        chain_include_stack(PROJECTIONS))
 
     counts = jnp.stack([out["counts"][n].astype(jnp.int32)
                         for n in COUNT_NAMES])

@@ -37,7 +37,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jepsen_tpu.checkers.elle.device_core import PROJECTIONS
+from jepsen_tpu.checkers.elle.device_core import (
+    PROJECTIONS,
+    chain_include_stack,
+    proj_include_stack,
+)
 from jepsen_tpu.checkers.elle.device_infer import PaddedLA, pad_packed
 from jepsen_tpu.history.soa import (
     MOP_APPEND,
@@ -47,7 +51,7 @@ from jepsen_tpu.history.soa import (
     TXN_OK,
     PackedTxns,
 )
-from jepsen_tpu.ops.cycle_sweep import _sweep_arrays
+from jepsen_tpu.ops.cycle_sweep import _sweep_arrays, projection_scan
 from jepsen_tpu.ops.segments import segmented_cummax, segmented_cumsum
 
 BIG = jnp.int32(2 ** 30)
@@ -307,44 +311,21 @@ def rw_core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
     e_dst = jnp.concatenate([edges[k][1] for k in ("ww", "wr", "rw", "tb",
                                                    "bt")])
     masks = {k: edges[k][2] for k in ("ww", "wr", "rw", "tb", "bt")}
-    z = {k: jnp.zeros_like(v) for k, v in masks.items()}
 
     pc_nodes, pc_starts, pc_mask = chains["process"]
     bc_nodes, bc_starts, bc_mask = chains["barrier"]
     chain_nodes = jnp.concatenate([pc_nodes, bc_nodes])
     chain_starts = jnp.concatenate([pc_starts, bc_starts])
-    pc_off = jnp.zeros_like(pc_mask)
-    bc_off = jnp.zeros_like(bc_mask)
 
-    # one sweep instantiation scanned over the 5 projections (same
-    # compile-time rationale as device_core.core_check)
-    m_stack = jnp.stack([
-        jnp.concatenate([
-            masks["ww"] if "ww" in proj else z["ww"],
-            masks["wr"] if "wr" in proj else z["wr"],
-            masks["rw"] if "rw" in proj else z["rw"],
-            masks["tb"] if "realtime" in proj else z["tb"],
-            masks["bt"] if "realtime" in proj else z["bt"],
-        ]) for proj in PROJECTIONS])
-    cm_stack = jnp.stack([
-        jnp.concatenate([
-            pc_mask if "process" in proj else pc_off,
-            bc_mask if "realtime" in proj else bc_off,
-        ]) for proj in PROJECTIONS])
-
-    def proj_body(carry, mc):
-        conv_all, overflow = carry
-        m, cm = mc
-        has, _, n_back, conv = _sweep_arrays(
-            2 * T, max_k, max_rounds, rank, e_src, e_dst, m,
-            chain_nodes, chain_starts, cm)
-        carry = (conv_all & conv,
-                 jnp.maximum(overflow, jnp.maximum(n_back - max_k, 0)))
-        return carry, has.astype(jnp.int32)
-
-    zero0 = e_src[0] * 0
-    (conv_all, overflow), cyc_bits = jax.lax.scan(
-        proj_body, (zero0 == 0, zero0), (m_stack, cm_stack))
+    # one sweep instantiation scanned over the 5 projections via the
+    # shared hoisted form (family-include flags + one shared backward
+    # enumeration; see cycle_sweep.projection_scan / PROFILE.md §0b)
+    conv_all, overflow, cyc_bits = projection_scan(
+        2 * T, max_k, max_rounds, rank, e_src, e_dst,
+        [masks[k] for k in ("ww", "wr", "rw", "tb", "bt")],
+        proj_include_stack(PROJECTIONS),
+        chain_nodes, chain_starts, [pc_mask, bc_mask],
+        chain_include_stack(PROJECTIONS))
 
     # cyclic versions: rank sweep over the version graph (no chains)
     ver = out["versions"]

@@ -242,9 +242,17 @@ def run(parser_dispatch, argv: Optional[Sequence[str]] = None) -> int:
     """-main scaffold: parse, set up logging, dispatch, exit code."""
     p, dispatch = parser_dispatch
     opts = p.parse_args(argv)
+    # truthy ALLOWlist: unrecognized spellings (off/none/disabled) must
+    # not silently downgrade a TPU box to CPU — but warn, because an
+    # IGNORED truthy-intent spelling means the process will go on to
+    # dial the TPU, which HANGS when the tunnel is down
     env_cpu = os.environ.get("JT_FORCE_CPU", "").strip().lower()
-    if getattr(opts, "cpu", False) or env_cpu not in ("", "0", "false",
-                                                      "no"):
+    if env_cpu and env_cpu not in ("1", "true", "yes", "on",
+                                   "0", "false", "no", "off"):
+        print(f"warning: ignoring unrecognized JT_FORCE_CPU={env_cpu!r} "
+              "(use 1/true/yes/on)", file=sys.stderr)
+    if getattr(opts, "cpu", False) or env_cpu in ("1", "true", "yes",
+                                                  "on"):
         # must happen before the first jax backend init (checkers);
         # see utils.backend for why JAX_PLATFORMS=cpu alone is not enough
         from jepsen_tpu.utils.backend import force_cpu_backend

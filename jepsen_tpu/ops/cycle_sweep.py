@@ -85,7 +85,7 @@ def backward_test(rank, nc_src, nc_dst, n_nodes: int):
 def _sweep_window(n_nodes: int, k_total: int, k_local: int, max_rounds: int,
                   rank, nc_src, nc_dst, nc_mask,
                   chain_nodes, chain_starts, chain_mask,
-                  k_offset, axis_name=None, back_raw=None):
+                  k_offset, axis_name=None, back_raw=None, back_pre=None):
     """Sweep kernel over a window of the backward-edge axis.
 
     Each caller owns backward edges with global ids in
@@ -107,14 +107,22 @@ def _sweep_window(n_nodes: int, k_total: int, k_local: int, max_rounds: int,
     # several projections hoist the two E-sized rank gathers out of the
     # scan — the comparison is projection-independent, only the mask
     # varies (1 byte/edge hoisted vs 8 bytes/edge re-gathered 5x).
-    if back_raw is None:
-        back_raw = backward_test(rank, nc_src, nc_dst, n_nodes)
-    is_back = nc_mask & back_raw
-    n_back = jnp.sum(is_back.astype(jnp.int32))
+    if back_pre is not None:
+        # caller hoisted the whole backward enumeration (is_back,
+        # position-stable back_id, n_back) — e.g. device_core's
+        # projection scan, which derives them from ONE shared cumsum
+        # plus per-family offsets instead of an E-sized cumsum per
+        # projection.  Must be bit-identical to the block below.
+        is_back, back_id, n_back = back_pre
+    else:
+        if back_raw is None:
+            back_raw = backward_test(rank, nc_src, nc_dst, n_nodes)
+        is_back = nc_mask & back_raw
+        n_back = jnp.sum(is_back.astype(jnp.int32))
 
-    # stable enumeration of backward edges: order by edge position
-    back_order = jnp.cumsum(is_back.astype(jnp.int32)) - 1  # id per back edge
-    back_id = jnp.where(is_back, back_order, -1)
+        # stable enumeration of backward edges: order by edge position
+        back_order = jnp.cumsum(is_back.astype(jnp.int32)) - 1
+        back_id = jnp.where(is_back, back_order, -1)
 
     # full-width source table (identical on every window — needed for the
     # meta-graph columns)
@@ -219,7 +227,8 @@ def _sweep_window(n_nodes: int, k_total: int, k_local: int, max_rounds: int,
 
 def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
                   rank, nc_src, nc_dst, nc_mask,
-                  chain_nodes, chain_starts, chain_mask, back_raw=None):
+                  chain_nodes, chain_starts, chain_mask, back_raw=None,
+                  back_pre=None):
     """Core kernel (single window).  Returns (has_cycle, witness_bits,
     n_backward, converged).
 
@@ -232,11 +241,84 @@ def _sweep_arrays(n_nodes: int, max_k: int, max_rounds: int,
                          rank, nc_src, nc_dst, nc_mask,
                          chain_nodes, chain_starts, chain_mask,
                          k_offset=jnp.int32(0), axis_name=None,
-                         back_raw=back_raw)
+                         back_raw=back_raw, back_pre=back_pre)
 
 
 _sweep = jax.jit(_sweep_arrays,
                  static_argnames=("n_nodes", "max_k", "max_rounds"))
+
+
+def projection_scan(n_nodes: int, max_k: int, max_rounds: int,
+                    rank, e_src, e_dst, fam_masks, inc_stack,
+                    chain_nodes, chain_starts, chain_masks, cinc_stack):
+    """Scan `_sweep_arrays` over projections given per-family masks and
+    per-projection family-include flags — the single-sourced hoisted
+    form shared by device_core.core_check and device_rw.rw_core_check.
+
+    Instead of materialized (P, E)/(P, C) mask stacks and an E-sized
+    cumsum per projection, the scan consumes tiny include matrices:
+    per-projection masks are `family_mask & include`, and backward-edge
+    enumeration hoists to ONE shared cumsum + per-family count offsets.
+    Families are concatenated blocks, so a projection's position-stable
+    enumeration equals its within-family ids shifted by the counts of
+    its included predecessor families — bit-identical to cumsum over
+    the projection's own mask (the `back_pre` path in `_sweep_window`).
+    Measured effect at 1M txns on CPU: fused check 7.98 s -> 5.18 s and
+    compile 28.8 s -> 7.9 s (PROFILE.md §0b).
+
+    fam_masks: per-family (E_f,) bool masks, concat order == e_src.
+    inc_stack: (P, F) int32 — family f included in projection p.
+    chain_masks: per-chain-group (C_g,) bool, concat order ==
+    chain_nodes.  cinc_stack: (P, G) int32.
+    Returns (conv_all, overflow, cyc_bits (P,) int32).
+    """
+    fam_lens = [int(m.shape[0]) for m in fam_masks]
+    bounds = np.cumsum([0] + fam_lens)
+    union_mask = jnp.concatenate(list(fam_masks))
+
+    back_raw = backward_test(rank, e_src, e_dst, n_nodes)
+    back_all = union_mask & back_raw
+    cum = jnp.cumsum(back_all.astype(jnp.int32))             # ONE E-cumsum
+    cum_start = [cum[int(b) - 1] if b > 0 else jnp.int32(0)
+                 for b in bounds[:-1]]
+    count_f = jnp.stack([
+        (cum[int(e) - 1] if e > 0 else jnp.int32(0)) - s
+        for s, e in zip(cum_start, bounds[1:])])
+    within = (cum - 1) - jnp.concatenate(
+        [jnp.broadcast_to(s, (L,)) for s, L in zip(cum_start, fam_lens)])
+
+    def rep(valsF):
+        return jnp.concatenate(
+            [jnp.broadcast_to(valsF[i], (L,))
+             for i, L in enumerate(fam_lens)])
+
+    def proj_body(carry, mc):
+        conv_all, overflow = carry
+        inc, cinc = mc
+        inc_b = inc.astype(bool)
+        m = union_mask & rep(inc_b)
+        cm = jnp.concatenate([cmask & (cinc[g] > 0)
+                              for g, cmask in enumerate(chain_masks)])
+        offs = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(count_f * inc)[:-1]])
+        is_back = back_all & rep(inc_b)
+        back_id = jnp.where(is_back, within + rep(offs), -1)
+        n_back = jnp.sum(count_f * inc)
+        has, _, n_back_out, conv = _sweep_arrays(
+            n_nodes, max_k, max_rounds, rank, e_src, e_dst, m,
+            chain_nodes, chain_starts, cm,
+            back_pre=(is_back, back_id, n_back))
+        carry = (conv_all & conv,
+                 jnp.maximum(overflow,
+                             jnp.maximum(n_back_out - max_k, 0)))
+        return carry, has.astype(jnp.int32)
+
+    # carry init derives from traced inputs so its varying-axis type
+    # matches the body outputs under shard_map/vmap
+    zero0 = e_src[0] * 0
+    (conv_all, overflow), cyc_bits = jax.lax.scan(
+        proj_body, (zero0 == 0, zero0), (inc_stack, cinc_stack))
+    return conv_all, overflow, cyc_bits
 
 #: budget ceilings shared by every sweep driver (detect_cycles here,
 #: grow_until_exact in device_core): past these, callers fall back to

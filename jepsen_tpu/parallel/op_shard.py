@@ -78,6 +78,14 @@ def projection_sweep_bits(out, max_k: int, sweep):
     pc_off = jnp.zeros_like(pc_mask)
     bc_off = jnp.zeros_like(bc_mask)
 
+    # NOTE: still the materialized-stack + per-projection-cumsum form.
+    # The single-device scans moved to cycle_sweep.projection_scan
+    # (family-include flags + one shared backward enumeration,
+    # PROFILE.md §0b); migrating this windowed/axis_name variant needs
+    # the hoisted back_pre pieces threaded through the k-window split
+    # and is deliberately deferred — its value is HBM division across a
+    # real mesh, where correctness is pinned by the JT_SCALE_TESTS
+    # bitwise differential against the single-device path.
     m_stack = jnp.stack([
         jnp.concatenate([
             masks["ww"] if "ww" in proj else z["ww"],
