@@ -162,16 +162,17 @@ def _run_size(n_txns: int, repeats: int):
 
     from jepsen_tpu.checkers.elle.device_core import core_check
     from jepsen_tpu.checkers.elle.device_infer import pad_packed
-    from jepsen_tpu.workloads import synth
+    from jepsen_tpu.utils import prestage
 
     # keys scale with size so per-key list lengths stay bounded (~12
     # appends/key) — matching how real list-append workloads bound
     # read-list growth (elle's gen rotates keys)
     n_keys = int(os.environ.get("BENCH_KEYS", max(64, n_txns // 8)))
 
+    # prestaged inputs (scripts/prestage_inputs.py) load in seconds; a
+    # cold miss falls back to generation (~153 s at 10M)
     t_gen = time.perf_counter()
-    p = synth.packed_la_history(n_txns=n_txns, n_keys=n_keys,
-                                mops_per_txn=4, read_frac=0.25, seed=7)
+    p = prestage.la_history(n_txns=n_txns, n_keys=n_keys, verbose=False)
     h = pad_packed(p)
     t_gen = time.perf_counter() - t_gen
 

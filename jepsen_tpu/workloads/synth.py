@@ -28,6 +28,11 @@ from jepsen_tpu.history.soa import (
     PackedTxns,
 )
 
+# Bump when packed_la_history / packed_rw_history internals change in a
+# way that alters output for the same kwargs — invalidates prestaged
+# bench inputs (utils/prestage.py keys filenames on this).
+PACKED_GEN_VERSION = 1
+
 
 def la_history(n_txns: int = 100, n_keys: int = 5, concurrency: int = 5,
                max_mops: int = 4, read_prob: float = 0.5,

@@ -31,11 +31,10 @@ def main():
 
     from jepsen_tpu.checkers.elle.device_core import core_check
     from jepsen_tpu.checkers.elle.device_infer import pad_packed
-    from jepsen_tpu.workloads import synth
+    from jepsen_tpu.utils import prestage
 
     t0 = time.perf_counter()
-    p = synth.packed_la_history(n_txns=n_txns, n_keys=max(64, n_txns // 8),
-                                mops_per_txn=4, read_frac=0.25, seed=7)
+    p = prestage.la_history(n_txns=n_txns, n_keys=max(64, n_txns // 8))
     print(f"gen {time.perf_counter() - t0:.1f}s", flush=True)
 
     t0 = time.perf_counter()

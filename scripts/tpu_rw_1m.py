@@ -21,11 +21,10 @@ def main():
     print("backend:", jax.default_backend())
 
     from jepsen_tpu.checkers.elle import device_rw
-    from jepsen_tpu.workloads import synth
+    from jepsen_tpu.utils import prestage
 
     t0 = time.perf_counter()
-    p = synth.packed_rw_history(n_txns=n_txns, n_keys=max(64, n_txns // 8),
-                                seed=11)
+    p = prestage.rw_history(n_txns=n_txns, n_keys=max(64, n_txns // 8))
     print(f"gen {time.perf_counter() - t0:.1f}s; n_txns={p.n_txns}")
 
     from jepsen_tpu.checkers.elle.device_rw import pad_packed

@@ -93,3 +93,40 @@ def test_pack_txns_rw_register():
     assert p.mop_val[1] == p.mop_val[0]  # read sees the write's value id
     assert p.mop_val[2] == -1            # nil read
     assert p.mop_rd_len[2] == 0          # known read
+
+
+def test_save_load_packed_roundtrip(tmp_path):
+    """Prestaged bench inputs (utils/prestage.py) round-trip bit-exactly,
+    including the lazy dense val_names map."""
+    import numpy as np
+
+    from jepsen_tpu.history.soa import load_packed, save_packed
+    from jepsen_tpu.workloads import synth
+
+    p = synth.packed_la_history(n_txns=300, n_keys=32, mops_per_txn=4,
+                                read_frac=0.25, seed=7)
+    path = str(tmp_path / "t.npz")
+    save_packed(path, p)
+    q = load_packed(path)
+    for c in ("txn_type", "txn_process", "txn_invoke_pos",
+              "txn_complete_pos", "txn_orig_index", "mop_txn", "mop_kind",
+              "mop_key", "mop_val", "mop_rd_start", "mop_rd_len",
+              "rd_elems"):
+        assert np.array_equal(getattr(p, c), getattr(q, c)), c
+    assert (q.n_keys, q.n_vals, q.n_events) == (p.n_keys, p.n_vals,
+                                                p.n_events)
+    assert q.val_names[5] == p.val_names[5]
+    assert len(q.val_names) == len(p.val_names)
+
+
+def test_prestage_generate_then_load(tmp_path, monkeypatch):
+    import numpy as np
+
+    monkeypatch.setenv("JT_PRESTAGE_DIR", str(tmp_path))
+    from jepsen_tpu.utils import prestage
+
+    a = prestage.rw_history(n_txns=200, n_keys=16, save=True, verbose=False)
+    assert len(list(tmp_path.glob("rw_v*.npz"))) == 1
+    b = prestage.rw_history(n_txns=200, n_keys=16, verbose=False)
+    assert np.array_equal(a.mop_val, b.mop_val)
+    assert b.n_vals == a.n_vals
