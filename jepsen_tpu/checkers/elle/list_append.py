@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Sequence, Set, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from jepsen_tpu.checkers.elle import consistency, oracle
+from jepsen_tpu.checkers.elle import consistency, coverage, oracle
 from jepsen_tpu.checkers.elle.device_infer import PaddedLA, infer, pad_packed
 from jepsen_tpu.checkers.elle.graph import (
     REL_NAMES,
@@ -72,6 +72,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
         [consistency.canonical(m) for m in consistency_models]))
     want |= set(anomalies)
     want |= {"duplicate-appends", "duplicate-elements", "incompatible-order"}
+
 
     # ---- cycle anomalies: group specs by rel projection -------------------
     specs = [(name, CYCLE_ANOMALY_SPECS[name]) for name in SPEC_ORDER
@@ -152,8 +153,20 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     if needs_fallback:
         if _force_no_fallback:
             raise RuntimeError("cycle sweep did not converge")
-        return oracle.check(p, consistency_models, anomalies,
+        # pass the ORIGINAL input: an op-level history keeps its session
+        # checkability through the fallback (packing drops it)
+        return oracle.check(history, consistency_models, anomalies,
                             max_reported=max_reported)
+
+    # session-guarantee tokens run the dedicated per-process checker —
+    # after the fallback decision, so a non-converged sweep doesn't do
+    # the (host-side) session walk twice (see coverage.py for the
+    # PackedTxns degradation rule)
+    sess_found, sess_checked = coverage.run_la_sessions(
+        history, want, isinstance(history, PackedTxns),
+        max_reported=max_reported)
+    for k, v in sess_found.items():
+        found.setdefault(k, []).extend(v)
 
     found = {k: v for k, v in found.items() if k in want}
     anomaly_types = sorted(found.keys())
@@ -161,13 +174,14 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     bad = set(boundary["not"]) | set(boundary["also-not"])
     requested_bad = bad & {consistency.canonical(m)
                            for m in consistency_models}
-    return {
-        "valid?": not requested_bad,
-        "anomaly-types": anomaly_types,
-        "anomalies": found,
-        "not": boundary["not"],
-        "also-not": boundary["also-not"],
-    }
+    return coverage.finalize_la(
+        {
+            "valid?": not requested_bad,
+            "anomaly-types": anomaly_types,
+            "anomalies": found,
+            "not": boundary["not"],
+            "also-not": boundary["also-not"],
+        }, want, sess_checked)
 
 
 def _expand_rels(rels: frozenset) -> Set[int]:

@@ -268,6 +268,17 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
         [consistency.canonical(m) for m in consistency_models]))
     want |= set(anomalies)
     want |= {"duplicate-appends", "duplicate-elements", "incompatible-order"}
+
+    # session-guarantee tokens: dedicated per-process checker on
+    # op-level input; coverage.py owns the degradation rule
+    from jepsen_tpu.checkers.elle import coverage
+
+    sess_found, sess_checked = coverage.run_la_sessions(
+        history, want, isinstance(history, PackedTxns),
+        max_reported=max_reported)
+    for k, v in sess_found.items():
+        found.setdefault(k, []).extend(v)
+
     cycle_specs = [s for s in SPEC_ORDER
                    if s in want and s in CYCLE_ANOMALY_SPECS]
 
@@ -293,15 +304,17 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
         valid: Any = "unknown"
     else:
         valid = not requested_bad
-    return {
-        "valid?": valid,
-        "anomaly-types": anomaly_types,
-        "anomalies": found,
-        "not": boundary["not"],
-        "also-not": boundary["also-not"],
-        "edge-counts": {REL_NAMES[r]: int((edges.rel == r).sum())
-                        for r in np.unique(edges.rel)} if len(edges) else {},
-    }
+    return coverage.finalize_la(
+        {
+            "valid?": valid,
+            "anomaly-types": anomaly_types,
+            "anomalies": found,
+            "not": boundary["not"],
+            "also-not": boundary["also-not"],
+            "edge-counts": {REL_NAMES[r]: int((edges.rel == r).sum())
+                            for r in np.unique(edges.rel)}
+            if len(edges) else {},
+        }, want, sess_checked)
 
 
 def _realtime_with_subset(inv, comp, ok_ids, ok_info, n_nodes):

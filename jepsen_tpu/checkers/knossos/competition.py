@@ -16,6 +16,7 @@ import inspect
 import logging
 import queue as _queue
 import threading
+import time
 from typing import Any, Dict
 
 logger = logging.getLogger("jepsen.knossos")
@@ -29,7 +30,8 @@ from jepsen_tpu.models import Model
 HOST_FIRST_MAX_OPS = 256
 
 
-def _race(contestants, ops, model, ctl, **kw) -> Dict[str, Any]:
+def _race(contestants, ops, model, ctl, _also_accepts=(),
+          **kw) -> Dict[str, Any]:
     """Race checkers on threads; first definitive answer wins and the
     losers are aborted via the shared `ctl` (reference competition
     semantics).  Threads are NON-daemon — a daemon straggler killed at
@@ -40,6 +42,19 @@ def _race(contestants, ops, model, ctl, **kw) -> Dict[str, Any]:
     expired deadline ends the race even while every leg is mid-flight.
     """
     q: _queue.Queue = _queue.Queue()
+
+    # a kwarg no contestant accepts (e.g. a misspelled budget like
+    # max_config) would otherwise be dropped by EVERY per-leg filter —
+    # auto mode silently unbounded where the direct paths TypeError
+    if kw:
+        accepted = set()
+        for fn in [fn for _, fn in contestants] + list(_also_accepts):
+            accepted |= set(inspect.signature(fn).parameters)
+        dropped = sorted(set(kw) - accepted)
+        if dropped:
+            logger.warning(
+                "race kwargs %s accepted by no contestant %s — ignored",
+                dropped, [n for n, _ in contestants])
 
     def run(name, fn):
         try:
@@ -55,12 +70,15 @@ def _race(contestants, ops, model, ctl, **kw) -> Dict[str, Any]:
 
     fallback: Dict[str, Any] = {"valid?": "unknown"}
     pending = 0
+    threads = []
     try:
         # starts inside the try: if the Nth start raises (thread
         # pressure), the finally still aborts the already-running legs
         for name, fn in contestants:
-            threading.Thread(target=run, args=(name, fn),
-                             name=f"knossos-race-{name}").start()
+            t = threading.Thread(target=run, args=(name, fn),
+                                 name=f"knossos-race-{name}")
+            t.start()
+            threads.append(t)
             pending += 1
         while pending:
             try:
@@ -91,6 +109,24 @@ def _race(contestants, ops, model, ctl, **kw) -> Dict[str, Any]:
         return fallback
     finally:
         ctl.abort()
+        # losers are non-daemon (a daemon killed inside XLA SIGABRTs at
+        # exit) and a leg stuck in one long compile/dispatch cannot see
+        # ctl mid-call — don't block the winner's return on them, but DO
+        # make slow unwinds diagnosable from the log (the reaper thread
+        # itself touches no native code, so daemon is safe)
+        if any(t.is_alive() for t in threads):
+            def reap(ts=tuple(threads)):
+                t_end = time.monotonic() + 30
+                for t in ts:
+                    t.join(timeout=max(0.0, t_end - time.monotonic()))
+                stuck = [t.name for t in ts if t.is_alive()]
+                if stuck:
+                    logger.info(
+                        "race losers still unwinding 30s after the "
+                        "verdict: %s", stuck)
+
+            threading.Thread(target=reap, daemon=True,
+                             name="knossos-race-reaper").start()
 
 
 HOST_LEGS = (("linear", linear.check), ("wgl", wgl.check))
@@ -165,12 +201,18 @@ def analysis(history: History, model: Model,
         return _polled(root,
                        lambda: device_wgl.check(ops, model, ctl=root, **kw))
     if len(ops) <= HOST_FIRST_MAX_OPS:
-        res = _race(HOST_LEGS, ops, model, ChildSearch(root), **kw)
+        # the device fallback three lines down also consumes kwargs:
+        # a device-only kwarg here is NOT dropped, don't warn on it
+        res = _race(HOST_LEGS, ops, model, ChildSearch(root),
+                    _also_accepts=(device_wgl.check,), **kw)
         if res["valid?"] != "unknown":
             return res
+        # same signature-based filter as _race: a host-only kwarg must
+        # not TypeError the fallback leg
+        dparams = inspect.signature(device_wgl.check).parameters
         dres = device_wgl.check(
             ops, model, ctl=ChildSearch(root) if root is not None else None,
-            **kw)
+            **{k: v for k, v in kw.items() if k in dparams})
         return dres if dres["valid?"] != "unknown" else res
     return _race(HOST_LEGS + (("device", device_wgl.check),),
                  ops, model, ChildSearch(root), **kw)

@@ -158,7 +158,7 @@ def _search_packed(ops: Sequence[LinOp], memo: Memo, evs, P: int,
         del slot_sym[t_slot]
         free.append(t_slot)
         if ctl is not None:
-            ctl.explored += int(configs.size)
+            ctl.add_explored(int(configs.size))
     return True, None
 
 
@@ -183,7 +183,7 @@ def _search_sets(ops: Sequence[LinOp], memo: Memo, evs, max_configs: int,
             return False, _failure_info(ops, i, pos, configs)
         configs = expanded
         if ctl is not None:
-            ctl.explored += len(configs)
+            ctl.add_explored(len(configs))
     return True, None
 
 

@@ -29,8 +29,16 @@ class Search:
         # deadline"
         self.deadline = (time.monotonic() + deadline_s
                          if deadline_s is not None else None)
+        self._explored_lock = threading.Lock()
         self.explored = 0
         self.result: Optional[dict] = None
+
+    def add_explored(self, n: int) -> None:
+        """Thread-safe progress increment: concurrently racing legs all
+        funnel into one parent counter, and a bare `explored += n` is a
+        non-atomic read-modify-write that loses updates under the race."""
+        with self._explored_lock:
+            self.explored += n
 
     def abort(self) -> None:
         self._abort.set()
@@ -89,3 +97,11 @@ class ChildSearch(Search):
             p.explored = v
         else:
             self._explored_local = v
+
+    def add_explored(self, n: int) -> None:
+        # delegate to the root so its lock serializes sibling legs
+        p = getattr(self, "_parent", None)
+        if p is not None:
+            p.add_explored(n)
+        else:
+            super().add_explored(n)

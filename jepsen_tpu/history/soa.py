@@ -115,6 +115,10 @@ class _DenseValNames:
     def __getitem__(self, v):
         if isinstance(v, slice):
             return [self[i] for i in range(*v.indices(self._n))]
+        if v < 0:
+            v += self._n  # match list semantics (the eager form)
+        if not 0 <= v < self._n:
+            raise IndexError(v)
         return (int(self._keys()[v]), int(v))
 
 

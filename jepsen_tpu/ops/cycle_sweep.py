@@ -250,10 +250,20 @@ _sweep = jax.jit(_sweep_arrays,
 
 def projection_scan(n_nodes: int, max_k: int, max_rounds: int,
                     rank, e_src, e_dst, fam_masks, inc_stack,
-                    chain_nodes, chain_starts, chain_masks, cinc_stack):
+                    chain_nodes, chain_starts, chain_masks, cinc_stack,
+                    sweep=None):
     """Scan `_sweep_arrays` over projections given per-family masks and
     per-projection family-include flags — the single-sourced hoisted
     form shared by device_core.core_check and device_rw.rw_core_check.
+
+    `sweep` (optional) replaces the single-window `_sweep_arrays` call
+    with a caller-supplied kernel of signature (rank, e_src, e_dst,
+    mask, chain_nodes, chain_starts, chain_mask, back_pre) -> (has,
+    witness, n_back, converged) — how the K-windowed sharded paths
+    (`parallel/op_shard.py`, `parallel/hybrid.py`) reuse this scan with
+    `_sweep_window` inside shard_map while keeping the hoisted
+    enumeration (VERDICT r04 item 2: the sharded sweep previously
+    re-materialized (5, E) mask stacks and ran 5 E-sized cumsums).
 
     Instead of materialized (P, E)/(P, C) mask stacks and an E-sized
     cumsum per projection, the scan consumes tiny include matrices:
@@ -304,10 +314,15 @@ def projection_scan(n_nodes: int, max_k: int, max_rounds: int,
         is_back = back_all & rep(inc_b)
         back_id = jnp.where(is_back, within + rep(offs), -1)
         n_back = jnp.sum(count_f * inc)
-        has, _, n_back_out, conv = _sweep_arrays(
-            n_nodes, max_k, max_rounds, rank, e_src, e_dst, m,
-            chain_nodes, chain_starts, cm,
-            back_pre=(is_back, back_id, n_back))
+        if sweep is None:
+            has, _, n_back_out, conv = _sweep_arrays(
+                n_nodes, max_k, max_rounds, rank, e_src, e_dst, m,
+                chain_nodes, chain_starts, cm,
+                back_pre=(is_back, back_id, n_back))
+        else:
+            has, _, n_back_out, conv = sweep(
+                rank, e_src, e_dst, m, chain_nodes, chain_starts, cm,
+                (is_back, back_id, n_back))
         carry = (conv_all & conv,
                  jnp.maximum(overflow,
                              jnp.maximum(n_back_out - max_k, 0)))

@@ -52,14 +52,20 @@ from jepsen_tpu.ops.cycle_sweep import _sweep_window
 def projection_sweep_bits(out, max_k: int, sweep):
     """The 5-projection scan over an inferred edge set, with `sweep` a
     callable (rank, e_src, e_dst, mask, chain_nodes, chain_starts,
-    chain_mask, back_raw) -> (has_cycle, witness, n_back, converged);
-    back_raw is the hoisted projection-independent backward-edge test.
+    chain_mask, back_pre) -> (has_cycle, witness, n_back, converged);
+    back_pre is the hoisted backward enumeration (is_back, back_id,
+    n_back) that `_sweep_window` consumes directly.
 
     One sweep instantiation scanned over the 5 projections — same
     compile-time + label-plane-memory rationale as device_core.core_check
     (5 inlined while_loop kernels measured 125.8 s of XLA compile at
     100k-txn shapes in round 2).  Shared by the K-axis sharded path and
-    the 2D hybrid (dcn x k) path (`parallel/hybrid.py`).
+    the 2D hybrid (dcn x k) path (`parallel/hybrid.py`).  Since round 5
+    this delegates to `cycle_sweep.projection_scan` — family-include
+    flags plus ONE shared E-sized backward cumsum — instead of
+    materializing (5, E)/(5, C) mask stacks and re-running 5 cumsums
+    (VERDICT r04 item 2; the single-device paths migrated in round 4,
+    PROFILE.md §0b).
     """
     edges = out["edges"]
     chains = out["chains"]
@@ -68,57 +74,25 @@ def projection_sweep_bits(out, max_k: int, sweep):
                                                    "bt")])
     e_dst = jnp.concatenate([edges[k][1] for k in ("ww", "wr", "rw", "tb",
                                                    "bt")])
-    masks = {k: edges[k][2] for k in ("ww", "wr", "rw", "tb", "bt")}
-    z = {k: jnp.zeros_like(v) for k, v in masks.items()}
 
     pc_nodes, pc_starts, pc_mask = chains["process"]
     bc_nodes, bc_starts, bc_mask = chains["barrier"]
     chain_nodes = jnp.concatenate([pc_nodes, bc_nodes])
     chain_starts = jnp.concatenate([pc_starts, bc_starts])
-    pc_off = jnp.zeros_like(pc_mask)
-    bc_off = jnp.zeros_like(bc_mask)
 
-    # NOTE: still the materialized-stack + per-projection-cumsum form.
-    # The single-device scans moved to cycle_sweep.projection_scan
-    # (family-include flags + one shared backward enumeration,
-    # PROFILE.md §0b); migrating this windowed/axis_name variant needs
-    # the hoisted back_pre pieces threaded through the k-window split
-    # and is deliberately deferred — its value is HBM division across a
-    # real mesh, where correctness is pinned by the JT_SCALE_TESTS
-    # bitwise differential against the single-device path.
-    m_stack = jnp.stack([
-        jnp.concatenate([
-            masks["ww"] if "ww" in proj else z["ww"],
-            masks["wr"] if "wr" in proj else z["wr"],
-            masks["rw"] if "rw" in proj else z["rw"],
-            masks["tb"] if "realtime" in proj else z["tb"],
-            masks["bt"] if "realtime" in proj else z["bt"],
-        ]) for proj in PROJECTIONS])
-    cm_stack = jnp.stack([
-        jnp.concatenate([
-            pc_mask if "process" in proj else pc_off,
-            bc_mask if "realtime" in proj else bc_off,
-        ]) for proj in PROJECTIONS])
+    from jepsen_tpu.checkers.elle.device_core import (
+        chain_include_stack,
+        proj_include_stack,
+    )
+    from jepsen_tpu.ops.cycle_sweep import projection_scan
 
-    from jepsen_tpu.ops.cycle_sweep import backward_test
-
-    back_raw = backward_test(rank, e_src, e_dst, rank.shape[0])
-
-    def proj_body(carry, mc):
-        conv_all, overflow = carry
-        m, cm = mc
-        has, _, n_back, conv = sweep(
-            rank, e_src, e_dst, m, chain_nodes, chain_starts, cm, back_raw)
-        carry = (conv_all & conv,
-                 jnp.maximum(overflow, jnp.maximum(n_back - max_k, 0)))
-        return carry, has.astype(jnp.int32)
-
-    # carry init derives from the data so its varying-axis type matches
-    # the body outputs when this whole function runs INSIDE a shard_map
-    # (the hybrid dcn-row case) as well as outside (the K-axis case)
-    zero = (rank[0] * 0).astype(jnp.int32)
-    (conv_all, overflow), cyc_bits = jax.lax.scan(
-        proj_body, (zero == 0, zero), (m_stack, cm_stack))
+    # max_rounds is owned by the sweep closure (unused when sweep is set)
+    conv_all, overflow, cyc_bits = projection_scan(
+        rank.shape[0], max_k, 0, rank, e_src, e_dst,
+        [edges[k][2] for k in ("ww", "wr", "rw", "tb", "bt")],
+        proj_include_stack(PROJECTIONS),
+        chain_nodes, chain_starts, [pc_mask, bc_mask],
+        chain_include_stack(PROJECTIONS), sweep=sweep)
 
     counts = jnp.stack([out["counts"][n].astype(jnp.int32)
                         for n in COUNT_NAMES])
@@ -142,14 +116,19 @@ def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
     rep = P()
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(rep,) * 8, out_specs=(rep, rep, rep, rep))
-    def sharded_sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_, br_):
+             in_specs=(rep,) * 10, out_specs=(rep, rep, rep, rep))
+    def sharded_sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_,
+                      ib_, bid_, nb_):
         off = jax.lax.axis_index(axis) * k_local
         return _sweep_window(2 * T, max_k, k_local, max_rounds,
                              rank_, e_src_, e_dst_, m_, cn_, cs_, cm_,
-                             k_offset=off, axis_name=axis, back_raw=br_)
+                             k_offset=off, axis_name=axis,
+                             back_pre=(ib_, bid_, nb_))
 
-    return projection_sweep_bits(out, max_k, sharded_sweep)
+    return projection_sweep_bits(
+        out, max_k,
+        lambda r, s, d, m, cn, cs, cm, bp: sharded_sweep(
+            r, s, d, m, cn, cs, cm, *bp))
 
 
 def shard_padded(h: PaddedLA, mesh: Mesh, axis: str = "dp"

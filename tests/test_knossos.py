@@ -329,7 +329,12 @@ def test_competition_races_device_and_host_legs():
     r = analysis(h, cas_register(), deadline_s=300)
     wall = time.time() - t0
     assert r["valid?"] is True, r
-    assert wall < 120, f"race should settle fast, took {wall:.0f}s"
+    # the functional regression is the verdict above; the wall bound is
+    # only meaningful with a core to spare — on a single-core box the
+    # device leg's XLA compile competes with the host DFS for the one
+    # core and the bound flakes (ADVICE r04)
+    if (os.cpu_count() or 1) > 1:
+        assert wall < 120, f"race should settle fast, took {wall:.0f}s"
 
 
 def test_device_wgl_ctl_abort():

@@ -1,0 +1,153 @@
+"""Control-plane integration suite: real OS processes (VERDICT r04
+item 6).  regd daemons are installed, started, crashed, restarted, and
+log-snarfed exclusively through `jepsen_tpu.control` — the reference's
+`jepsen.control` usage pattern — with a checker verdict at the end."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu import db as db_proto
+from jepsen_tpu.dbs import regd_suite as rs
+from jepsen_tpu.generator import core as g
+from jepsen_tpu.nemesis import core as nem
+
+
+def _opts(tmp_path, base_port):
+    return {
+        "store-dir": str(tmp_path / "store"),
+        "concurrency": 4,
+        "base-port": base_port,
+    }
+
+
+def _run(test, limit):
+    test["generator"] = g.limit(limit, test["generator"])
+    return core.run(test)
+
+
+def test_regd_append_valid_real_processes(tmp_path):
+    """Happy path: 3 real daemon processes, real TCP, checker valid —
+    and the artifacts prove the control plane did the work."""
+    t = rs.append_test(_opts(tmp_path, 7620))
+    done = _run(t, 120)
+    res = done["results"]
+    assert res["valid?"] is True, res
+    oks = [op for op in done["history"]
+           if op.type == "ok" and op.f == "txn"]
+    assert len(oks) >= 60, len(oks)
+    # daemons really ran as OS processes: logs exist (use `done`, the
+    # completed test map — it holds the run's store timestamp)
+    db = done["db"]
+    for node in done["nodes"]:
+        paths = db._paths(done, node)
+        assert os.path.exists(paths["log"]), paths["log"]
+        assert "listening" in open(paths["log"]).read()
+    # log download landed the files in the store dir, one dir per node
+    from jepsen_tpu import store
+
+    for node in done["nodes"]:
+        d = store.path(done, node)
+        assert os.path.exists(os.path.join(d, "regd.log")), d
+
+
+def test_regd_primary_crash_recovery(tmp_path):
+    """Kill -9 the primary mid-run via grepkill, restart it via
+    start_daemon; WAL replay keeps the history strict-serializable."""
+    t = rs.append_test(_opts(tmp_path, 7630))
+    db = t["db"]
+    killer = nem.node_start_stopper(
+        lambda test, nodes: [nodes[0]],       # always the primary
+        lambda test, node: db.kill(test, node),
+        lambda test, node: db.start(test, node),
+        start_f="kill-primary", stop_f="restart-primary")
+    t["nemesis"] = killer
+    nem_seq = [
+        g.sleep(0.15),
+        {"type": "invoke", "f": "kill-primary"},
+        g.sleep(0.2),
+        {"type": "invoke", "f": "restart-primary"},
+        g.sleep(0.1),
+    ]
+    t["generator"] = g.any_gen(g.limit(200, t["generator"]),
+                               g.nemesis(nem_seq))
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is True, res
+    hist = done["history"]
+    oks = [op for op in hist if op.type == "ok" and op.f == "txn"]
+    # most of the 200 ops land in the dead window and fail — commits on
+    # both sides of the crash are what matters
+    assert len(oks) >= 25, len(oks)
+    # the crash really happened: some client ops failed or went info
+    non_ok = [op for op in hist
+              if op.type in ("fail", "info") and op.f == "txn"]
+    assert non_ok, "kill window produced no failures — nemesis inert?"
+    # and the nemesis ops themselves are in the history
+    assert any(op.f == "kill-primary" for op in hist)
+
+
+def test_regd_stale_reads_caught(tmp_path):
+    """--stale-reads + a blocked backup: local backup reads diverge and
+    the checker must find realtime anomalies (the deliberate hole)."""
+    opts = _opts(tmp_path, 7640)
+    opts["consistency-models"] = ("strict-serializable",)
+    t = rs.append_test(opts, stale_reads=True)
+    db = t["db"]
+
+    class BlockBackups(nem.Nemesis):
+        def invoke(self, test, op):
+            if op["f"] == "block":
+                # backups drop replication from the primary: their local
+                # reads freeze while the primary keeps committing
+                for node in test["nodes"][1:]:
+                    rs.request(db.port(test, node),
+                               {"op": "block",
+                                "peers": [test["nodes"][0]]})
+            else:
+                for node in test["nodes"][1:]:
+                    rs.request(db.port(test, node), {"op": "heal"})
+            return dict(op, type="info")
+
+    t["nemesis"] = BlockBackups()
+    nem_seq = [
+        g.sleep(0.05),
+        {"type": "invoke", "f": "block"},
+        g.sleep(0.6),
+        {"type": "invoke", "f": "heal"},
+    ]
+    t["generator"] = g.any_gen(g.limit(250, t["generator"]),
+                               g.nemesis(nem_seq))
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid?"] is False, res
+
+
+def test_regdb_supports_expected_facets():
+    db = rs.RegDB()
+    assert db_proto.supports(db, db_proto.Process)
+    assert db_proto.supports(db, db_proto.Primary)
+    assert db_proto.supports(db, db_proto.LogFiles)
+    assert not db_proto.supports(db, db_proto.Pause)
+
+
+def test_regd_wal_torn_tail_recovery(tmp_path):
+    """A torn (partial) final WAL line must not swallow later commits on
+    the NEXT restart: the store truncates the torn tail before
+    appending (review r05 finding — reproduced data loss)."""
+    from jepsen_tpu.dbs.regd import Store
+
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = Store(wal)
+    s1.commit([["append", "x", 1]])
+    s1.commit([["append", "x", 2]])
+    # simulate a crash mid-write: torn partial record, no newline
+    with open(wal, "ab") as f:
+        f.write(b'{"txn": [["append", "x", 3')
+    s2 = Store(wal)                       # restart 1: drops torn tail
+    assert s2.data["x"] == [1, 2]
+    s2.commit([["append", "x", 4]])
+    s2.commit([["append", "x", 5]])
+    s3 = Store(wal)                       # restart 2: 4 and 5 survive
+    assert s3.data["x"] == [1, 2, 4, 5], s3.data

@@ -104,3 +104,104 @@ def test_guarantee_selection():
             (1, [["r", "x", None]], [["r", "x", 1]]))
     res = sessions.check(h, guarantees=("monotonic-writes",))
     assert res["valid?"] is True  # MR not requested
+
+
+# ---- cross-key WFR / MW (round 5, VERDICT r04 item 8) ----------------
+
+
+def test_cross_key_wfr_rw_register():
+    """S1 read u(k1) then wrote v(k2); S2 observed v then read k1 older
+    than u — v applied before the write it depends on."""
+    from jepsen_tpu.checkers.elle import sessions
+    from jepsen_tpu.history import history, invoke, ok
+
+    h = history([
+        invoke(0, "txn", [["w", "a", 1]]),
+        ok(0, "txn", [["w", "a", 1]]),
+        invoke(1, "txn", [["r", "a", None], ["w", "b", 9]]),
+        ok(1, "txn", [["r", "a", 1], ["w", "b", 9]]),
+        invoke(2, "txn", [["r", "b", None]]),
+        ok(2, "txn", [["r", "b", 9]]),
+        invoke(2, "txn", [["r", "a", None]]),
+        ok(2, "txn", [["r", "a", None]]),  # INIT: older than u=1
+    ])
+    res = sessions.check(h, guarantees=("writes-follow-reads",))
+    assert "writes-follow-reads-violation" in res["anomaly-types"], res
+    item = res["anomalies"]["writes-follow-reads-violation"][0]
+    assert "cross-key-dependency" in item, item
+
+
+def test_cross_key_mw_rw_register():
+    """S1 wrote w1(k1) then v(k2); S2 observed v then read k1 older
+    than w1 — S1's writes applied out of session order."""
+    from jepsen_tpu.checkers.elle import sessions
+    from jepsen_tpu.history import history, invoke, ok
+
+    h = history([
+        invoke(1, "txn", [["w", "a", 7]]),
+        ok(1, "txn", [["w", "a", 7]]),
+        invoke(1, "txn", [["w", "b", 9]]),
+        ok(1, "txn", [["w", "b", 9]]),
+        invoke(2, "txn", [["r", "b", None]]),
+        ok(2, "txn", [["r", "b", 9]]),
+        invoke(2, "txn", [["r", "a", None]]),
+        ok(2, "txn", [["r", "a", None]]),  # INIT: older than 7
+    ])
+    res = sessions.check(h, guarantees=("monotonic-writes",))
+    assert "monotonic-writes-violation" in res["anomaly-types"], res
+
+
+def test_cross_key_wfr_list_append():
+    from jepsen_tpu.checkers.elle import sessions
+    from jepsen_tpu.history import history, invoke, ok
+
+    h = history([
+        invoke(0, "txn", [["append", "a", 1]]),
+        ok(0, "txn", [["append", "a", 1]]),
+        invoke(1, "txn", [["r", "a", None], ["append", "b", 9]]),
+        ok(1, "txn", [["r", "a", [1]], ["append", "b", 9]]),
+        invoke(2, "txn", [["r", "b", None]]),
+        ok(2, "txn", [["r", "b", [9]]]),
+        invoke(2, "txn", [["r", "a", None]]),
+        ok(2, "txn", [["r", "a", []]]),  # shorter than S1's read
+    ])
+    res = sessions.check_la(h, guarantees=("writes-follow-reads",))
+    assert "writes-follow-reads-violation" in res["anomaly-types"], res
+
+
+def test_cross_key_mw_list_append():
+    from jepsen_tpu.checkers.elle import sessions
+    from jepsen_tpu.history import history, invoke, ok
+
+    h = history([
+        invoke(1, "txn", [["append", "a", 7]]),
+        ok(1, "txn", [["append", "a", 7]]),
+        invoke(1, "txn", [["append", "b", 9]]),
+        ok(1, "txn", [["append", "b", 9]]),
+        invoke(2, "txn", [["r", "b", None]]),
+        ok(2, "txn", [["r", "b", [9]]]),
+        invoke(2, "txn", [["r", "a", None]]),
+        ok(2, "txn", [["r", "a", []]]),  # missing S1's append 7
+    ])
+    res = sessions.check_la(h, guarantees=("monotonic-writes",))
+    assert "monotonic-writes-violation" in res["anomaly-types"], res
+
+
+def test_cross_key_no_false_positive_on_causal_history():
+    """A session that reads the dependency key at or past the required
+    version stays clean."""
+    from jepsen_tpu.checkers.elle import sessions
+    from jepsen_tpu.history import history, invoke, ok
+
+    h = history([
+        invoke(0, "txn", [["w", "a", 1]]),
+        ok(0, "txn", [["w", "a", 1]]),
+        invoke(1, "txn", [["r", "a", None], ["w", "b", 9]]),
+        ok(1, "txn", [["r", "a", 1], ["w", "b", 9]]),
+        invoke(2, "txn", [["r", "b", None]]),
+        ok(2, "txn", [["r", "b", 9]]),
+        invoke(2, "txn", [["r", "a", None]]),
+        ok(2, "txn", [["r", "a", 1]]),  # exactly u: fine
+    ])
+    res = sessions.check(h)
+    assert res["valid?"] is True, res

@@ -19,21 +19,30 @@ from jepsen_tpu.checkers.elle import sessions
 from jepsen_tpu.history import history, invoke, ok
 
 
-def _simulate(seed, n_procs=4, n_keys=3, n_txns=60):
+def _simulate(seed, n_procs=4, n_keys=3, n_txns=60, causal_frac=0.0):
     """Returns a mutable txn list [(proc, mops)] where every session's
-    reads are monotone by construction."""
+    reads are monotone by construction.  `causal_frac` of the write txns
+    read a DIFFERENT key before writing — registering cross-key causal
+    dependencies (round-5 WFR/MW cross-key rules) that a single-copy
+    store satisfies by construction."""
     rng = random.Random(seed)
     cur = {k: None for k in range(n_keys)}  # live version per key
     next_v = [0]
     txns = []
     for _ in range(n_txns):
         proc = rng.randrange(n_procs)
-        if rng.random() < 0.5:
+        r = rng.random()
+        if r < 0.5:
             # write txn: read current, install successor (chains the DAG)
             k = rng.randrange(n_keys)
             v = next_v[0]
             next_v[0] += 1
-            txns.append((proc, [["r", k, cur[k]], ["w", k, v]]))
+            if rng.random() < causal_frac and n_keys > 1:
+                ka = rng.choice([x for x in range(n_keys) if x != k])
+                txns.append((proc, [["r", ka, cur[ka]],
+                                    ["r", k, cur[k]], ["w", k, v]]))
+            else:
+                txns.append((proc, [["r", k, cur[k]], ["w", k, v]]))
             cur[k] = v
         else:
             # read-only txn over 1-2 keys at the live versions
@@ -65,6 +74,15 @@ def _read_only_reads(txns, proc):
 def test_valid_sessions_fuzz():
     for seed in range(25):
         res = sessions.check(_to_history(_simulate(seed)))
+        assert res["valid?"] is True, (seed, res)
+
+
+def test_valid_sessions_fuzz_with_causal_writes():
+    """Cross-key dependency registration must not manufacture
+    violations on a single-copy store."""
+    for seed in range(25):
+        res = sessions.check(
+            _to_history(_simulate(seed, causal_frac=0.5)))
         assert res["valid?"] is True, (seed, res)
 
 
@@ -142,3 +160,101 @@ def test_read_your_writes_injection_fuzz():
         assert "read-your-writes-violation" in res["anomaly-types"], \
             (seed, res)
     assert injected >= 30, f"only {injected} injectable cases"
+
+
+def test_cross_key_wfr_injection_fuzz():
+    """S1 read u(ka) then wrote v(kb); rewrite a later observer to read
+    v(kb) and afterwards an ancestor of u on ka — cross-key WFR."""
+    injected = 0
+    for seed in range(80):
+        txns = _simulate(seed, causal_frac=0.6)
+        done = False
+        # causal writes: (txn_pos, ka, u, kb, v) with a known u
+        cws = []
+        for i, (p, mops) in enumerate(txns):
+            if len(mops) == 3 and mops[0][0] == "r" and \
+                    mops[2][0] == "w" and mops[0][1] != mops[2][1] and \
+                    mops[0][2] is not None:
+                cws.append((i, p, mops[0][1], mops[0][2],
+                            mops[2][1], mops[2][2]))
+        for i1, p1, ka, u, kb, v in cws:
+            if done:
+                break
+            for p2 in range(4):
+                if p2 == p1 or done:
+                    continue
+                ro = [(i, j, m[1]) for i, j, m in (
+                    (i, j, m) for i, (p, mops) in enumerate(txns)
+                    if p == p2 and not any(x[0] == "w" for x in mops)
+                    for j, m in enumerate(mops)) if i > i1]
+                for a in range(len(ro)):
+                    for b in range(a + 1, len(ro)):
+                        i2, j2, k2 = ro[a]
+                        i3, j3, k3 = ro[b]
+                        if k2 == kb and k3 == ka and i3 > i2:
+                            txns[i2][1][j2][2] = v
+                            txns[i3][1][j3][2] = None  # INIT < u
+                            done = True
+                            break
+                    if done:
+                        break
+        if not done:
+            continue
+        injected += 1
+        res = sessions.check(_to_history(txns))
+        assert res["valid?"] is False, (seed, res)
+        assert "writes-follow-reads-violation" in res["anomaly-types"], \
+            (seed, res)
+    assert injected >= 20, f"only {injected} injectable cases"
+
+
+def test_cross_key_mw_injection_fuzz():
+    """S1 wrote v1(ka) then v2(kb); rewrite an observer to read v2(kb)
+    then an ancestor of v1 on ka — cross-key MW."""
+    injected = 0
+    for seed in range(80):
+        txns = _simulate(seed, causal_frac=0.3)
+        done = False
+        for p1 in range(4):
+            if done:
+                break
+            # this session's writes in order: (txn_pos, key, val)
+            ws = [(i, mops[-1][1], mops[-1][2])
+                  for i, (p, mops) in enumerate(txns)
+                  if p == p1 and mops[-1][0] == "w"]
+            for a in range(len(ws)):
+                for b in range(a + 1, len(ws)):
+                    ia, ka, v1 = ws[a]
+                    ib, kb, v2 = ws[b]
+                    if ka == kb:
+                        continue
+                    for p2 in range(4):
+                        if p2 == p1 or done:
+                            continue
+                        ro = [(i, j, m[1]) for i, (p, mops) in
+                              enumerate(txns) if p == p2 and
+                              not any(x[0] == "w" for x in mops)
+                              for j, m in enumerate(mops) if i > ib]
+                        for x in range(len(ro)):
+                            for y in range(x + 1, len(ro)):
+                                i2, j2, k2 = ro[x]
+                                i3, j3, k3 = ro[y]
+                                if k2 == kb and k3 == ka and i3 > i2:
+                                    txns[i2][1][j2][2] = v2
+                                    txns[i3][1][j3][2] = None
+                                    done = True
+                                    break
+                            if done:
+                                break
+                    if done:
+                        break
+                if done:
+                    break
+        if not done:
+            continue
+        injected += 1
+        res = sessions.check(_to_history(txns))
+        assert res["valid?"] is False, (seed, res)
+        assert "monotonic-writes-violation" in res["anomaly-types"], \
+            (seed, res)
+    assert injected >= 20, f"only {injected} injectable cases"
