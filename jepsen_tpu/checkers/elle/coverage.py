@@ -103,12 +103,14 @@ def unchecked_for_la(want: Set[str], sess_checked: bool) -> list:
     search this call."""
     searched = LA_COUNT_TOKENS | set(SPEC_ORDER) | LA_EQUIV_COVERED
     sess_want = _session_tokens(want)
-    if sess_checked or {"G-single-process", "G1c-process",
-                        "G0-process"} & want:
+    if sess_checked or "G-single-process" in want:
         # per-session ordering violations surface as process-edge cycles
         # in the transactional graph (the reference's own treatment), so
         # a strict/strong-session-class request keeps its verdict even
-        # on packed input; a BARE session request does not
+        # on packed input; a BARE session request does not.  Only the
+        # G-single-process family qualifies: read-centric violations
+        # (monotonic-reads, RYW) need anti-dependency (rw) edges, which
+        # G0-process/G1c-process projections do not search
         searched |= sess_want
     return sorted(want - searched)
 

@@ -96,8 +96,10 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
     # so the verdict stands; a BARE session request (e.g. just
     # ["monotonic-reads"]) has no such coverage and must degrade to
     # unknown rather than silently report valid
-    proc_covered = bool({"G-single-process", "G1c-process",
-                         "G0-process"} & want)
+    # only G-single-process qualifies: read-centric session violations
+    # (monotonic-reads, RYW) surface through anti-dependency (rw)
+    # edges, which the G0-process/G1c-process projections never search
+    proc_covered = "G-single-process" in want
     sess_unchecked = sorted(w[:-len(suffix)] for w in sess_want) \
         if (sess_want and isinstance(history, PackedTxns)
             and not proc_covered) else []

@@ -162,6 +162,107 @@ def _search_packed(ops: Sequence[LinOp], memo: Memo, evs, P: int,
     return True, None
 
 
+def _rowview(a: np.ndarray) -> np.ndarray:
+    """View (C, W) rows as a structured 1-D array for row-wise
+    membership (np.isin sorts lexicographically by fields)."""
+    return np.ascontiguousarray(a).view(
+        [("", a.dtype)] * a.shape[1]).ravel()
+
+
+def _search_packed_wide(ops: Sequence[LinOp], memo: Memo, evs, P: int,
+                        max_configs: int, ctl: Optional[Search] = None):
+    """Wide-mask packed search: the >57-slot regime (crash-heavy
+    histories, where every `info` op holds a slot forever — VERDICT r04
+    item 3's missing contestant).
+
+    A config is a row ``[state, lane_0 .. lane_{L-1}]`` (int64 cols;
+    lanes hold uint32 slot bitmasks, L = ceil(P/32)) in a (C, 1+L)
+    array kept row-sorted-unique by np.unique(axis=0).  The per-event
+    expansion is the same vectorized frontier closure as the int64 path
+    — one transition-table gather per (pending slot x frontier) round —
+    just with 2-D rows instead of scalar packs.  ~P/57x more memory per
+    config than the int64 path; identical asymptotics.
+    """
+    table = memo.table
+    L = (P + 31) // 32
+
+    free = list(range(P - 1, -1, -1))
+    slot_of: Dict[int, int] = {}
+    slot_sym: Dict[int, int] = {}
+
+    configs = np.zeros((1, 1 + L), np.int64)
+    configs[0, 0] = memo.init_state
+    for pos, kind, i in evs:
+        if ctl is not None and ctl.aborted():
+            return None, {"reason": "aborted"}
+        if kind == "call":
+            s = free.pop()
+            slot_of[i] = s
+            slot_sym[s] = int(memo.op_sym[i])
+            continue
+
+        t_slot = slot_of.pop(i)
+        all_cfgs = configs
+        frontier = configs
+        while frontier.shape[0]:
+            # poll INSIDE the closure too: one event's expansion can run
+            # minutes on info-dense histories, and the competition must
+            # be able to abort this leg mid-event
+            if ctl is not None and ctl.aborted():
+                return None, {"reason": "aborted"}
+            new_parts = []
+            for s, sym in slot_sym.items():
+                lane, bit = 1 + s // 32, np.int64(1) << (s % 32)
+                sel = (frontier[:, lane] & bit) == 0
+                if not sel.any():
+                    continue
+                sub = frontier[sel]
+                s2 = table[sub[:, 0], sym]
+                ok = s2 >= 0
+                if not ok.any():
+                    continue
+                rows = sub[ok].copy()
+                rows[:, 0] = s2[ok]
+                rows[:, lane] |= bit
+                new_parts.append(rows)
+            if not new_parts:
+                break
+            cand = np.unique(np.concatenate(new_parts), axis=0)
+            fresh = cand[~np.isin(_rowview(cand), _rowview(all_cfgs),
+                                  assume_unique=True)]
+            if not fresh.shape[0]:
+                break
+            all_cfgs = np.unique(np.concatenate([all_cfgs, fresh]),
+                                 axis=0)
+            if all_cfgs.shape[0] > max_configs:
+                return None, {"reason": "config budget exhausted"}
+            frontier = fresh
+
+        lane, bit = 1 + t_slot // 32, np.int64(1) << (t_slot % 32)
+        survivors = all_cfgs[(all_cfgs[:, lane] & bit) != 0]
+        if not survivors.shape[0]:
+            op_of_slot = {s: j for j, s in slot_of.items()}
+            op_of_slot[t_slot] = i
+            prior = set()
+            for row in configs[:4]:
+                lin = frozenset(
+                    op_of_slot[s] for s in range(P)
+                    if (int(row[1 + s // 32]) >> (s % 32)) & 1
+                    and s in op_of_slot)
+                prior.add((int(row[0]), lin))
+            del slot_sym[t_slot]
+            free.append(t_slot)
+            return False, _failure_info(ops, i, pos, prior)
+        survivors = survivors.copy()
+        survivors[:, lane] &= ~bit
+        configs = np.unique(survivors, axis=0)
+        del slot_sym[t_slot]
+        free.append(t_slot)
+        if ctl is not None:
+            ctl.add_explored(int(configs.shape[0]))
+    return True, None
+
+
 def _search_sets(ops: Sequence[LinOp], memo: Memo, evs, max_configs: int,
                  ctl: Optional[Search] = None):
     table = memo.table
@@ -187,14 +288,24 @@ def _search_sets(ops: Sequence[LinOp], memo: Memo, evs, max_configs: int,
     return True, None
 
 
+#: wide-mask slot ceiling: L = ceil(P/32) lanes per config row; past
+#: this the per-config rows are so wide the sets path wins anyway
+WIDE_MAX_SLOTS = 1024
+
+
 def _search(ops: Sequence[LinOp], memo: Memo, max_configs: int,
-            ctl: Optional[Search] = None, _force_sets: bool = False):
+            ctl: Optional[Search] = None, _force_sets: bool = False,
+            _force_wide: bool = False):
     evs = _events(ops)
     P = _peak_concurrency(evs)
     # packed configs need state << P to fit an int64
-    if not _force_sets and P and P <= 57 and \
-            memo.n_states <= (1 << (62 - P)):
-        return _search_packed(ops, memo, evs, P, max_configs, ctl)
+    if not _force_sets:
+        if not _force_wide and P and P <= 57 and \
+                memo.n_states <= (1 << (62 - P)):
+            return _search_packed(ops, memo, evs, P, max_configs, ctl)
+        if P and P <= WIDE_MAX_SLOTS:
+            return _search_packed_wide(ops, memo, evs, P, max_configs,
+                                       ctl)
     return _search_sets(ops, memo, evs, max_configs, ctl)
 
 

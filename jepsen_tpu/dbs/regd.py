@@ -117,6 +117,11 @@ class Node:
         self.stale_reads = stale_reads
         self.blocked = set()
         self.lock = threading.Lock()
+        # serializes commit+forward so backups apply txns in the
+        # primary's WAL order (without it, two handler threads can
+        # forward in the opposite order and a backup diverges
+        # PERMANENTLY — order corruption, not the documented staleness)
+        self.write_lock = threading.Lock()
 
     @property
     def is_primary(self):
@@ -192,8 +197,9 @@ class Node:
             writes = any(f == "append" for f, _, _ in txn)
             if self.is_primary:
                 if writes:
-                    out = self.store.commit(txn)
-                    self.forward(txn)
+                    with self.write_lock:
+                        out = self.store.commit(txn)
+                        self.forward(txn)
                 else:
                     out = self.store.read_only(txn)
                 return {"ok": True, "txn": out}

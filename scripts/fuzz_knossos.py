@@ -33,14 +33,27 @@ t_algo = {}
 t_start = time.time()
 N = int(os.environ.get("FUZZ_N", 150))
 for case in range(N):
-    params = dict(
-        n_ops=rng.choice([12, 24, 40]),
-        concurrency=rng.choice([2, 3]),
-        stale_read_prob=rng.choice([0.0, 0.0, 0.2, 0.5]),
-        info_prob=rng.choice([0.0, 0.05, 0.15]),
-        cas_prob=rng.choice([0.0, 0.2, 0.5]),
-        seed=rng.randrange(1 << 30),
-    )
+    if case % 10 == 9:
+        # wide-mask regime (round 5): enough concurrency+infos that the
+        # peak slot count can exceed 57, exercising
+        # linear._search_packed_wide in the same agreement gate
+        params = dict(
+            n_ops=rng.choice([60, 90]),
+            concurrency=rng.choice([40, 70]),
+            stale_read_prob=rng.choice([0.0, 0.2]),
+            info_prob=rng.choice([0.2, 0.4]),
+            cas_prob=rng.choice([0.0, 0.3]),
+            seed=rng.randrange(1 << 30),
+        )
+    else:
+        params = dict(
+            n_ops=rng.choice([12, 24, 40]),
+            concurrency=rng.choice([2, 3]),
+            stale_read_prob=rng.choice([0.0, 0.0, 0.2, 0.5]),
+            info_prob=rng.choice([0.0, 0.05, 0.15]),
+            cas_prob=rng.choice([0.0, 0.2, 0.5]),
+            seed=rng.randrange(1 << 30),
+        )
     h = synth.lin_register_history(**params)
     cur_algo, t_a = None, 0.0
     try:

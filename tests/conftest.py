@@ -32,6 +32,33 @@ if not os.environ.get("JT_NO_TEST_CACHE"):
 import pytest
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Record the compile budget (VERDICT r04 item 7): how many XLA
+    executables the suite compiled fresh vs served from the persistent
+    cache this run.  Printed in the terminal summary."""
+    d = os.environ.get("BENCH_CACHE_DIR")
+    if not d or not os.path.isdir(d):
+        return
+    entries = os.listdir(d)
+    t0 = getattr(session, "_jt_t0", None)
+    fresh = 0
+    if t0 is not None:
+        for e in entries:
+            try:
+                if os.path.getmtime(os.path.join(d, e)) >= t0:
+                    fresh += 1
+            except OSError:
+                pass
+    print(f"\n[jepsen-tpu] persistent compile cache: {len(entries)} "
+          f"entries, {fresh} written this run ({d})")
+
+
+def pytest_sessionstart(session):
+    import time
+
+    session._jt_t0 = time.time()
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Release compiled executables between test modules.

@@ -119,3 +119,14 @@ def test_device_host_parity_with_sessions():
         b = oracle.check(h, consistency_models=models)
         assert a["valid?"] == b["valid?"], (models, a, b)
         assert a["anomaly-types"] == b["anomaly-types"], (models, a, b)
+
+
+def test_g0_process_request_does_not_cover_session_tokens():
+    """G0-process/G1c-process projections lack rw edges, so they cannot
+    stand in for read-centric session checks on packed input (review
+    r05 finding)."""
+    p = pack_txns(_valid_la_history(), "list-append")
+    res = list_append.check(p, consistency_models=("causal",),
+                            anomalies=("G0-process",))
+    assert res["valid?"] == "unknown", res
+    assert "monotonic-reads-violation" in res["unchecked-anomalies"]
