@@ -66,8 +66,10 @@ class RegDB(db_proto.DB, db_proto.Process, db_proto.Primary,
         }
 
     def _pattern(self, test, node) -> str:
-        """grepkill pattern: unique per node via its --name flag."""
-        return f"regd.py --name {node} "
+        """grepkill pattern: unique per node AND per suite instance
+        (the port embeds base_port, so concurrent suites on different
+        ports never cross-kill each other's daemons)."""
+        return f"regd.py --name {node} --port {self.port(test, node)} "
 
     # ---- DB protocol ----------------------------------------------------
     def setup(self, test, node):
@@ -75,8 +77,7 @@ class RegDB(db_proto.DB, db_proto.Process, db_proto.Primary,
         c.exec_("mkdir", "-p", p["dir"])
         # install: ship the daemon source through the control plane
         c.upload([DAEMON_SRC], p["bin"])
-        self.start(test, node)
-        self._await_ready(test, node)
+        self.start_and_await(test, node)
 
     def teardown(self, test, node):
         p = self._paths(test, node)
@@ -97,6 +98,13 @@ class RegDB(db_proto.DB, db_proto.Process, db_proto.Primary,
         cu.start_daemon(sys.executable, *args,
                         logfile=p["log"], pidfile=p["pid"])
 
+    def start_and_await(self, test, node):
+        """Start the daemon and block until it answers pings — the
+        sequence both setup and restart nemeses need (readiness policy
+        lives in exactly one place)."""
+        self.start(test, node)
+        self._await_ready(test, node)
+
     def kill(self, test, node):
         # the crash path: SIGKILL by pattern, no graceful anything
         cu.grepkill(self._pattern(test, node))
@@ -115,7 +123,9 @@ class RegDB(db_proto.DB, db_proto.Process, db_proto.Primary,
         return [p["log"], p["wal"]]
 
     # ---- helpers --------------------------------------------------------
-    def _await_ready(self, test, node, timeout_s: float = 10.0):
+    def _await_ready(self, test, node, timeout_s: float = 60.0):
+        # generous: bare python startup measured 4.5 s on this box while
+        # an XLA compile owned the single core
         import time
 
         deadline = time.monotonic() + timeout_s
@@ -189,7 +199,12 @@ def _make_test(opts: Dict[str, Any], name: str, stale_reads: bool
     from jepsen_tpu.generator import core as g
     from jepsen_tpu.workloads import append
 
-    wl = append.workload()
+    # thread the requested models into the checker: backup staleness is
+    # LEGAL under plain serializable (reads serialize early); only a
+    # realtime-aware model makes the stale-read hole visible
+    models = tuple(opts.get("consistency-models",
+                            ("strict-serializable",)))
+    wl = append.workload(consistency_models=models)
     database = RegDB(base_port=int(opts.get("base-port", 7610)),
                      stale_reads=stale_reads)
     test = dict(opts)
