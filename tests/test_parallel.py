@@ -273,19 +273,23 @@ def test_check_batch_hybrid_overflow_fallback():
 
 
 @pytest.mark.skipif(not os.environ.get("JT_SCALE_TESTS"),
-                    reason="set JT_SCALE_TESTS=1: ~15 min, 4 x 500k-txn "
+                    reason="set JT_SCALE_TESTS=1: ~15 min, 4 x 200k-txn "
                            "hybrid (dcn x k) differential")
 def test_check_batch_hybrid_500k():
-    # config-5 rehearsal at scale: 4 x 500k-txn histories over a (2, 4)
+    # config-5 rehearsal at scale: 4 x 200k-txn histories over a (2, 4)
     # mesh — batch rows x sweep windows — bitwise-equal to the unsharded
-    # batch path.  500k, not 1M: on the VIRTUAL mesh all 8 devices'
-    # replicated inference intermediates live in one host's RAM (4 x 1M
-    # aborts in the XLA:CPU allocator here); on real chips each device
-    # owns its HBM and the per-device footprint is ~1 GB at 1M.
+    # batch path.  200k, not 1M: the virtual mesh serializes every
+    # device onto the host cores, and XLA:CPU's collective rendezvous
+    # hard-aborts (CHECK-fail) when participants arrive > 40 s apart —
+    # on a single-core host the per-device inference at 500k shapes
+    # already exceeds that (measured round 5; 500k passed on the
+    # earlier multi-core box).  On real chips devices run in parallel
+    # and the constraint vanishes; the per-device footprint is ~1 GB
+    # at 1M.
     from jepsen_tpu.parallel.hybrid import check_batch_hybrid, \
         make_hybrid_mesh
 
-    ps = [synth.packed_la_history(n_txns=500_000, n_keys=62_500,
+    ps = [synth.packed_la_history(n_txns=200_000, n_keys=25_000,
                                   mops_per_txn=4, read_frac=0.25, seed=s)
           for s in range(4)]
     got = check_batch_hybrid(ps, make_hybrid_mesh(2, 4))
