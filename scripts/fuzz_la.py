@@ -21,7 +21,11 @@ from jepsen_tpu.checkers.elle.specs import NONADJACENT_FAMILY
 from jepsen_tpu.workloads import synth
 
 MODELS_POOL = [["strict-serializable"], ["serializable"],
-               ["snapshot-isolation"], ["read-committed"]]
+               ["snapshot-isolation"], ["read-committed"],
+               # round 5: session-aware requests — exercises
+               # sessions.check_la + the coverage contract on both
+               # sides of the differential
+               ["causal"], ["PRAM"], ["monotonic-reads"]]
 
 
 def _valid_nonadjacent_witness(entry):
