@@ -115,14 +115,18 @@ def unchecked_for_la(want: Set[str], sess_checked: bool) -> list:
     return sorted(want - searched)
 
 
-def finalize_la(result: Dict[str, Any], want: Set[str],
-                sess_checked: bool) -> Dict[str, Any]:
-    """Apply the coverage contract to a finished verdict: a would-be
-    `valid?: True` with unsearched requested anomalies becomes
-    `"unknown"`, and the unchecked list is always surfaced."""
-    unchecked = unchecked_for_la(want, sess_checked)
+def apply_unchecked(result: Dict[str, Any], unchecked) -> Dict[str, Any]:
+    """The degradation rule, shared by every checker: surface the
+    unchecked list, and downgrade a would-be `valid?: True` to
+    `"unknown"` (an oracle that cannot look must say so)."""
     if unchecked:
-        result["unchecked-anomalies"] = unchecked
+        result["unchecked-anomalies"] = sorted(unchecked)
         if result["valid?"] is True:
             result["valid?"] = "unknown"
     return result
+
+
+def finalize_la(result: Dict[str, Any], want: Set[str],
+                sess_checked: bool) -> Dict[str, Any]:
+    """Apply the coverage contract to a finished list-append verdict."""
+    return apply_unchecked(result, unchecked_for_la(want, sess_checked))

@@ -100,7 +100,9 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
     # (monotonic-reads, RYW) surface through anti-dependency (rw)
     # edges, which the G0-process/G1c-process projections never search
     proc_covered = "G-single-process" in want
-    sess_unchecked = sorted(w[:-len(suffix)] for w in sess_want) \
+    # full "-violation" tokens, matching the la checkers' key shape
+    # (coverage.finalize_la) so callers see ONE degradation contract
+    sess_unchecked = sorted(sess_want) \
         if (sess_want and isinstance(history, PackedTxns)
             and not proc_covered) else []
     if sess_want and not isinstance(history, PackedTxns):
@@ -112,10 +114,9 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
         sess_found = sres["anomalies"]
 
     def finalize(result: Dict[str, Any]) -> Dict[str, Any]:
-        if sess_unchecked and result["valid?"] is True:
-            result["valid?"] = "unknown"
-            result["unchecked-guarantees"] = sess_unchecked
-        return result
+        from jepsen_tpu.checkers.elle import coverage
+
+        return coverage.apply_unchecked(result, sess_unchecked)
 
     if use_device and p.n_txns >= FUSED_MIN_TXNS:
         from jepsen_tpu.checkers.elle import device_rw

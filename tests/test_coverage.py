@@ -130,3 +130,15 @@ def test_g0_process_request_does_not_cover_session_tokens():
                             anomalies=("G0-process",))
     assert res["valid?"] == "unknown", res
     assert "monotonic-reads-violation" in res["unchecked-anomalies"]
+
+
+def test_rw_packed_bare_session_request_degrades():
+    """The rw checker's inline degradation follows the same contract
+    and key shape as the la checkers (review r05 finding: this path
+    had no coverage)."""
+    from jepsen_tpu.checkers.elle import rw_register
+
+    p = synth.packed_rw_history(n_txns=150, n_keys=8, seed=2)
+    res = rw_register.check(p, consistency_models=("causal",))
+    assert res["valid?"] == "unknown", res
+    assert "monotonic-reads-violation" in res["unchecked-anomalies"]
