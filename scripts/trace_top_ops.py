@@ -1,0 +1,73 @@
+"""Aggregate device-op time from a JAX profiler trace directory.
+
+`profiling.trace` (and bench.py's BENCH_PROFILE_DIR) write a Perfetto /
+Chrome-trace JSON under <dir>/plugins/profile/<run>/*.trace.json.gz.
+This summarizes where the device time goes without TensorBoard: top HLO
+ops by total duration, grouped by fusion/op name, per device pid.
+
+Usage: python scripts/trace_top_ops.py /tmp/jax-trace [top_n]
+"""
+
+import gzip
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def find_trace(root):
+    pats = [os.path.join(root, "plugins", "profile", "*", "*.trace.json.gz"),
+            os.path.join(root, "**", "*.trace.json.gz")]
+    for pat in pats:
+        hits = sorted(glob.glob(pat, recursive=True))
+        if hits:
+            return hits[-1]  # latest run
+    raise SystemExit(f"no *.trace.json.gz under {root}")
+
+
+def main():
+    root = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    path = find_trace(root)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+
+    # map pid -> process name (device rows are "/device:TPU:0" etc.)
+    pid_name = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e["pid"]] = e.get("args", {}).get("name", "")
+
+    device_pids = {p for p, n in pid_name.items()
+                   if "device:" in n.lower() or "tpu" in n.lower()
+                   or "xla" in n.lower()}
+    # fall back: any pid with complete ("X") events that isn't python/host
+    if not device_pids:
+        device_pids = {p for p, n in pid_name.items() if "python" not in
+                       n.lower() and "host" not in n.lower()}
+
+    agg = defaultdict(lambda: [0.0, 0])  # name -> [total_us, count]
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        dur = float(e.get("dur", 0))
+        name = e.get("name", "?")
+        agg[name][0] += dur
+        agg[name][1] += 1
+        total += dur
+
+    print(f"trace: {path}")
+    print(f"device pids: {sorted((p, pid_name.get(p)) for p in device_pids)}")
+    print(f"total device-op time: {total/1e6:.3f} s over {len(agg)} "
+          f"distinct ops\n")
+    print(f"{'total_s':>9} {'%':>5} {'count':>7}  name")
+    for name, (us, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0])[:top_n]:
+        print(f"{us/1e6:9.3f} {100*us/max(total,1e-9):5.1f} {cnt:7d}  "
+              f"{name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
