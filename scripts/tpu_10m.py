@@ -13,6 +13,7 @@ deliverable.
 Usage: python scripts/tpu_10m.py [n_txns]  (default 10M; needs TPU free)
 """
 
+import os
 import sys
 import time
 
@@ -44,17 +45,22 @@ def main():
           f"T={h.txn_type.shape[0]} M={h.mop_txn.shape[0]} "
           f"R={h.rd_elems.shape[0]}", flush=True)
 
+    # HBM headroom knob: max_k sizes the (2T, max_k) label plane (4 GiB
+    # at 10M shapes with the default 128) and the (C, max_k) chain
+    # gather — the two largest sweep allocations on a 16 GiB chip
+    max_k = int(os.environ.get("JT_10M_MAX_K", 128))
+
     t0 = time.perf_counter()
-    bits, over = core_check(h, p.n_keys)
+    bits, over = core_check(h, p.n_keys, max_k=max_k)
     jax.block_until_ready(bits)
     print(f"compile+first {time.perf_counter() - t0:.1f}s "
           f"converged={int(np.asarray(bits)[-1])} "
-          f"over={int(np.asarray(over))}", flush=True)
+          f"over={int(np.asarray(over))} max_k={max_k}", flush=True)
 
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        bits, over = core_check(h, p.n_keys)
+        bits, over = core_check(h, p.n_keys, max_k=max_k)
         jax.block_until_ready(bits)
         best = min(best, time.perf_counter() - t0)
     print(f"steady {best:.2f}s = {n_txns / best:,.0f} txns/s "
@@ -70,4 +76,16 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001
+        # the campaign records 3000 chars of stdout but only 1000 of
+        # stderr, and axon/libtpu log spam can push the actual error
+        # out of that window (attempt 1 on 2026-08-01 was undiagnosable
+        # from the record) — put the traceback where it survives
+        import traceback
+
+        print("FAILED:", type(e).__name__, str(e)[:1500], flush=True)
+        traceback.print_exc(limit=5, file=sys.stdout)
+        sys.stdout.flush()
+        raise
