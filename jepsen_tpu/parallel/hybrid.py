@@ -68,12 +68,12 @@ def _hybrid_core(batch, n_keys: int, mesh: Mesh, max_k: int = 128,
         def one(h):
             out = infer(h, n_keys)
 
-            def sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_, bp_):
+            def sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_, bp_, bt_):
                 off = jax.lax.axis_index("k") * k_local
                 return _sweep_window(2 * T, max_k, k_local, max_rounds,
                                      rank_, e_src_, e_dst_, m_, cn_, cs_,
                                      cm_, k_offset=off, axis_name="k",
-                                     back_pre=bp_)
+                                     back_pre=bp_, back_tables=bt_)
 
             return projection_sweep_bits(out, max_k, sweep)
 

@@ -52,9 +52,10 @@ from jepsen_tpu.ops.cycle_sweep import _sweep_window
 def projection_sweep_bits(out, max_k: int, sweep):
     """The 5-projection scan over an inferred edge set, with `sweep` a
     callable (rank, e_src, e_dst, mask, chain_nodes, chain_starts,
-    chain_mask, back_pre) -> (has_cycle, witness, n_back, converged);
-    back_pre is the hoisted backward enumeration (is_back, back_id,
-    n_back) that `_sweep_window` consumes directly.
+    chain_mask, back_pre, back_tables) -> (has_cycle, witness, n_back,
+    converged); back_pre is the hoisted backward enumeration (is_back,
+    back_id, n_back) and back_tables the searchsorted-built (max_k,)
+    (bsrc, bdst) endpoint pair that `_sweep_window` consumes directly.
 
     One sweep instantiation scanned over the 5 projections — same
     compile-time + label-plane-memory rationale as device_core.core_check
@@ -116,19 +117,20 @@ def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
     rep = P()
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(rep,) * 10, out_specs=(rep, rep, rep, rep))
+             in_specs=(rep,) * 12, out_specs=(rep, rep, rep, rep))
     def sharded_sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_,
-                      ib_, bid_, nb_):
+                      ib_, bid_, nb_, bsrc_, bdst_):
         off = jax.lax.axis_index(axis) * k_local
         return _sweep_window(2 * T, max_k, k_local, max_rounds,
                              rank_, e_src_, e_dst_, m_, cn_, cs_, cm_,
                              k_offset=off, axis_name=axis,
-                             back_pre=(ib_, bid_, nb_))
+                             back_pre=(ib_, bid_, nb_),
+                             back_tables=(bsrc_, bdst_))
 
     return projection_sweep_bits(
         out, max_k,
-        lambda r, s, d, m, cn, cs, cm, bp: sharded_sweep(
-            r, s, d, m, cn, cs, cm, *bp))
+        lambda r, s, d, m, cn, cs, cm, bp, bt: sharded_sweep(
+            r, s, d, m, cn, cs, cm, *bp, *bt))
 
 
 def shard_padded(h: PaddedLA, mesh: Mesh, axis: str = "dp"
