@@ -46,6 +46,7 @@ from jepsen_tpu.history.soa import (
     TXN_OK,
     PackedTxns,
 )
+from jepsen_tpu.ops import pallas_fill
 from jepsen_tpu.ops.segments import (
     segment_ids_from_starts,
     segmented_cummax,
@@ -285,6 +286,7 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # which is the containing one) — a scatter + cummax forward fill, an
     # O(R) replacement for the former O(R log nk) searchsorted
     slot = jnp.arange(R, dtype=jnp.int32)
+    slot_valid = slot < total_ord
     if nk == 1:
         # single key: every slot is key 0.  Also dodges a real compile
         # cost: with nk == 1 the scatter seed below is compile-time
@@ -292,16 +294,51 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
         # constant-folds the cummax's R-sized reduce-window tree
         # interpretively — measured 1-18 s of compile per shape.
         slot_key = jnp.zeros(R, jnp.int32)
+        slot_off = slot
+        src_read0 = ord_read[0]
+        src_start = jnp.where(
+            src_read0 >= 0,
+            h.mop_rd_start[jnp.clip(src_read0, 0, M - 1)], 0)
+    elif pallas_fill.fill_enabled():
+        # TPU: the three slot_key-indexed expansions (slot_key itself,
+        # ord_start[slot_key], rd_start[ord_read[slot_key]]) are
+        # monotone/segment-constant fills — seed per-key values at the
+        # segment starts and forward-fill with the single-pass Pallas
+        # LOCF kernel instead of R-sized gathers (measured ~0.45 s per
+        # gather at R = 2^24 on chip).  slot_key's seed is scatter-MAX
+        # over possibly-shared starts (zero-length keys) exactly as the
+        # lax path, and its seeded values are non-decreasing, so LOCF
+        # is bitwise cummax.  The value channels seed only n_elems > 0
+        # keys (unique starts): every valid slot's containing key has
+        # elements, and invalid slots are masked by slot_valid.
+        key_ids = jnp.arange(nk, dtype=jnp.int32)
+        sk_seed = jnp.full(R + 1, -1, jnp.int32).at[
+            jnp.clip(ord_start, 0, R)].max(key_ids)[:R]
+        slot_key = jnp.clip(pallas_fill.locf_flat(sk_seed), 0, nk - 1)
+        nonempty = ord_len > 0
+        pos_ne = jnp.where(nonempty, ord_start, R)
+        osv_seed = jnp.full(R + 1, -1, jnp.int32).at[
+            jnp.clip(pos_ne, 0, R)].max(
+            jnp.where(nonempty, ord_start, -1))[:R]
+        # per-key rd_start of the chosen longest read (ord_len > 0
+        # implies ord_read >= 0)
+        srcst_k = h.mop_rd_start[jnp.clip(ord_read, 0, M - 1)]
+        srcst_seed = jnp.full(R + 1, -1, jnp.int32).at[
+            jnp.clip(pos_ne, 0, R)].max(
+            jnp.where(nonempty, srcst_k, -1))[:R]
+        ord_start_f = pallas_fill.locf_flat(osv_seed)
+        src_start = pallas_fill.locf_flat(srcst_seed)
+        slot_off = slot - jnp.where(ord_start_f >= 0, ord_start_f, 0)
+        src_start = jnp.where(src_start >= 0, src_start, 0)
     else:
         key_ids = jnp.arange(nk, dtype=jnp.int32)
         sk_seed = jnp.full(R + 1, -1, jnp.int32).at[
             jnp.clip(ord_start, 0, R)].max(key_ids)[:R]
         slot_key = jnp.clip(jax.lax.cummax(sk_seed), 0, nk - 1)
-    slot_off = slot - ord_start[slot_key]
-    slot_valid = slot < total_ord
-    src_read = ord_read[slot_key]
-    src_start = jnp.where(src_read >= 0,
-                          h.mop_rd_start[jnp.clip(src_read, 0, M - 1)], 0)
+        slot_off = slot - ord_start[slot_key]
+        src_read = ord_read[slot_key]
+        src_start = jnp.where(src_read >= 0,
+                              h.mop_rd_start[jnp.clip(src_read, 0, M - 1)], 0)
     ord_elems = jnp.where(
         slot_valid, h.rd_elems[jnp.clip(src_start + slot_off, 0, R - 1)], -1)
     cv = jnp.clip(ord_elems, 0, V - 1)
@@ -314,13 +351,42 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     seed = jnp.full(R + 1, -1, jnp.int32).at[
         jnp.where(has_elems, h.mop_rd_start, R)].max(
         jnp.where(has_elems, mop_pos, -1))[:R]
-    elem_read = jax.lax.cummax(seed)
-    er = jnp.clip(elem_read, 0, M - 1)
-    elem_off = slot - h.mop_rd_start[er]
+
+    def _aseed(vals):
+        # value channel seeded at the same (unique) read-start slots
+        return jnp.full(R + 1, -1, jnp.int32).at[
+            jnp.where(has_elems, h.mop_rd_start, R)].max(
+            jnp.where(has_elems, vals.astype(jnp.int32), -1))[:R]
+
+    if pallas_fill.fill_enabled():
+        # TPU: forward-fill the owning-read id AND the four per-read
+        # table values in one Pallas pass each, replacing lax.cummax
+        # plus four R-sized `table[er]` gathers (~0.45 s each at
+        # R = 2^24 on chip, PROFILE.md round-5 trace).  elem_read is
+        # bitwise cummax (monotone seeds); the value channels replicate
+        # the legacy `table[clip(er, 0, M-1)]` exactly, including
+        # table[0] on the leading er == -1 prefix.
+        elem_read = pallas_fill.locf_flat(seed)
+        hole = elem_read < 0
+        erd_start = jnp.where(hole, h.mop_rd_start[0],
+                              pallas_fill.locf_flat(_aseed(h.mop_rd_start)))
+        erd_len = jnp.where(hole, h.mop_rd_len[0],
+                            pallas_fill.locf_flat(_aseed(h.mop_rd_len)))
+        elem_key = jnp.where(hole, h.mop_key[0],
+                             pallas_fill.locf_flat(_aseed(h.mop_key)))
+        elem_txn = jnp.where(hole, h.mop_txn[0],
+                             pallas_fill.locf_flat(_aseed(h.mop_txn)))
+        er = jnp.clip(elem_read, 0, M - 1)
+    else:
+        elem_read = jax.lax.cummax(seed)
+        er = jnp.clip(elem_read, 0, M - 1)
+        erd_start = h.mop_rd_start[er]
+        erd_len = h.mop_rd_len[er]
+        elem_key = h.mop_key[er]
+        elem_txn = h.mop_txn[er]
+    elem_off = slot - erd_start
     elem_in_read = h.rd_elem_mask & (elem_read >= 0) & (elem_off >= 0) & \
-        (elem_off < h.mop_rd_len[er])
-    elem_key = h.mop_key[er]
-    elem_txn = h.mop_txn[er]
+        (elem_off < erd_len)
     ev = jnp.clip(h.rd_elems, 0, V - 1)
 
     # incompatible-order: element disagrees with its key's version order
@@ -376,7 +442,7 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
         operand=None), 1)
 
     # G1b: last element of a read is an intermediate append of another txn
-    is_last_elem = elem_in_read & (elem_off == h.mop_rd_len[er] - 1)
+    is_last_elem = elem_in_read & (elem_off == erd_len - 1)
     g1b = is_last_elem & (writer[ev] >= 0) & (~is_final[ev]) & \
         (writer[ev] != elem_txn)
     g1b_count = jnp.sum(g1b.astype(jnp.int32))
@@ -420,11 +486,32 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # element-side content check: element at offset o of read m belongs to
     # the appends-since-last-read window iff o >= base; it must then equal
     # the append at run position q(m) - n + (o - base)
-    er_run = inv_run[er]                          # run position of the read
-    er_n = n_app_before[jnp.clip(er_run, 0, M - 1)]
-    er_have = have_prev[jnp.clip(er_run, 0, M - 1)]
-    er_prev_len = prev_len[jnp.clip(er_run, 0, M - 1)]
-    base = jnp.where(er_have, er_prev_len, h.mop_rd_len[er] - er_n)
+    if pallas_fill.fill_enabled():
+        # same Pallas LOCF expansion as the read-element table above:
+        # all four are per-read constants, so compose them per-mop
+        # (M-sized gathers, ~4x cheaper than R-sized on chip), seed at
+        # the read starts, and fill — replacing four more R-sized
+        # gathers.  The leading er == -1 prefix replicates the legacy
+        # clip-to-mop-0 values.
+        erc = jnp.clip(inv_run, 0, M - 1)
+        comp_n = n_app_before[erc]
+        comp_have = have_prev[erc].astype(jnp.int32)
+        comp_prev_len = prev_len[erc]
+
+        def _rfill(valsM):
+            f = pallas_fill.locf_flat(_aseed(valsM))
+            return jnp.where(hole, valsM[0].astype(jnp.int32), f)
+
+        er_run = _rfill(inv_run)
+        er_n = _rfill(comp_n)
+        er_have = _rfill(comp_have) != 0
+        er_prev_len = _rfill(comp_prev_len)
+    else:
+        er_run = inv_run[er]                      # run position of the read
+        er_n = n_app_before[jnp.clip(er_run, 0, M - 1)]
+        er_have = have_prev[jnp.clip(er_run, 0, M - 1)]
+        er_prev_len = prev_len[jnp.clip(er_run, 0, M - 1)]
+    base = jnp.where(er_have, er_prev_len, erd_len - er_n)
     j = elem_off - base
     in_window = elem_in_read & (j >= 0) & (j < er_n)
     exp_val = val2[jnp.clip(er_run - er_n + j, 0, M - 1)]

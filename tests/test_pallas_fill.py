@@ -1,0 +1,133 @@
+"""Differential tests for the Pallas LOCF forward-fill kernel
+(`ops/pallas_fill.py`) and its integration into edge inference.
+
+Protocol mirrors tests/test_pallas.py: the pure-JAX grid emulator
+(`locf_blocked_reference`) — same block math, explicit sequential
+carry — anchors the kernel on any backend; the emulator is checked
+against the O(log n) lax scan here, and the whole device_infer
+kernel-branch restructuring is driven through the emulator
+(JT_PALLAS=1 + JT_PALLAS_EMULATE=1) and compared bitwise against the
+default lax path on full checker verdicts.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from jepsen_tpu.ops.pallas_fill import (  # noqa: E402
+    HOLE,
+    locf_blocked_reference,
+    locf_lax,
+)
+
+
+def _random_seed_array(rng, n, density, monotone=False):
+    x = np.full(n, HOLE, np.int32)
+    pos = rng.random(n) < density
+    vals = rng.integers(0, 1_000_000, size=int(pos.sum()))
+    if monotone:
+        vals = np.sort(vals)
+    x[np.nonzero(pos)[0]] = vals
+    return jnp.asarray(x)
+
+
+@pytest.mark.parametrize("n", [1, 7, 128, 129, 1000, 4096, 200_000])
+@pytest.mark.parametrize("density", [0.0, 0.01, 0.5, 1.0])
+def test_emulator_matches_lax(n, density):
+    rng = np.random.default_rng(n * 1000 + int(density * 100))
+    x = _random_seed_array(rng, n, density)
+    got = np.asarray(locf_blocked_reference(x, block=8))
+    want = np.asarray(locf_lax(x))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_emulator_matches_cummax_on_monotone_seeds():
+    rng = np.random.default_rng(7)
+    x = _random_seed_array(rng, 50_000, 0.05, monotone=True)
+    got = np.asarray(locf_blocked_reference(x))
+    want = np.asarray(jax.lax.cummax(x))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_emulator_adversarial_layouts():
+    cases = [
+        jnp.full(300, HOLE, jnp.int32),                       # all holes
+        jnp.arange(300, dtype=jnp.int32),                     # no holes
+        jnp.asarray([HOLE] * 299 + [5], jnp.int32),           # one at end
+        jnp.asarray([5] + [HOLE] * 299, jnp.int32),           # one at start
+        # value at every block boundary only
+        jnp.asarray([v if i % 128 == 0 else HOLE
+                     for i, v in enumerate(range(300))], jnp.int32),
+    ]
+    for x in cases:
+        np.testing.assert_array_equal(
+            np.asarray(locf_blocked_reference(x, block=8)),
+            np.asarray(locf_lax(x)))
+
+
+def test_locf_flat_vmap_exact():
+    from jepsen_tpu.ops.pallas_fill import locf_flat
+
+    rng = np.random.default_rng(11)
+    xs = jnp.stack([_random_seed_array(rng, 500, 0.1) for _ in range(4)])
+    got = np.asarray(jax.vmap(locf_flat)(xs))
+    want = np.asarray(jax.vmap(locf_lax)(xs))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_infer_kernel_branch_matches_legacy():
+    """The full device_infer kernel-branch restructure, driven through
+    the emulator on this backend, must reproduce the legacy core_check
+    bits exactly — including on histories with seeded anomalies."""
+    import dataclasses
+
+    from jepsen_tpu.checkers.elle.device_core import core_check
+    from jepsen_tpu.checkers.elle.device_infer import pad_packed
+    from jepsen_tpu.history.soa import TXN_FAIL
+    from jepsen_tpu.workloads import synth
+
+    # odd sizes -> unique padded shapes -> fresh jit traces under each
+    # env setting (the branch is chosen at trace time from the env)
+    padded = []
+    for n, nk, seed in [(531, 7, 3), (1043, 1, 4), (775, 19, 5),
+                        (777, 5, 6)]:
+        p = synth.packed_la_history(n_txns=n, n_keys=nk, seed=seed)
+        h = pad_packed(p)
+        if seed == 5:
+            # aborted writer whose appends stay visible -> G1a et al.
+            h = dataclasses.replace(
+                h, txn_type=h.txn_type.at[0].set(TXN_FAIL))
+        if seed == 6:
+            # corrupt one read element -> incompatible-order / internal
+            h = dataclasses.replace(
+                h, rd_elems=h.rd_elems.at[3].set(h.rd_elems[9]))
+        padded.append((h, p.n_keys))
+    results = {}
+    for mode, env in [("legacy", {"JT_PALLAS": "0"}),
+                      ("kernel", {"JT_PALLAS": "1",
+                                  "JT_PALLAS_EMULATE": "1"})]:
+        old = {k: os.environ.get(k) for k in
+               ("JT_PALLAS", "JT_PALLAS_EMULATE")}
+        os.environ.update(env)
+        # the env branch is chosen at trace time: drop cached traces so
+        # the second mode doesn't silently reuse the first mode's program
+        core_check.clear_cache()
+        try:
+            outs = []
+            for h, nk in padded:
+                bits, over = core_check(h, nk)
+                outs.append((np.asarray(bits), int(over)))
+            results[mode] = outs
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    for (gb, go), (wb, wo) in zip(results["kernel"], results["legacy"]):
+        np.testing.assert_array_equal(gb, wb)
+        assert go == wo
