@@ -85,10 +85,27 @@ def _block_fill(v, block: int, roll):
     return jnp.where(v == HOLE, prev, v)
 
 
+def _replicate_last_lane(row, roll):
+    """(1, 128) -> (1, 128) with every lane = input lane 127, via
+    cyclic-roll doubling (Mosaic has no (1,1)->(1,128) broadcast; a
+    full replicated row sidesteps it — the same reason the OR kernel
+    carries a (1, K) row).  Shared by kernel and emulator."""
+    lanes = jax.lax.broadcasted_iota(jnp.int32, row.shape, 1)
+    v = jnp.where(lanes == _LANES - 1, row, HOLE)
+    dist = 1
+    while dist < _LANES:
+        # cyclic roll by -dist: lane l reads lane l+dist (mod 128);
+        # only lane 127 is non-hole initially, so this backward-fills
+        v_p = roll(v, _LANES - dist, 1)
+        v = jnp.where(v == HOLE, v_p, v)
+        dist *= 2
+    return v
+
+
 def _fill_kernel(block: int, v_ref, o_ref, carry_ref):
     """One grid step: in-block fill + carry absorb/update.  carry_ref is
-    (8, 128) int32 VMEM scratch; [0, 0] holds the last non-hole value of
-    all previous blocks (or HOLE)."""
+    (8, 128) int32 VMEM scratch; row 0 holds the last non-hole value of
+    all previous blocks (or HOLE), replicated across lanes."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -98,14 +115,15 @@ def _fill_kernel(block: int, v_ref, o_ref, carry_ref):
     def _():
         carry_ref[...] = jnp.full_like(carry_ref, HOLE)
 
+    roll = lambda x, d, ax: pltpu.roll(x, shift=d, axis=ax)  # noqa: E731
     v = v_ref[...]
-    out = _block_fill(v, block,
-                      lambda x, d, ax: pltpu.roll(x, shift=d, axis=ax))
-    carry = carry_ref[0:1, 0:1]                          # (1, 1)
+    out = _block_fill(v, block, roll)
+    carry = carry_ref[0:1, :]                            # (1, 128)
     out = jnp.where(out == HOLE, carry, out)
     # new carry = last flat element (already carry-absorbed, so a fully
-    # empty block propagates the old carry)
-    carry_ref[0:1, 0:1] = out[block - 1:block, _LANES - 1:_LANES]
+    # empty block propagates the old carry), replicated across lanes
+    carry_ref[0:1, :] = _replicate_last_lane(
+        out[block - 1:block, :], roll)
     o_ref[...] = out
 
 
@@ -153,12 +171,13 @@ def locf_blocked_reference(x: jnp.ndarray,
     v2d, n = _pad_2d(x, block)
     block = min(block, v2d.shape[0])
     outs = []
-    carry = jnp.full((1, 1), HOLE, jnp.int32)
+    roll = lambda a, d, ax: jnp.roll(a, d, ax)  # noqa: E731
+    carry = jnp.full((1, _LANES), HOLE, jnp.int32)
     for b in range(v2d.shape[0] // block):
         vb = v2d[b * block:(b + 1) * block]
-        out = _block_fill(vb, block, lambda a, d, ax: jnp.roll(a, d, ax))
+        out = _block_fill(vb, block, roll)
         out = jnp.where(out == HOLE, carry, out)
-        carry = out[block - 1:block, _LANES - 1:_LANES]
+        carry = _replicate_last_lane(out[block - 1:block, :], roll)
         outs.append(out)
     return jnp.concatenate(outs).reshape(-1)[:n]
 
