@@ -66,16 +66,11 @@ def chain_include_stack(projections=PROJECTIONS) -> jnp.ndarray:
         for p in projections], jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("n_keys", "max_k", "max_rounds"))
-def core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
-               max_rounds: int = 64) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (bits, overflowed):
-    bits: (13,) int32 — counts/flags per the module docstring, last slot is
-    converged (1 = trustworthy).
-    overflowed: int32 — max backward edges seen beyond max_k (0 = exact).
-    """
-    out = infer(h, n_keys)
-    T = h.txn_type.shape[0]
+def _verdict(out, max_k: int, max_rounds: int):
+    """Sweep half of the core check: infer output -> (bits, overflowed).
+    Plain function — jitted fused with infer by `core_check`, or as its
+    own (much smaller) XLA program by `core_check_staged`."""
+    T = out["ranks"]["txn"].shape[0]
     edges = out["edges"]
     chains = out["chains"]
     rank = jnp.concatenate([out["ranks"]["txn"], out["ranks"]["barrier"]])
@@ -108,6 +103,68 @@ def core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
     bits = jnp.concatenate(
         [counts, cyc_bits, conv_all.astype(jnp.int32)[None]])
     return bits, overflow
+
+
+@partial(jax.jit, static_argnames=("n_keys", "max_k", "max_rounds"))
+def core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
+               max_rounds: int = 64) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (bits, overflowed):
+    bits: (13,) int32 — counts/flags per the module docstring, last slot is
+    converged (1 = trustworthy).
+    overflowed: int32 — max backward edges seen beyond max_k (0 = exact).
+    """
+    return _verdict(infer(h, n_keys), max_k, max_rounds)
+
+
+@partial(jax.jit, static_argnames=("n_keys",))
+def _infer_stage(h: PaddedLA, n_keys: int):
+    # only the keys _verdict consumes: materializing the full infer dict
+    # would keep the R-sized order table (+ witnesses) live in HBM at
+    # exactly the 10M shapes this path exists for — the fused program
+    # dead-code-eliminates them, so the staged one must drop them too
+    out = infer(h, n_keys)
+    return {k: out[k] for k in ("counts", "edges", "chains", "ranks")}
+
+
+@partial(jax.jit, static_argnames=("max_k", "max_rounds"))
+def _sweep_stage(out, max_k: int, max_rounds: int):
+    return _verdict(out, max_k, max_rounds)
+
+
+def core_check_staged(h: PaddedLA, n_keys: int, max_k: int = 128,
+                      max_rounds: int = 64,
+                      verbose: bool = False
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """core_check as TWO separately-compiled XLA programs (infer, then
+    sweep) with the intermediate edge/chain arrays materialized on
+    device.
+
+    Bitwise-equal to `core_check` (same `_verdict` body; the only
+    difference is the stage boundary).  Exists because the axon
+    remote-compile service drops the connection on the single fused
+    program at 2^24-txn shapes (PROFILE.md §-1d: `remote_compile:
+    Network Error: Unexpected EOF` — server-side XLA death, three
+    campaign attempts) while 2^20-shape programs compile fine; halving
+    per-program complexity is the lever.  Costs on acyclic histories are
+    negligible: the steady state is all inference (PROFILE.md §-1c) and
+    the lost infer→sweep fusion only re-reads the materialized COO
+    edges (~3 GB at 10M shapes, well under a transient of the fused
+    program's own sort workspaces)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    out = _infer_stage(h, n_keys)
+    jax.block_until_ready(out)
+    if verbose:
+        print(f"  staged: infer {_time.perf_counter() - t0:.1f}s",
+              flush=True)
+    t0 = _time.perf_counter()
+    res = _sweep_stage(out, max_k=max_k, max_rounds=max_rounds)
+    jax.block_until_ready(res)
+    if verbose:
+        print(f"  staged: sweep {_time.perf_counter() - t0:.1f}s",
+              flush=True)
+    return res
 
 
 

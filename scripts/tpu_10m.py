@@ -16,6 +16,7 @@ Usage: python scripts/tpu_10m.py [n_txns]  (default 10M; needs TPU free)
 import os
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, ".")
 
@@ -30,7 +31,8 @@ def main():
     enable_compile_cache()
     print("backend:", jax.default_backend(), flush=True)
 
-    from jepsen_tpu.checkers.elle.device_core import core_check
+    from jepsen_tpu.checkers.elle.device_core import (core_check,
+                                                      core_check_staged)
     from jepsen_tpu.checkers.elle.device_infer import pad_packed
     from jepsen_tpu.utils import prestage
 
@@ -49,9 +51,19 @@ def main():
     # at 10M shapes with the default 128) and the (C, max_k) chain
     # gather — the two largest sweep allocations on a 16 GiB chip
     max_k = int(os.environ.get("JT_10M_MAX_K", 128))
+    # staged (default): two separately-compiled programs — the fused
+    # single program kills the axon remote-compile service at
+    # 2^24-txn shapes (PROFILE.md §-1d, "Unexpected EOF" x3 attempts);
+    # JT_10M_MODE=fused retries the one-program form
+    mode = os.environ.get("JT_10M_MODE", "staged")
+    if mode not in ("staged", "fused"):
+        raise SystemExit(f"JT_10M_MODE must be staged|fused, got {mode!r}")
+    check = (core_check if mode == "fused" else partial(
+        core_check_staged, verbose=True))
+    print(f"mode: {mode}", flush=True)
 
     t0 = time.perf_counter()
-    bits, over = core_check(h, p.n_keys, max_k=max_k)
+    bits, over = check(h, p.n_keys, max_k=max_k)
     jax.block_until_ready(bits)
     print(f"compile+first {time.perf_counter() - t0:.1f}s "
           f"converged={int(np.asarray(bits)[-1])} "
@@ -60,7 +72,7 @@ def main():
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        bits, over = core_check(h, p.n_keys, max_k=max_k)
+        bits, over = check(h, p.n_keys, max_k=max_k)
         jax.block_until_ready(bits)
         best = min(best, time.perf_counter() - t0)
     print(f"steady {best:.2f}s = {n_txns / best:,.0f} txns/s "

@@ -347,3 +347,29 @@ def test_layout_facts_reject_non_txn_major():
     p.mop_txn[0] = -1
     hp2 = pad_packed(p)
     assert not hp2.txn_major
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_staged_core_check_matches_fused(seed):
+    """core_check_staged (two XLA programs, the 10M remote-compile
+    workaround) is bitwise-equal to the fused core_check — valid and
+    injected-invalid histories both."""
+    from jepsen_tpu.checkers.elle.device_core import (core_check,
+                                                      core_check_staged)
+    from jepsen_tpu.checkers.elle.device_infer import pad_packed
+    from jepsen_tpu.history.soa import pack_txns
+
+    if seed == 0:
+        p = synth.packed_la_history(n_txns=2000, n_keys=16, seed=3)
+    else:
+        h = synth.la_history(n_txns=150, n_keys=5, concurrency=4,
+                             fail_prob=0.05, info_prob=0.05,
+                             multi_append_prob=0.2, seed=seed)
+        [synth.inject_g1a, synth.inject_wr_cycle,
+         synth.inject_rw_cycle][seed - 1](h)
+        p = pack_txns(h)
+    hp = pad_packed(p)
+    bits_f, over_f = core_check(hp, p.n_keys, max_k=32)
+    bits_s, over_s = core_check_staged(hp, p.n_keys, max_k=32)
+    np.testing.assert_array_equal(np.asarray(bits_f), np.asarray(bits_s))
+    assert int(over_f) == int(over_s)
