@@ -169,6 +169,26 @@ def core_check_staged(h: PaddedLA, n_keys: int, max_k: int = 128,
 
 
 
+# Padded txn capacity where the one fused program stops compiling on the
+# axon TPU remote-compile service (2^20-shape programs compile fine,
+# 2^24-shape ones die server-side — PROFILE.md §-1d).  The staged split
+# is bitwise-equal, so past the wall every caller dispatches to it; on
+# non-TPU backends there is no remote compiler and fused always works.
+STAGED_T_THRESHOLD = 1 << 24
+
+
+def core_check_auto(h: PaddedLA, n_keys: int, max_k: int = 128,
+                    max_rounds: int = 64):
+    """Shape-aware dispatch between `core_check` (fused) and
+    `core_check_staged` — the single boundary every large-shape caller
+    (bench, stream.py, core_check_exact) shares."""
+    if h.txn_type.shape[0] >= STAGED_T_THRESHOLD and \
+            jax.default_backend() == "tpu":
+        return core_check_staged(h, n_keys, max_k=max_k,
+                                 max_rounds=max_rounds)
+    return core_check(h, n_keys, max_k=max_k, max_rounds=max_rounds)
+
+
 def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
                      round_to: int = 1):
     """Host-side rebatch policy, shared by every fused-check caller.
@@ -205,6 +225,16 @@ def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
     """core_check with host-side rebatching until exact.  Returns
     (bits, overflowed) like core_check; exact iff bits[-1] == 1 and
     overflowed == 0."""
+    if h.txn_type.shape[0] >= STAGED_T_THRESHOLD and \
+            jax.default_backend() == "tpu":
+        # staged split: infer is independent of max_k/max_rounds, so a
+        # budget retry re-runs only the (cheap-on-acyclic) sweep stage —
+        # the fused program had to redo inference every retry
+        out = _infer_stage(h, n_keys)
+        jax.block_until_ready(out)
+        return grow_until_exact(
+            lambda k, r: _sweep_stage(out, max_k=k, max_rounds=r),
+            max_k, max_rounds)
     return grow_until_exact(
         lambda k, r: core_check(h, n_keys, max_k=k, max_rounds=r),
         max_k, max_rounds)

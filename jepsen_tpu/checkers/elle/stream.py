@@ -28,8 +28,7 @@ import numpy as np
 
 from jepsen_tpu.checkers.elle.device_core import (
     COUNT_NAMES,
-    core_check,
-    grow_until_exact,
+    core_check_exact,
 )
 from jepsen_tpu.checkers.elle.device_infer import (
     PaddedLA,
@@ -148,9 +147,8 @@ def check_stored(test_or_dir, workload: str = "list-append",
         res["n-txns"] = pk.n_txns
         return res
 
-    bits, over = grow_until_exact(
-        lambda k, r: core_check(h, h.n_keys, max_k=k, max_rounds=r),
-        max_k, max_rounds)
+    bits, over = core_check_exact(h, h.n_keys, max_k=max_k,
+                                  max_rounds=max_rounds)
     row = np.asarray(bits)
     over_i = int(np.asarray(over))
     counts = {n: int(row[j]) for j, n in enumerate(COUNT_NAMES)}
