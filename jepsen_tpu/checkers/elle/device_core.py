@@ -177,13 +177,19 @@ def core_check_staged(h: PaddedLA, n_keys: int, max_k: int = 128,
 STAGED_T_THRESHOLD = 1 << 24
 
 
+def _use_staged(h: PaddedLA) -> bool:
+    """One definition of the fused-vs-staged boundary, shared by
+    core_check_auto and core_check_exact so they can't drift."""
+    return h.txn_type.shape[0] >= STAGED_T_THRESHOLD and \
+        jax.default_backend() == "tpu"
+
+
 def core_check_auto(h: PaddedLA, n_keys: int, max_k: int = 128,
                     max_rounds: int = 64):
     """Shape-aware dispatch between `core_check` (fused) and
     `core_check_staged` — the single boundary every large-shape caller
     (bench, stream.py, core_check_exact) shares."""
-    if h.txn_type.shape[0] >= STAGED_T_THRESHOLD and \
-            jax.default_backend() == "tpu":
+    if _use_staged(h):
         return core_check_staged(h, n_keys, max_k=max_k,
                                  max_rounds=max_rounds)
     return core_check(h, n_keys, max_k=max_k, max_rounds=max_rounds)
@@ -225,8 +231,7 @@ def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
     """core_check with host-side rebatching until exact.  Returns
     (bits, overflowed) like core_check; exact iff bits[-1] == 1 and
     overflowed == 0."""
-    if h.txn_type.shape[0] >= STAGED_T_THRESHOLD and \
-            jax.default_backend() == "tpu":
+    if _use_staged(h):
         # staged split: infer is independent of max_k/max_rounds, so a
         # budget retry re-runs only the (cheap-on-acyclic) sweep stage —
         # the fused program had to redo inference every retry

@@ -1,0 +1,97 @@
+"""Compile the 10M staged pair via deviceless v5e topology while
+sampling this process's peak RSS: measures the compile-memory footprint
+that OOM-kills the axon remote compile helper (PROFILE.md §-1f), and
+lands the executables in the local cache as a bonus."""
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Compile the CHIP program: JT_PALLAS=1 forces the Pallas LOCF path that
+# `fill_enabled()` would otherwise gate OFF under the forced-CPU default
+# backend — without it this measures a different (lax-path) program than
+# the one that OOM-killed the remote helper (the round-5 session-2
+# "silent defeat #2", PROFILE.md §-1f).
+os.environ["JT_PALLAS"] = "1"
+
+from jepsen_tpu.utils.backend import enable_compile_cache, force_cpu_backend
+
+force_cpu_backend()
+enable_compile_cache()
+
+import jax
+import jax._src.xla_bridge as _xb
+
+# register the local libtpu as the `tpu` platform (compile-only, no
+# tunnel) so pallas lowering rules resolve; single-process only — libtpu
+# takes /tmp/libtpu_lockfile
+_xb.register_plugin(
+    "tpu",
+    library_path="/opt/venv/lib/python3.12/site-packages/libtpu/libtpu.so",
+    priority=0)
+
+from jax.experimental import topologies
+from jax.sharding import SingleDeviceSharding
+
+
+def rss_gb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 2**20
+    return 0.0
+
+
+PEAK = [0.0]
+
+
+def sampler():
+    while True:
+        PEAK[0] = max(PEAK[0], rss_gb())
+        time.sleep(2)
+
+
+threading.Thread(target=sampler, daemon=True).start()
+
+
+def main():
+    n_txns = int(os.environ.get("RSS_TXNS", 10_000_000))
+    max_k = int(os.environ.get("JT_10M_MAX_K", 32))
+    from jepsen_tpu.checkers.elle.device_core import (_infer_stage,
+                                                      _sweep_stage)
+    from jepsen_tpu.checkers.elle.device_infer import pad_packed
+    from jepsen_tpu.utils import prestage
+
+    p = prestage.la_history(n_txns=n_txns, n_keys=max(64, n_txns // 8))
+    h = pad_packed(p)
+    topo = topologies.get_topology_desc(topology_name="v5e:2x2",
+                                        platform="tpu")
+    dev = topo.devices[0]
+    sh = SingleDeviceSharding(dev)
+    hs = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh), h)
+    del h
+    print(f"baseline rss {rss_gb():.1f} GB", flush=True)
+    t0 = time.perf_counter()
+    low = _infer_stage.lower(hs, p.n_keys)
+    print(f"infer lowered {time.perf_counter()-t0:.0f}s "
+          f"rss {rss_gb():.1f} GB", flush=True)
+    t0 = time.perf_counter()
+    low.compile()
+    print(f"infer compiled {time.perf_counter()-t0:.0f}s "
+          f"peak rss {PEAK[0]:.1f} GB", flush=True)
+    out_sd = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        jax.eval_shape(_infer_stage, hs, p.n_keys))
+    t0 = time.perf_counter()
+    low2 = _sweep_stage.lower(out_sd, max_k=max_k, max_rounds=64)
+    low2.compile()
+    print(f"sweep compiled {time.perf_counter()-t0:.0f}s "
+          f"peak rss {PEAK[0]:.1f} GB", flush=True)
+
+
+if __name__ == "__main__":
+    main()

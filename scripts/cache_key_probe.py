@@ -1,0 +1,110 @@
+"""Diff the persistent-compile-cache key components between the axon
+tunnel backend and the deviceless v5e topology backend (same tiny
+program, same shapes): the deviceless AOT hedge only pays off if its
+cache keys match what the in-tunnel run computes.  Run each mode in a
+fresh process:
+
+    python scripts/cache_key_probe.py axon
+    JAX_PLATFORMS=cpu python scripts/cache_key_probe.py topo
+
+Also saves the axon backend's platform strings + serialized topology to
+scripts/axon_fingerprint.json for aot_warm.py's key-matching mode.
+"""
+
+import base64
+import json
+import logging
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "axon"
+
+if mode == "topo":
+    from jepsen_tpu.utils.backend import force_cpu_backend
+
+    force_cpu_backend()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax._src import cache_key as ck
+from jax._src.lib import xla_client
+
+logging.basicConfig(stream=sys.stderr)
+ck.logger.setLevel(logging.DEBUG)
+
+
+def tiny(x):
+    return jnp.cumsum(x * 2)[-1]
+
+
+def main():
+    if mode == "axon":
+        backend = jax.devices()[0].client
+        devs = np.array(jax.devices()[:1])
+    else:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(topology_name="v5e:2x2",
+                                            platform="tpu")
+        devs = np.array(topo.devices[:1])
+        backend = None
+
+    from jax.sharding import SingleDeviceSharding
+
+    sh = SingleDeviceSharding(devs.flat[0])
+    xs = jax.ShapeDtypeStruct((1024,), jnp.int32, sharding=sh)
+    lowered = jax.jit(tiny).lower(xs)
+    module = lowered._lowering.stablehlo_module() if hasattr(
+        lowered._lowering, "stablehlo_module") else \
+        lowered.compiler_ir("stablehlo")
+    opts = lowered._lowering.compile_args["executable_build_options"] \
+        if "executable_build_options" in getattr(
+            lowered._lowering, "compile_args", {}) else None
+    # the canonical route: what compiler.py passes
+    from jax._src import compiler
+    compile_options = lowered._lowering.compile_args.get("compile_options") \
+        if hasattr(lowered._lowering, "compile_args") else None
+    if compile_options is None:
+        compile_options = xla_client.CompileOptions()
+    if mode == "axon":
+        key = ck.get(module, devs, compile_options, backend)
+    else:
+        # topology compile path: backend object for key purposes is the
+        # topology client jax uses in AOT; emulate with a shim exposing
+        # platform/platform_version like compiler.py sees
+        class TopoShim:
+            platform = devs.flat[0].platform
+            platform_version = getattr(devs.flat[0].client,
+                                       "platform_version", "")
+
+        key = ck.get(module, devs, compile_options, TopoShim)
+    print(f"[{mode}] key:", key)
+    info = {
+        "mode": mode,
+        "platform": getattr(devs.flat[0], "platform", "?"),
+        "device_kind": devs.flat[0].device_kind,
+    }
+    try:
+        topo_ser = xla_client.get_topology_for_devices(
+            list(devs.flat)).serialize()
+        info["topology_b64"] = base64.b64encode(topo_ser).decode()
+    except Exception as e:
+        info["topology_error"] = str(e)
+    if mode == "axon":
+        info["platform_version"] = backend.platform_version
+        with open(os.path.join(REPO, "scripts", "axon_fingerprint.json"),
+                  "w") as f:
+            json.dump(info, f)
+    else:
+        info["platform_version"] = TopoShim.platform_version
+    print(json.dumps({k: (v[:80] + "..." if isinstance(v, str) and
+                          len(v) > 80 else v)
+                      for k, v in info.items()}, indent=1))
+
+
+if __name__ == "__main__":
+    main()

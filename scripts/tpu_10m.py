@@ -48,9 +48,12 @@ def main():
           f"R={h.rd_elems.shape[0]}", flush=True)
 
     # HBM headroom knob: max_k sizes the (2T, max_k) label plane (4 GiB
-    # at 10M shapes with the default 128) and the (C, max_k) chain
-    # gather — the two largest sweep allocations on a 16 GiB chip
-    max_k = int(os.environ.get("JT_10M_MAX_K", 128))
+    # at 10M shapes with max_k=128) and the (C, max_k) chain gather —
+    # the two largest sweep allocations on a 16 GiB chip.  Default 32
+    # (1 GiB plane): the prestaged 10M history has zero backward edges,
+    # and aot_warm.py's la_10m_staged warms the SAME specialization (a
+    # different max_k is a different executable).
+    max_k = int(os.environ.get("JT_10M_MAX_K", 32))
     # staged (default): two separately-compiled programs — the fused
     # single program kills the axon remote-compile service at
     # 2^24-txn shapes (PROFILE.md §-1d, "Unexpected EOF" x3 attempts);

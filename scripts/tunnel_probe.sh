@@ -1,0 +1,11 @@
+#!/bin/sh
+# log tunnel liveness every ~4 min
+while true; do
+  t0=$(date +%s)
+  if timeout 200 python -c "import jax; jax.devices()" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) UP ($(( $(date +%s) - t0 ))s)"
+  else
+    echo "$(date +%H:%M:%S) down"
+  fi
+  sleep 220
+done
