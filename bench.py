@@ -25,7 +25,7 @@ BENCH_SIZES (comma-separated ladder, default "100000,1000000"),
 BENCH_KEYS, BENCH_REPEATS, BENCH_FORCE_CPU=1, BENCH_INIT_TIMEOUT (s,
 default 180), BENCH_TPU_RETRY_S (keep re-probing a down TPU tunnel for
 this long before the CPU fallback, default 450), BENCH_DEADLINE (s,
-default 1500), BENCH_CACHE_DIR (persistent XLA compilation cache,
+default 2700), BENCH_CACHE_DIR (persistent XLA compilation cache,
 default <repo>/.jax_cache).
 
 Exit status: 0 with a real value; 1 on any error/deadline path with no
@@ -84,7 +84,7 @@ def _init_backend():
     # a slow-but-live tunnel as down
     probe_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 180))
     # default window: ~2-3 probes when each hangs the full 180 s, while
-    # leaving most of the default 1500 s deadline for the CPU fallback
+    # leaving most of the default 2700 s deadline for the CPU fallback
     retry_window = float(os.environ.get("BENCH_TPU_RETRY_S", 450))
     t_start = time.monotonic()
     n_probes = 0
@@ -218,11 +218,16 @@ def _run_size(n_txns: int, repeats: int):
 
 def main():
     # arm the watchdog before anything that can raise or hang — the
-    # one-JSON-line contract must survive malformed env knobs too
+    # one-JSON-line contract must survive malformed env knobs too.
+    # Default 2700 s: a COLD 1M TPU compile measured 1161 s on the
+    # round-5 box (1834-2104 s on the previous one) — 1500 s left no
+    # headroom if the persistent cache misses (axon cache keys have
+    # been observed unstable across processes, PROFILE.md §-1f), and
+    # the watchdog still emits the best completed rung on breach.
     try:
-        deadline = float(os.environ.get("BENCH_DEADLINE", 1500))
+        deadline = float(os.environ.get("BENCH_DEADLINE", 2700))
     except ValueError:
-        deadline = 1500.0
+        deadline = 2700.0
     done = _arm_watchdog(deadline)
     platform = "unknown"
     try:

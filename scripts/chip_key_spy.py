@@ -1,0 +1,68 @@
+"""On-chip cache-key component spy (PROFILE.md §-1f open question).
+
+Runs the staged pair at small shapes on the axon backend and logs every
+cache-key component hash for the jit__infer_stage / jit__sweep_stage
+programs.  Run TWICE in fresh processes and diff the outputs: whichever
+component differs between runs is what makes on-chip staged-infer keys
+unstable (three different keys for one program observed 2026-08-01).
+
+Usage (tunnel up): python scripts/chip_key_spy.py >> scripts/chip_key_spy.log
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from jepsen_tpu.utils.backend import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+import jax  # noqa: E402
+from jax._src import cache_key as ck  # noqa: E402
+
+_orig = ck.get
+
+
+def spy(module, devices, compile_options, backend, *a, **kw):
+    key = _orig(module, devices, compile_options, backend, *a, **kw)
+    name = str(module.operation.attributes["sym_name"])
+    if "_infer_stage" in name or "_sweep_stage" in name:
+        canon = ck._canonicalize_ir(module, ck.IgnoreCallbacks.NO)
+        opts = compile_options.SerializeAsString()
+        print(f"[{time.strftime('%H:%M:%S')}] {name}", flush=True)
+        print("  canon-ir:", hashlib.sha256(canon).hexdigest()[:16],
+              f"({len(canon)} B)", flush=True)
+        print("  opts:", hashlib.sha256(opts).hexdigest()[:16],
+              f"({len(opts)} B)", flush=True)
+        print("  platver:", hashlib.sha256(
+            backend.platform_version.encode()).hexdigest()[:16], flush=True)
+        print("  key:", key[-16:], flush=True)
+        # persist the raw options for byte-level diffing across runs
+        tag = "infer" if "_infer_stage" in name else "sweep"
+        with open(os.path.join(REPO, "scripts",
+                               f"opts_{tag}_{os.getpid()}.bin"), "wb") as f:
+            f.write(opts)
+        with open(os.path.join(REPO, "scripts",
+                               f"canon_{tag}_{os.getpid()}.bin"), "wb") as f:
+            f.write(canon)
+    return key
+
+
+ck.get = spy
+
+from jepsen_tpu.checkers.elle.device_core import core_check_staged  # noqa: E402
+from jepsen_tpu.checkers.elle.device_infer import pad_packed  # noqa: E402
+from jepsen_tpu.workloads import synth  # noqa: E402
+
+p = synth.packed_la_history(n_txns=512, n_keys=16, seed=0)
+h = jax.device_put(pad_packed(p))
+jax.block_until_ready(h)
+t0 = time.perf_counter()
+bits, over = core_check_staged(h, p.n_keys)
+jax.block_until_ready(bits)
+print(f"pid {os.getpid()} done {time.perf_counter()-t0:.1f}s "
+      f"backend={jax.default_backend()}", flush=True)
