@@ -43,6 +43,21 @@ STAGES = [
      {"BENCH_TXNS": "1000000", "BENCH_DEADLINE": "5400"}, 5500),
     ("rw_1m", [sys.executable, "scripts/tpu_rw_1m.py"], {}, 3600),
     ("la_10m", [sys.executable, "scripts/tpu_10m.py"], {}, 14400),
+    # --- round-5 session-2 additions (fresh names = fresh attempts) ---
+    # does a FRESH process hit the warm fused 1M entries?  (never
+    # verified on the axon backend; if this recompiles ~1161 s the
+    # driver bench relies on the 2700 s deadline, PROFILE.md §-1f)
+    ("warmcheck_1m", [sys.executable, "bench.py"],
+     {"BENCH_TXNS": "1000000", "BENCH_REPEATS": "1",
+      "BENCH_DEADLINE": "3000"}, 3100),
+    # two spy runs: diff scripts/chip_key_spy.log across pids to find
+    # the cache-key component that varies per process on-chip
+    ("key_spy_a", [sys.executable, "scripts/chip_key_spy.py"], {}, 1800),
+    ("key_spy_b", [sys.executable, "scripts/chip_key_spy.py"], {}, 1800),
+    # config 4 via the staged two-program split (these are tpu_10m.py's
+    # defaults too; explicit so the stage can't drift with them)
+    ("la_10m_staged", [sys.executable, "scripts/tpu_10m.py"],
+     {"JT_10M_MODE": "staged", "JT_10M_MAX_K": "32"}, 14400),
 ]
 
 
