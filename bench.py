@@ -156,10 +156,28 @@ def _emit(payload):
         sys.stdout.flush()
 
 
+def _span_durations_s(doc):
+    """Flatten a telemetry snapshot's span forest into
+    {name: [durations_s...]} (bench spans repeat per timed run)."""
+    out = {}
+
+    def walk(sp):
+        d = sp.get("dur_ns")
+        if d is not None:
+            out.setdefault(sp["name"], []).append(d / 1e9)
+        for c in sp.get("children") or []:
+            walk(c)
+
+    for r in doc.get("spans", []):
+        walk(r)
+    return out
+
+
 def _run_size(n_txns: int, repeats: int):
     """One ladder rung: returns the result payload (raises on failure)."""
     import jax
 
+    from jepsen_tpu import telemetry
     from jepsen_tpu.checkers.elle.device_core import core_check_auto as check
     from jepsen_tpu.checkers.elle.device_infer import pad_packed
     from jepsen_tpu.utils import prestage
@@ -169,40 +187,57 @@ def _run_size(n_txns: int, repeats: int):
     # read-list growth (elle's gen rotates keys)
     n_keys = int(os.environ.get("BENCH_KEYS", max(64, n_txns // 8)))
 
-    # prestaged inputs (scripts/prestage_inputs.py) load in seconds; a
-    # cold miss falls back to generation (~153 s at 10M)
-    t_gen = time.perf_counter()
-    p = prestage.la_history(n_txns=n_txns, n_keys=n_keys, verbose=False)
-    h = pad_packed(p)
-    t_gen = time.perf_counter() - t_gen
+    # telemetry rides along (ISSUE 1 satellite): checker span durations
+    # + ops/s land in the BENCH_*.json payload so the perf trajectory
+    # is machine-readable from PR 1 onward
+    coll = telemetry.activate()
+    try:
+        # prestaged inputs (scripts/prestage_inputs.py) load in seconds; a
+        # cold miss falls back to generation (~153 s at 10M)
+        t_gen = time.perf_counter()
+        with telemetry.span("bench.gen", n_txns=n_txns):
+            p = prestage.la_history(n_txns=n_txns, n_keys=n_keys,
+                                    verbose=False)
+            h = pad_packed(p)
+        t_gen = time.perf_counter() - t_gen
 
-    # stage inputs on device BEFORE timing: first dispatch otherwise
-    # pays a synchronous host->device transfer of every padded array
-    # (measured ~30 s at 100k txns in round 2)
-    t_stage = time.perf_counter()
-    h = jax.device_put(h)
-    jax.block_until_ready(h)
-    t_stage = time.perf_counter() - t_stage
+        # stage inputs on device BEFORE timing: first dispatch otherwise
+        # pays a synchronous host->device transfer of every padded array
+        # (measured ~30 s at 100k txns in round 2)
+        t_stage = time.perf_counter()
+        with telemetry.span("bench.stage"):
+            h = jax.device_put(h)
+            jax.block_until_ready(h)
+        t_stage = time.perf_counter() - t_stage
 
-    # warmup (compile — or persistent-cache hit on reruns)
-    t_compile = time.perf_counter()
-    bits, over = check(h, p.n_keys)
-    jax.block_until_ready(bits)
-    t_compile = time.perf_counter() - t_compile
-    assert int(bits[-1]) == 1, "sweep did not converge on bench history"
-    assert int(bits[:12].sum()) == 0, "bench history must be valid"
-
-    from jepsen_tpu.utils.profiling import trace
-
-    best = float("inf")
-    with trace(os.environ.get("BENCH_PROFILE_DIR")):
-        for _ in range(repeats):
-            t0 = time.perf_counter()
+        # warmup (compile — or persistent-cache hit on reruns)
+        t_compile = time.perf_counter()
+        with telemetry.span("bench.compile-or-warmup"):
             bits, over = check(h, p.n_keys)
             jax.block_until_ready(bits)
-            best = min(best, time.perf_counter() - t0)
+        t_compile = time.perf_counter() - t_compile
+        assert int(bits[-1]) == 1, "sweep did not converge on bench history"
+        assert int(bits[:12].sum()) == 0, "bench history must be valid"
 
-    ops_per_sec = n_txns / best
+        from jepsen_tpu.utils.profiling import trace
+
+        best = float("inf")
+        with trace(os.environ.get("BENCH_PROFILE_DIR")):
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                with telemetry.span("bench.check", n_txns=n_txns):
+                    bits, over = check(h, p.n_keys)
+                    jax.block_until_ready(bits)
+                best = min(best, time.perf_counter() - t0)
+
+        ops_per_sec = n_txns / best
+        telemetry.registry().gauge(
+            "checker-ops-per-s", checker="device-core").set(
+            round(ops_per_sec, 1))
+        doc = telemetry.snapshot(coll)
+    finally:
+        telemetry.deactivate(coll)
+    spans = _span_durations_s(doc)
     return {
         "metric": "elle-list-append-check-throughput",
         "value": round(ops_per_sec, 1),
@@ -213,6 +248,13 @@ def _run_size(n_txns: int, repeats: int):
         "gen_s": round(t_gen, 2),
         "stage_s": round(t_stage, 2),
         "compile_or_warmup_s": round(t_compile, 2),
+        "telemetry": {
+            "checker_span_s": {name: round(min(ds), 6)
+                               for name, ds in sorted(spans.items())},
+            "checker_span_runs": {name: len(ds)
+                                  for name, ds in sorted(spans.items())},
+            "check_ops_per_s": round(ops_per_sec, 1),
+        },
     }
 
 

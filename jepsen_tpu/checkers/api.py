@@ -14,10 +14,12 @@ unknown and none is false.
 from __future__ import annotations
 
 import re
+import time
 import traceback
 from collections import Counter as _Counter
 from typing import Any, Callable, Dict, Iterable, Optional
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.history.ops import FAIL, INFO, INVOKE, OK, History, Op
 
 
@@ -51,12 +53,41 @@ def checker(fn: Callable, name: str = "fn") -> Checker:
 def check_safe(chk: Checker, test: dict, history: History,
                opts: Optional[dict] = None) -> Dict[str, Any]:
     """Run a checker, converting exceptions into an invalid result
-    (reference: `jepsen.checker/check-safe`)."""
+    (reference: `jepsen.checker/check-safe`).  The failing checker's
+    `name()` rides along in the error result so composed-checker
+    failures stay attributable in stored results.  Telemetric runs get
+    one ``check:<name>`` span per (composed) checker, carrying the
+    history length, verdict, and throughput."""
     try:
-        return chk.check(test, history, opts)
-    except Exception:
-        return {"valid?": "unknown",
-                "error": traceback.format_exc()}
+        name = chk.name()
+    except Exception:  # noqa: BLE001 — a broken name() must not mask check()
+        name = type(chk).__name__
+    tel = telemetry.active()
+    if not tel.enabled:
+        try:
+            return chk.check(test, history, opts)
+        except Exception:
+            return {"valid?": "unknown", "checker": name,
+                    "error": traceback.format_exc()}
+    with tel.span(f"check:{name}", checker=name) as sp:
+        try:
+            n = len(history)
+        except TypeError:
+            n = None
+        t0 = time.perf_counter()
+        try:
+            res = chk.check(test, history, opts)
+        except Exception:
+            sp.set_attr(ops=n, valid="unknown", crashed=True)
+            return {"valid?": "unknown", "checker": name,
+                    "error": traceback.format_exc()}
+        dt = time.perf_counter() - t0
+        sp.set_attr(ops=n, valid=res.get("valid?")
+                    if isinstance(res, dict) else None)
+        if n and dt > 0:
+            telemetry.registry().gauge(
+                "checker-ops-per-s", checker=name).set(round(n / dt, 1))
+        return res
 
 
 def _merge_valid(vs: Iterable[Any]) -> Any:

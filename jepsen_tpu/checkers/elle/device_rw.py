@@ -360,9 +360,18 @@ def check(p: PackedTxns | PaddedLA, n_keys: int = None, max_k: int = 128,
         MAX_ROUNDS_CAP,
     )
 
+    from jepsen_tpu import telemetry
+
     h = p if isinstance(p, PaddedLA) else pad_packed(p)
     n_keys = h.n_keys if n_keys is None else n_keys
     rw_cap = h.mop_txn.shape[0]
+
+    # one phase span over the whole fused check incl. grow-retries
+    # (infer/graph-build/cycle-sweep are fused in one jit program here,
+    # so per-stage child spans would only time dispatch)
+    ph = telemetry.phases()
+    ph.start("elle.rw-core-check", device=True,
+             t_pad=h.txn_type.shape[0])
 
     while True:
         bits, over, rw_over = rw_core_check(h, n_keys, max_k=max_k,
@@ -388,6 +397,7 @@ def check(p: PackedTxns | PaddedLA, n_keys: int = None, max_k: int = 128,
             continue
         break
 
+    ph.end()
     row = np.asarray(bits)
     nc = len(COUNT_NAMES_RW)
     counts = {n: int(row[i]) for i, n in enumerate(COUNT_NAMES_RW)}

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Sequence, Set, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.checkers.elle import consistency, coverage, oracle
 from jepsen_tpu.checkers.elle.device_infer import PaddedLA, infer, pad_packed
 from jepsen_tpu.checkers.elle.graph import (
@@ -46,19 +47,42 @@ from jepsen_tpu.checkers.elle.specs import CYCLE_ANOMALY_SPECS, SPEC_ORDER
 from jepsen_tpu.history.soa import TXN_OK, PackedTxns, pack_txns
 from jepsen_tpu.ops.cycle_sweep import SweepGraph, detect_cycles
 
+# first-call-in-process tracking per jitted stage: telemetry's span
+# attr for "this duration probably includes jit trace+compile"
+_WARM: Dict[str, bool] = {}
+
 
 def check(history, consistency_models: Sequence[str] = ("serializable",),
           anomalies: Sequence[str] = (), max_reported: int = 8,
           _force_no_fallback: bool = False) -> Dict[str, Any]:
     """Check a list-append history on device.  Accepts History / op list /
     PackedTxns."""
-    p = history if isinstance(history, PackedTxns) \
-        else pack_txns(history, "list-append")
+    # phase spans matching the host oracle's stage names (device=True
+    # distinguishes them in one trace); "warm" records whether this
+    # process already traced/compiled the infer program — the closest
+    # cheap proxy for jit compile vs execute time
+    ph = telemetry.phases()
+    if isinstance(history, PackedTxns):
+        p = history
+    else:
+        ph.start("elle.pack", device=True)
+        p = pack_txns(history, "list-append")
     if p.n_txns == 0 or not (p.txn_type == TXN_OK).any():
+        ph.end()
         return {"valid?": "unknown", "anomaly-types": [], "anomalies": {},
                 "not": [], "also-not": []}
 
+    ph.start("elle.infer", device=True, txns=p.n_txns,
+             warm=_WARM.get("infer", False))
+    _WARM["infer"] = True
     h = pad_packed(p)
+    if telemetry.enabled():
+        telemetry.registry().counter("device-bytes-staged").inc(
+            sum(int(np.asarray(a).nbytes) for a in (
+                h.txn_type, h.txn_process, h.txn_invoke_pos,
+                h.txn_complete_pos, h.txn_mask, h.mop_txn, h.mop_kind,
+                h.mop_key, h.mop_val, h.mop_rd_start, h.mop_rd_len,
+                h.mop_mask, h.rd_elems, h.rd_elem_mask)))
     out = infer(h, h.n_keys)
 
     found: Dict[str, List[Any]] = {}
@@ -75,6 +99,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
 
 
     # ---- cycle anomalies: group specs by rel projection -------------------
+    ph.start("elle.graph-build", device=True)
     specs = [(name, CYCLE_ANOMALY_SPECS[name]) for name in SPEC_ORDER
              if name in want]
     projections: Dict[frozenset, List[Tuple[str, CycleSpec]]] = {}
@@ -108,6 +133,8 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     host_edges: EdgeList = None  # lazily materialized for classification
     explainer = None             # lazily built per-edge Explainer
     needs_fallback = False
+    ph.start("elle.cycle-sweep", device=True,
+             projections=len(projections))
     for rels, group in projections.items():
         sel = jnp.zeros_like(base_mask)
         for r in rels:
@@ -151,6 +178,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
                      "witnesses": int(len(res.witness_edge_ids))})
 
     if needs_fallback:
+        ph.end()
         if _force_no_fallback:
             raise RuntimeError("cycle sweep did not converge")
         # pass the ORIGINAL input: an op-level history keeps its session
@@ -162,11 +190,13 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     # after the fallback decision, so a non-converged sweep doesn't do
     # the (host-side) session walk twice (see coverage.py for the
     # PackedTxns degradation rule)
+    ph.start("elle.sessions", device=False)
     sess_found, sess_checked = coverage.run_la_sessions(
         history, want, isinstance(history, PackedTxns),
         max_reported=max_reported)
     for k, v in sess_found.items():
         found.setdefault(k, []).extend(v)
+    ph.end()
 
     found = {k: v for k, v in found.items() if k in want}
     anomaly_types = sorted(found.keys())

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.checkers.elle import consistency
 from jepsen_tpu.checkers.elle.graph import (
     REL_NAMES,
@@ -88,7 +89,16 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
           anomalies: Sequence[str] = (), max_cycle_steps: int = 2_000_000,
           max_reported: int = 8) -> Dict[str, Any]:
     """Check a list-append history.  Accepts a History / op list / PackedTxns."""
-    p = history if isinstance(history, PackedTxns) else pack_txns(history, "list-append")
+    # sequential phase spans (telemetry, no-op when disabled): the same
+    # infer / graph-build / cycle-sweep stage names as the device
+    # pipeline, so host-vs-device time is comparable in one trace
+    ph = telemetry.phases()
+    if isinstance(history, PackedTxns):
+        p = history
+    else:
+        ph.start("elle.pack", device=False)
+        p = pack_txns(history, "list-append")
+    ph.start("elle.infer", device=False, txns=p.n_txns)
     txns = _unpack(p)
     found: Dict[str, List[Any]] = {}
 
@@ -204,6 +214,8 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
                         "committed-writer": txns[wb].orig_index})
 
     # -- dependency edges ---------------------------------------------------
+    ph.start("elle.graph-build", device=False)
+
     def graph_txn(i: int) -> bool:
         return txns[i].type in (TXN_OK, TXN_INFO)
 
@@ -273,6 +285,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     # op-level input; coverage.py owns the degradation rule
     from jepsen_tpu.checkers.elle import coverage
 
+    ph.start("elle.sessions", device=False)
     sess_found, sess_checked = coverage.run_la_sessions(
         history, want, isinstance(history, PackedTxns),
         max_reported=max_reported)
@@ -282,6 +295,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     cycle_specs = [s for s in SPEC_ORDER
                    if s in want and s in CYCLE_ANOMALY_SPECS]
 
+    ph.start("elle.cycle-sweep", device=False, specs=len(cycle_specs))
     for name in cycle_specs:
         spec = CYCLE_ANOMALY_SPECS[name]
         proj = edges.project(spec.rels)
@@ -295,6 +309,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
                               "scc-size": int(len(scc))})
                 break  # one witness per spec, like the reference's default
 
+    ph.end()
     found = {k: v for k, v in found.items() if k in want}
     anomaly_types = sorted(found.keys())
     boundary = consistency.friendly_boundary(anomaly_types)

@@ -21,6 +21,7 @@ from typing import Any, Dict
 
 logger = logging.getLogger("jepsen.knossos")
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.checkers.knossos import device_wgl, linear, wgl
 from jepsen_tpu.checkers.knossos.prep import prepare
 from jepsen_tpu.checkers.knossos.search import ChildSearch
@@ -63,7 +64,13 @@ def _race(contestants, ops, model, ctl, _also_accepts=(),
             # silently kill a leg instead of racing it
             params = inspect.signature(fn).parameters
             leg_kw = {k: v for k, v in kw.items() if k in params}
-            q.put((name, fn(list(ops), model, ctl=ctl, **leg_kw), None))
+            # each leg runs on its own thread, so this span is a root
+            # on its own timeline row; device=True marks the TPU leg
+            with telemetry.span(f"knossos.{name}", ops=len(ops),
+                                device=(fn is device_wgl.check)) as sp:
+                res = fn(list(ops), model, ctl=ctl, **leg_kw)
+                sp.set_attr(valid=res.get("valid?"))
+            q.put((name, res, None))
         except Exception as e:  # noqa: BLE001 — let the others finish
             logger.warning("%s contestant crashed", name, exc_info=True)
             q.put((name, None, e))
@@ -164,6 +171,8 @@ def analysis(history: History, model: Model,
              **kw) -> Dict[str, Any]:
     """Linearizability analysis.
     algorithm: auto | wgl | linear | device | competition.
+    Telemetric runs get a ``knossos.analysis`` span over the whole call
+    plus one root span per race leg (each on its own thread row).
 
     auto: small histories race linear vs wgl (cheap memoization, host
     DFS usually instant), then try the device on "unknown"; large ones
@@ -180,7 +189,18 @@ def analysis(history: History, model: Model,
     forwarded to EVERY leg, device included: an explicit budget bounds
     the whole analysis, not just the host algorithms.
     """
-    ops = prepare(history)
+    with telemetry.span("knossos.analysis", algorithm=algorithm) as sp:
+        with telemetry.span("knossos.prep"):
+            ops = prepare(history)
+        sp.set_attr(ops=len(ops))
+        res = _dispatch(ops, model, algorithm, deadline_s, kw)
+        sp.set_attr(valid=res.get("valid?"),
+                    algorithm_used=res.get("algorithm", algorithm))
+        return res
+
+
+def _dispatch(ops, model: Model, algorithm: str, deadline_s,
+              kw: Dict[str, Any]) -> Dict[str, Any]:
     parent = kw.pop("ctl", None)
     # one root per analysis: carries this call's deadline (absolute from
     # here) and observes the caller's ctl; everything below aborts

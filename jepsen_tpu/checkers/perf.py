@@ -140,6 +140,21 @@ def _shade(ax, history, test: Optional[dict] = None):
         ax.axvspan(t0, t1, color="#FF8B8B", alpha=0.2, lw=0)
 
 
+def _matplotlib():
+    """pyplot with the Agg backend, or None when matplotlib is absent —
+    the graphs then degrade to returning their computed counts instead
+    of raising into `check_safe` (a missing plotting dep must never
+    turn a run's results "unknown")."""
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError:
+        logger.warning("matplotlib unavailable; perf graphs skipped")
+        return None
+
+
 class LatencyGraph(Checker):
     """Scatter of op latencies over time, colored by completion type,
     one marker style per :f; nemesis windows shaded (reference
@@ -152,9 +167,10 @@ class LatencyGraph(Checker):
         pts = latency_points(history)
         if len(pts["time"]) == 0:
             return {"valid?": True, "points": 0}
-        import matplotlib
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
+        plt = _matplotlib()
+        if plt is None:
+            return {"valid?": True, "points": int(len(pts["time"])),
+                    "plot": "skipped (no matplotlib)"}
 
         fig, ax = plt.subplots(figsize=(10, 5))
         _shade(ax, history, test)
@@ -192,9 +208,12 @@ class RateGraph(Checker):
         series = rate_points(history, self.dt)
         if not series:
             return {"valid?": True, "points": 0}
-        import matplotlib
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
+        plt = _matplotlib()
+        if plt is None:
+            return {"valid?": True,
+                    "points": sum(len(t) for t, _ in series.values()),
+                    "series": len(series),
+                    "plot": "skipped (no matplotlib)"}
 
         fig, ax = plt.subplots(figsize=(10, 5))
         _shade(ax, history, test)

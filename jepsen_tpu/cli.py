@@ -83,6 +83,10 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    help="skip db teardown for post-mortem inspection")
     p.add_argument("--logging-json", action="store_true",
                    help="JSON log lines")
+    p.add_argument("--telemetry", action="store_true",
+                   help="collect span tracing + metrics; writes "
+                        "telemetry.json and Chrome trace.json into the "
+                        "store dir (view with `trace <dir>` or Perfetto)")
 
 
 def opts_to_test_map(opts: argparse.Namespace) -> Dict[str, Any]:
@@ -138,6 +142,22 @@ def serve_cmd(opts: argparse.Namespace) -> int:
     return 0
 
 
+def trace_cmd(opts: argparse.Namespace) -> int:
+    """Summarize a stored run's telemetry (span tree + metrics)."""
+    from .telemetry import export as tel_export
+    d = opts.dir
+    if not os.path.isdir(d):
+        print(f"trace: no such directory {d!r}", file=sys.stderr)
+        return 2
+    try:
+        print(tel_export.summarize(d))
+    except FileNotFoundError:
+        print(f"trace: {d} has no telemetry.json (run the test with "
+              "--telemetry or JEPSEN_TELEMETRY=1)", file=sys.stderr)
+        return 2
+    return 0
+
+
 def analyze_cmd(opts: argparse.Namespace,
                 checker_fn: Optional[Callable[[], Any]] = None) -> int:
     """Re-check a stored run (reference: store/load + re-check path)."""
@@ -173,6 +193,10 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
     pa = sub.add_parser("analyze", help="re-check a stored run")
     pa.add_argument("dir", help="store run directory")
 
+    ptr = sub.add_parser("trace",
+                         help="summarize a stored run's telemetry")
+    ptr.add_argument("dir", help="store run directory")
+
     def dispatch(opts: argparse.Namespace) -> int:
         if opts.cmd == "test":
             return run_test_cmd(test_fn, opts)
@@ -180,6 +204,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
             return serve_cmd(opts)
         if opts.cmd == "analyze":
             return analyze_cmd(opts, checker_fn)
+        if opts.cmd == "trace":
+            return trace_cmd(opts)
         p.error(f"unknown command {opts.cmd}")
         return 2
 

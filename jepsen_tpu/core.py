@@ -29,7 +29,7 @@ import traceback
 from typing import Any, Dict, Optional
 
 from . import db as db_proto
-from . import os_setup, store
+from . import os_setup, store, telemetry
 from .checkers import api as checker_api
 from .control import api as control
 from .control.core import Remote, Session
@@ -151,6 +151,28 @@ def run(test: dict) -> dict:
     test = {**noop_test(), **test}
     if test.get("start-time") is None:
         test["start-time"] = time.time()
+    # telemetry: a fresh collector per run when opted in (test map key,
+    # telemetry.enable(), or JEPSEN_TELEMETRY); the NOOP singleton
+    # otherwise — every span below is then a shared no-op object
+    tel = (telemetry.activate() if telemetry.wanted_for(test)
+           else telemetry.NOOP)
+    if tel.enabled:
+        test["telemetry-collector"] = tel
+        # a full run always writes the unsuffixed artifacts, even for a
+        # test map reloaded from a store dir that was later analyzed
+        test.pop("telemetry-artifact-suffix", None)
+    try:
+        with tel.span("run", name=test.get("name"),
+                      nodes=len(test.get("nodes") or ()),
+                      concurrency=test.get("concurrency")):
+            return _run_phases(test, tel)
+    finally:
+        if tel.enabled:
+            telemetry.deactivate(tel)
+
+
+def _run_phases(test: dict, tel) -> dict:
+    """The body of :func:`run`, one telemetry span per phase."""
     log_handler = _start_logging(test)
     logger.info("Running test %s on nodes %s", test.get("name"),
                 test.get("nodes"))
@@ -162,10 +184,14 @@ def run(test: dict) -> dict:
         try:
             if test.get("nodes") and test.get("remote") is not None:
                 os_ = test.get("os") or os_setup.noop
-                control.on_nodes(test, os_.setup)
-                _db_setup(test)
+                with tel.span("os-setup"):
+                    control.on_nodes(test, os_.setup)
+                with tel.span("db-setup"):
+                    _db_setup(test)
             if nemesis is not None:
-                test["nemesis"] = nemesis = nemesis.setup(test) or nemesis
+                with tel.span("nemesis-setup"):
+                    test["nemesis"] = nemesis = \
+                        nemesis.setup(test) or nemesis
 
             logger.info("Starting workload")
             fg = test.get("final-generator")
@@ -174,7 +200,9 @@ def run(test: dict) -> dict:
                 # :generator then :final-generator once clients settle)
                 test["generator"] = gen_core.phases(
                     test.get("generator"), fg)
-            hist = interpreter.run(test)
+            with tel.span("workload") as w_span:
+                hist = interpreter.run(test)
+                w_span.set_attr(ops=len(hist))
             test["history"] = hist
             logger.info("Workload complete: %d ops", len(hist))
         except BaseException as e:
@@ -185,21 +213,30 @@ def run(test: dict) -> dict:
             # died mid-setup: faults must be healed and dbs stopped either
             # way, and node logs are most valuable for crashed runs.
             if nemesis is not None:
-                _quietly("nemesis teardown", lambda: nemesis.teardown(test))
+                with tel.span("nemesis-teardown"):
+                    _quietly("nemesis teardown",
+                             lambda: nemesis.teardown(test))
             if test.get("nodes") and test.get("remote") is not None:
-                _quietly("log download", lambda: _download_logs(test))
-                _quietly("db teardown", lambda: _db_teardown(test))
+                with tel.span("log-download"):
+                    _quietly("log download", lambda: _download_logs(test))
+                with tel.span("db-teardown"):
+                    _quietly("db teardown", lambda: _db_teardown(test))
                 os_ = test.get("os") or os_setup.noop
-                _quietly("os teardown",
-                         lambda: control.on_nodes(test, os_.teardown))
+                with tel.span("os-teardown"):
+                    _quietly("os teardown",
+                             lambda: control.on_nodes(test, os_.teardown))
     finally:
         _close_sessions(sessions)
         test.pop("sessions", None)
 
     try:
-        store.save_0(test)
+        with tel.span("store.save_0"):
+            store.save_0(test)
+        # the check phase gets one span per (composed) checker, opened
+        # inside checker_api.check_safe with the checker's name attached
         test["results"] = _check(test, test.get("history"))
-        store.save_1(test)
+        with tel.span("store.save_1"):
+            store.save_1(test)
         valid = test["results"].get("valid?")
         (logger.info if valid is True else logger.warning)(
             "Analysis complete: valid? = %s", valid)
@@ -239,8 +276,21 @@ def analyze(test_or_dir, checker=None) -> dict:
         raise ValueError(
             "no checker: stored tests don't persist checker objects; "
             "pass one to analyze(test, checker)")
-    test["results"] = checker_api.check_safe(chk, test, hist)
-    store.save_1(test)
+    tel = (telemetry.activate() if telemetry.wanted_for(test)
+           else telemetry.NOOP)
+    if tel.enabled:
+        test["telemetry-collector"] = tel
+        # keep the original run's telemetry.json/trace.json intact:
+        # the re-check writes *-analyze.json artifacts instead
+        test["telemetry-artifact-suffix"] = "-analyze"
+    try:
+        with tel.span("analyze", name=test.get("name")):
+            test["results"] = checker_api.check_safe(chk, test, hist)
+            with tel.span("store.save_1"):
+                store.save_1(test)
+    finally:
+        if tel.enabled:
+            telemetry.deactivate(tel)
     return test
 
 

@@ -25,8 +25,10 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Any, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
+from jepsen_tpu import telemetry
 from jepsen_tpu.client import Client, invoke_with_errors
 from jepsen_tpu.generator import core as g
 from jepsen_tpu.generator.context import NEMESIS_THREAD, Context, context
@@ -138,6 +140,14 @@ def run(test: dict) -> History:
     events: List[dict] = []
     in_flight = 0
 
+    # telemetry (ISSUE 1): per-worker op counts accumulate in a local
+    # dict on the (single-threaded) dispatch loop and flush to the
+    # process registry once at the end — zero locking on the op path,
+    # zero work when disabled
+    telemetric = telemetry.enabled()
+    op_counts: Dict[Tuple[Any, str], int] = {}
+    stall_ns = 0
+
     def now() -> int:
         return relative_time_nanos()
 
@@ -145,6 +155,9 @@ def run(test: dict) -> History:
         nonlocal ctx, gen, in_flight
         comp = dict(comp, time=now())
         events.append(comp)
+        if telemetric:
+            k = (thread, comp.get("type"))
+            op_counts[k] = op_counts.get(k, 0) + 1
         ctx = ctx.with_time(comp["time"]).free_thread(thread)
         if comp.get("type") == "info" and isinstance(comp.get("process"), int):
             ctx = ctx.with_next_process(thread, concurrency)
@@ -175,7 +188,13 @@ def run(test: dict) -> History:
                 gen = gen2
                 wake = ((op_.time - ctx.time) / 1e9
                         if op_.time is not None else _TICK_S)
-                wait_for_completion(min(max(wake, _TICK_S / 10), 10.0))
+                if telemetric:
+                    t_stall = time.perf_counter_ns()
+                    wait_for_completion(
+                        min(max(wake, _TICK_S / 10), 10.0))
+                    stall_ns += time.perf_counter_ns() - t_stall
+                else:
+                    wait_for_completion(min(max(wake, _TICK_S / 10), 10.0))
                 continue
             t_op = op_.get("time") or ctx.time
             if t_op > ctx.time:
@@ -188,6 +207,9 @@ def run(test: dict) -> History:
             invoke = dict(op_, type="invoke", time=ctx.time)
             events.append(invoke)
             thread = ctx.thread_for_process(invoke["process"])
+            if telemetric:
+                k = (thread, "invoke")
+                op_counts[k] = op_counts.get(k, 0) + 1
             ctx = ctx.busy_thread(thread)
             gen = g.gen_update(gen, test, ctx, invoke)
             in_flight += 1
@@ -202,6 +224,22 @@ def run(test: dict) -> History:
         for w in workers.values():
             w.thread.join(timeout=10)
         nemesis_worker.thread.join(timeout=10)
+        if telemetric:
+            _flush_metrics(concurrency, op_counts, stall_ns)
 
     ops = [Op.from_dict(e) for e in events]
     return history(ops)
+
+
+def _flush_metrics(concurrency: int,
+                   op_counts: Dict[Tuple[Any, str], int],
+                   stall_ns: int) -> None:
+    """Flush the dispatch loop's local tallies into the process-wide
+    registry: ops invoked/ok/fail/info per worker + generator stall."""
+    reg = telemetry.registry()
+    for (thread, typ), n in sorted(op_counts.items(), key=lambda kv:
+                                   (str(kv[0][0]), str(kv[0][1]))):
+        worker = "nemesis" if thread == NEMESIS_THREAD else str(thread)
+        reg.counter("interpreter-ops", worker=worker, type=typ).inc(n)
+    reg.counter("generator-stall-ns").inc(stall_ns)
+    reg.gauge("interpreter-concurrency").set(concurrency)

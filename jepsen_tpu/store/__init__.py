@@ -18,6 +18,7 @@ most recent run.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import time
@@ -26,6 +27,8 @@ from typing import Any, Iterator, List, Optional
 from ..history.ops import History
 from . import codec
 from .format import JepsenFile, LazyHistory
+
+logger = logging.getLogger("jepsen.store")
 
 BASE = "store"
 TEST_FILE = "test.jepsen"
@@ -113,7 +116,9 @@ def save_0(test: dict) -> dict:
 
 
 def save_1(test: dict) -> dict:
-    """Phase 1: append results after analysis; history blocks untouched."""
+    """Phase 1: append results after analysis; history blocks untouched.
+    A telemetric run (collector attached by `core.run`/`core.analyze`)
+    also persists ``telemetry.json`` + Chrome ``trace.json`` here."""
     d = test_dir(test)
     results = test.get("results", {})
     jf = JepsenFile(os.path.join(d, TEST_FILE))
@@ -122,8 +127,27 @@ def save_1(test: dict) -> dict:
     jf.append_results(results)
     with open(os.path.join(d, "results.json"), "w") as f:
         f.write(codec.dumps(results).decode())
+    _save_telemetry(test, d)
     update_symlinks(test)
     return test
+
+
+def _save_telemetry(test: dict, d: str) -> None:
+    coll = test.get("telemetry-collector")
+    if coll is None or not getattr(coll, "enabled", False):
+        return
+    try:
+        from .. import telemetry
+
+        # an analyze pass writes telemetry-analyze.json / trace-analyze
+        # .json so the original run's artifacts survive the re-check
+        telemetry.write_run(d, coll, meta={
+            "name": test.get("name"),
+            "start-time": test.get("start-time"),
+            "concurrency": test.get("concurrency"),
+        }, suffix=test.get("telemetry-artifact-suffix", ""))
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a save
+        logger.warning("telemetry export failed: %s", e)
 
 
 def load(name_or_dir: str, ts: Optional[str] = None, *, base: Optional[str] = None) -> dict:

@@ -1,0 +1,321 @@
+"""Nestable timed spans — the tracing half of the telemetry layer.
+
+A :class:`Collector` owns one span forest per run: every thread that
+opens a span gets its own root chain (thread-local stacks), so the
+interpreter's worker threads, the knossos race legs, and the main
+orchestration loop each land on their own timeline row in the Chrome
+trace export.  Spans nest via context managers (or the :func:`traced`
+decorator) and carry free-form attributes (op counts, history length,
+device vs host, jit compile vs execute ...).
+
+Cost contract (ISSUE 1): telemetry must be off-by-default-cheap.  The
+disabled path is the module-level :data:`NOOP` singleton whose
+``span()`` returns one shared no-op context manager — no allocation, no
+clock read, no locks.  Hot loops additionally guard per-op work with
+``collector.enabled``.
+
+Clocks: span timing uses ``time.perf_counter_ns()`` (monotonic,
+comparable across threads in one process); the collector anchors that
+to wall time once at construction so exports can place the run in
+absolute time.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "Collector", "NoopCollector", "NOOP", "active",
+           "activate", "deactivate", "span", "traced", "enabled",
+           "current"]
+
+
+class Span:
+    """One timed node in the span tree.  ``t0``/``t1`` are
+    perf_counter_ns values; ``t1`` is None while the span is open."""
+
+    __slots__ = ("name", "t0", "t1", "attrs", "children", "tid",
+                 "thread_name")
+
+    def __init__(self, name: str, tid: int, thread_name: str,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.t0 = time.perf_counter_ns()
+        self.t1: Optional[int] = None
+        self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
+        self.children: List[Span] = []
+        self.tid = tid
+        self.thread_name = thread_name
+
+    @property
+    def duration_ns(self) -> Optional[int]:
+        return None if self.t1 is None else self.t1 - self.t0
+
+    def set_attr(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def __repr__(self) -> str:
+        d = self.duration_ns
+        return (f"<Span {self.name} "
+                f"{'open' if d is None else f'{d / 1e6:.3f}ms'} "
+                f"children={len(self.children)}>")
+
+
+class _SpanCtx:
+    """Context manager binding one Span to a collector's thread stack."""
+
+    __slots__ = ("_collector", "_name", "_attrs", "span")
+
+    def __init__(self, collector: "Collector", name: str,
+                 attrs: Optional[Dict[str, Any]]):
+        self._collector = collector
+        self._name = name
+        self._attrs = attrs
+        self.span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        self.span = self._collector._push(self._name, self._attrs)
+        return self.span
+
+    def __exit__(self, *exc) -> bool:
+        self._collector._pop(self.span)
+        return False
+
+
+class _NoopSpan:
+    """Shared stand-in for both the no-op context manager and the span
+    it yields; every operation is a cheap no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set_attr(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    attrs: Dict[str, Any] = {}
+    duration_ns = None
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class Collector:
+    """Thread-safe span collector for one run (or one process session).
+
+    Each thread keeps its own span stack; a span opened with an empty
+    stack becomes a root.  ``roots`` and cross-thread registration are
+    lock-protected; within a thread, push/pop touch only thread-local
+    state.
+
+    Each collector owns a fresh metrics registry: while it is active,
+    ``telemetry.registry()`` resolves to it, so a run's exported
+    counters cover exactly that run (a second telemetric run in one
+    process does not inherit the first run's tallies)."""
+
+    enabled = True
+
+    def __init__(self):
+        from .metrics import Registry
+
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self.roots: List[Span] = []
+        self.registry = Registry()
+        # wall-clock anchor: epoch_ns + (t - perf0_ns) locates any span
+        # in absolute time
+        self.perf0_ns = time.perf_counter_ns()
+        self.epoch_ns = time.time_ns()
+
+    # -- span API ----------------------------------------------------------
+
+    def span(self, name: str, /, **attrs: Any) -> _SpanCtx:
+        return _SpanCtx(self, name, attrs or None)
+
+    def current(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    # -- internals ---------------------------------------------------------
+
+    def _push(self, name: str, attrs: Optional[Dict[str, Any]]) -> Span:
+        t = threading.current_thread()
+        sp = Span(name, t.ident or 0, t.name, attrs)
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        if stack:
+            stack[-1].children.append(sp)
+        else:
+            with self._lock:
+                self.roots.append(sp)
+        stack.append(sp)
+        return sp
+
+    def _pop(self, sp: Optional[Span]) -> None:
+        if sp is None:
+            return
+        sp.t1 = time.perf_counter_ns()
+        stack = getattr(self._tls, "stack", None)
+        # tolerate exits out of order (a crashed body that skipped
+        # children's __exit__): unwind to and including sp
+        while stack:
+            top = stack.pop()
+            if top is sp:
+                break
+            if top.t1 is None:
+                top.t1 = sp.t1
+
+    # -- finalization ------------------------------------------------------
+
+    def close_open_spans(self) -> None:
+        """Stamp a provisional end on every still-open span (export can
+        run mid-span, e.g. from inside store.save_1's own span)."""
+        now = time.perf_counter_ns()
+
+        def walk(sp: Span) -> None:
+            if sp.t1 is None:
+                sp.attrs.setdefault("open", True)
+                sp.t1 = now
+            for c in sp.children:
+                walk(c)
+
+        with self._lock:
+            for r in self.roots:
+                walk(r)
+
+
+class NoopCollector:
+    """The disabled collector: a no-op singleton.  ``span()`` hands back
+    one shared object; nothing is recorded."""
+
+    enabled = False
+    roots: List[Span] = []
+    registry = None  # telemetry.registry() falls back to the default
+
+    def span(self, name: str, /, **attrs: Any) -> _NoopSpan:
+        return _NOOP_SPAN
+
+    def current(self) -> None:
+        return None
+
+    def close_open_spans(self) -> None:
+        pass
+
+
+NOOP = NoopCollector()
+
+# process-wide active collector; module-level so instrumentation sites
+# (interpreter workers, checker internals) need no plumbing
+_active: Any = NOOP
+_active_lock = threading.Lock()
+
+
+def active() -> Any:
+    """The currently-active collector (NOOP when telemetry is off)."""
+    return _active
+
+
+def enabled() -> bool:
+    return _active.enabled
+
+
+def activate(collector: Optional[Collector] = None) -> Collector:
+    """Install `collector` (a fresh one by default) as the process-wide
+    active collector; returns it.  The previous collector is remembered
+    so nested activations restore correctly via :func:`deactivate`."""
+    global _active
+    c = collector or Collector()
+    with _active_lock:
+        prev = _active
+        c._prev = prev  # type: ignore[attr-defined]
+        _active = c
+    return c
+
+
+def deactivate(collector: Optional[Collector] = None) -> None:
+    """Remove `collector` (default: whatever is active), restoring its
+    predecessor."""
+    global _active
+    with _active_lock:
+        c = collector or _active
+        if c is _active and c is not NOOP:
+            _active = getattr(c, "_prev", NOOP) or NOOP
+
+
+def span(name: str, /, **attrs: Any):
+    """Open a span on the active collector — the one-liner used by
+    instrumentation sites::
+
+        with telemetry.span("elle.infer", txns=n) as sp:
+            ...
+            sp.set_attr(edges=m)
+    """
+    return _active.span(name, **attrs)
+
+
+def current() -> Optional[Span]:
+    """The innermost open span on this thread (None when disabled or
+    at top level) — for attaching attributes after the fact."""
+    return _active.current()
+
+
+def traced(name: Optional[str] = None, **attrs: Any):
+    """Decorator form: time every call of the function as a span."""
+
+    def deco(fn):
+        sp_name = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            with _active.span(sp_name, **attrs):
+                return fn(*args, **kw)
+
+        return wrapper
+
+    return deco
+
+
+class PhaseTimer:
+    """Sequential sibling spans for long linear functions: each
+    ``start()`` closes the previous phase and opens the next, without
+    the re-indentation a ``with`` block per phase would force::
+
+        ph = telemetry.phases()
+        ph.start("elle.infer", txns=n)
+        ...
+        ph.start("elle.cycle-sweep")
+        ...
+        ph.end()
+
+    An exception mid-phase leaves the span open; the collector stamps a
+    provisional end at export (`close_open_spans`)."""
+
+    __slots__ = ("_collector", "_ctx")
+
+    def __init__(self, collector: Any):
+        self._collector = collector
+        self._ctx: Any = None
+
+    def start(self, name: str, /, **attrs: Any):
+        self.end()
+        self._ctx = self._collector.span(name, **attrs)
+        return self._ctx.__enter__()
+
+    def end(self) -> None:
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+
+def phases() -> PhaseTimer:
+    """A :class:`PhaseTimer` over the active collector (no-op when
+    telemetry is off)."""
+    return PhaseTimer(_active)

@@ -88,6 +88,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._files(path[len("/files/"):])
             if path.startswith("/zip/"):
                 return self._zip(path[len("/zip/"):])
+            if path.startswith("/telemetry/"):
+                return self._telemetry(path[len("/telemetry/"):])
             self._send(404, b"not found", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
             pass
@@ -100,11 +102,15 @@ class _Handler(BaseHTTPRequestHandler):
         for d in store.tests(base=self.base):
             s = _run_summary(d)
             rel = os.path.relpath(d, self.base)
+            tel = (f'<td><a href="/telemetry/{quote(rel)}">trace</a></td>'
+                   if os.path.exists(os.path.join(d, "telemetry.json"))
+                   else "<td></td>")
             rows.append(
                 "<tr>"
                 f'<td><a href="/files/{quote(rel)}/">{html.escape(s["name"])}</a></td>'
                 f'<td><a href="/files/{quote(rel)}/">{html.escape(s["timestamp"])}</a></td>'
                 f"{_verdict_cell(s['valid?'])}"
+                f"{tel}"
                 f'<td><a href="/zip/{quote(rel)}">zip</a></td>'
                 "</tr>")
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
@@ -114,8 +120,34 @@ table {{ border-collapse: collapse; }}
 td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 </style></head><body>
 <h1>jepsen-tpu runs</h1>
-<table><tr><th>test</th><th>time</th><th>valid?</th><th>download</th></tr>
+<table><tr><th>test</th><th>time</th><th>valid?</th><th>telemetry</th><th>download</th></tr>
 {"".join(rows)}</table></body></html>"""
+        self._send(200, doc.encode())
+
+    def _telemetry(self, rel: str):
+        """Per-run telemetry page: the span-tree/metrics summary plus
+        links to the raw artifacts (trace.json loads in Perfetto)."""
+        p = self._safe_path(rel.rstrip("/"))
+        if p is None or not os.path.isdir(p) or \
+                not os.path.exists(os.path.join(p, "telemetry.json")):
+            return self._send(404, b"no telemetry for this run",
+                              "text/plain")
+        from .telemetry import export as tel_export
+        try:
+            summary = tel_export.summarize(p)
+        except Exception as e:  # noqa: BLE001 — corrupt file still 200s
+            summary = f"telemetry.json unreadable: {e}"
+        rel = rel.rstrip("/")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>telemetry — {html.escape(rel)}</title>
+<style>body {{ font-family: sans-serif; margin: 2em; }}
+pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}</style>
+</head><body>
+<p><a href="/">&larr; runs</a> &middot;
+<a href="/files/{quote(rel)}/telemetry.json">telemetry.json</a> &middot;
+<a href="/files/{quote(rel)}/trace.json">trace.json</a>
+(open in <a href="https://ui.perfetto.dev">ui.perfetto.dev</a>)</p>
+<pre>{html.escape(summary)}</pre></body></html>"""
         self._send(200, doc.encode())
 
     def _files(self, rel: str):

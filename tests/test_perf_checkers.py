@@ -154,3 +154,78 @@ def test_nemesis_intervals_kill_start_heuristic_no_metadata():
     iv = perf.nemesis_intervals(history(ops))
     assert len(iv) == 2
     assert abs(iv[0][1] - 2.0) < 0.1 and abs(iv[1][1] - 4.0) < 0.1
+    # windows are keyed to the OPENING f (the fault), not the closer
+    assert iv[0][2] == "kill" and iv[1][2] == "kill"
+
+
+def _nem_ops(spec):
+    ops = []
+    for (t, f) in spec:
+        ops.append(Op(type="invoke", process="nemesis", f=f, time=t * S))
+        ops.append(Op(type="info", process="nemesis", f=f,
+                      time=t * S + 1000))
+    return ops
+
+
+def test_nemesis_intervals_bare_start_opens_when_no_window_open():
+    # heuristic mode: with NO window open, a bare "start" is the
+    # conventional start/stop nemesis's opener, not a kill recovery
+    iv = perf.nemesis_intervals(history(_nem_ops([(1, "start"),
+                                                  (3, "stop")])))
+    assert len(iv) == 1
+    assert abs(iv[0][0] - 1.0) < 0.1 and abs(iv[0][1] - 3.0) < 0.1
+    assert iv[0][2] == "start"
+
+
+def test_nemesis_intervals_still_open_window_closes_at_history_end():
+    # a kill with no recovery: the window must extend to the last op's
+    # time instead of being dropped
+    ops = _nem_ops([(1, "kill")])
+    ops.append(Op(type="invoke", process=0, f="read", value=None,
+                  time=6 * S))
+    ops.append(Op(type="ok", process=0, f="read", value=1,
+                  time=6 * S + 1000))
+    iv = perf.nemesis_intervals(history(ops))
+    assert len(iv) == 1
+    t0, t1, f = iv[0]
+    assert abs(t0 - 1.0) < 0.1 and abs(t1 - 6.0) < 0.1 and f == "kill"
+
+
+def test_nemesis_intervals_open_window_sole_op_history():
+    # degenerate: the opening completion is the LAST op — the window
+    # closes at that same time, not negative or dropped
+    iv = perf.nemesis_intervals(history(_nem_ops([(1, "kill")])))
+    assert len(iv) == 1
+    t0, t1, _ = iv[0]
+    assert t1 >= t0 and abs(t0 - 1.0) < 0.1
+
+
+def test_nemesis_intervals_kill_start_kill_reopen_then_end():
+    # recovery closes window 1; the second kill's window runs to the end
+    iv = perf.nemesis_intervals(history(_nem_ops(
+        [(1, "kill"), (2, "start"), (4, "kill")])))
+    assert len(iv) == 2
+    assert abs(iv[0][0] - 1.0) < 0.1 and abs(iv[0][1] - 2.0) < 0.1
+    assert abs(iv[1][0] - 4.0) < 0.1 and abs(iv[1][1] - 4.0) < 0.11
+
+
+def test_graphs_degrade_without_matplotlib(tmp_path, monkeypatch):
+    """Satellite: a missing matplotlib returns computed counts instead
+    of raising into check_safe."""
+    import sys
+    # None in sys.modules makes `import matplotlib` raise ImportError
+    monkeypatch.setitem(sys.modules, "matplotlib", None)
+    monkeypatch.setitem(sys.modules, "matplotlib.pyplot", None)
+    test = {"name": "nomp", "store-dir": str(tmp_path / "s")}
+    h = _mk_history()
+    r1 = perf.LatencyGraph().check(test, h)
+    assert r1["valid?"] is True
+    assert r1["points"] == 8
+    assert r1["plot"] == "skipped (no matplotlib)"
+    r2 = perf.RateGraph().check(test, h)
+    assert r2["valid?"] is True
+    assert r2["plot"] == "skipped (no matplotlib)"
+    assert r2["points"] > 0 and r2["series"] > 0
+    # through check_safe + compose: still a clean valid result
+    res = perf.perf().check(test, h)
+    assert res["valid?"] is True
