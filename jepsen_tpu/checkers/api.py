@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, Iterable, Optional
 
 from jepsen_tpu import telemetry
 from jepsen_tpu.history.ops import FAIL, INFO, INVOKE, OK, History, Op
+from jepsen_tpu.resilience import DEADLINE_ERROR, Deadline, DeadlineExceeded
 
 
 class Checker:
@@ -57,15 +58,37 @@ def check_safe(chk: Checker, test: dict, history: History,
     `name()` rides along in the error result so composed-checker
     failures stay attributable in stored results.  Telemetric runs get
     one ``check:<name>`` span per (composed) checker, carrying the
-    history length, verdict, and throughput."""
+    history length, verdict, and throughput.
+
+    Deadlines: ``opts["time-limit"]`` (seconds) or the test map's
+    ``"checker-time-limit"`` bound the check — a cooperative
+    :class:`Deadline` is placed in ``opts["deadline"]`` for checkers
+    that poll it (the elle and knossos pipelines do), and any
+    :class:`DeadlineExceeded` escaping a checker becomes
+    ``{"valid?": "unknown", "error": "deadline-exceeded"}`` rather
+    than a crash dump.  Composed checkers share ONE deadline: the
+    outermost `check_safe` creates it, the nested calls find it
+    already present in opts."""
     try:
         name = chk.name()
     except Exception:  # noqa: BLE001 — a broken name() must not mask check()
         name = type(chk).__name__
+    dl = Deadline.resolve(opts, test)
+    if dl is not None:
+        opts = dict(opts or {}, deadline=dl)
+
+    def deadline_res() -> Dict[str, Any]:
+        telemetry.registry().counter("checker-deadline-exceeded",
+                                     checker=name).inc()
+        return {"valid?": "unknown", "checker": name,
+                "error": DEADLINE_ERROR}
+
     tel = telemetry.active()
     if not tel.enabled:
         try:
             return chk.check(test, history, opts)
+        except DeadlineExceeded:
+            return deadline_res()
         except Exception:
             return {"valid?": "unknown", "checker": name,
                     "error": traceback.format_exc()}
@@ -77,6 +100,9 @@ def check_safe(chk: Checker, test: dict, history: History,
         t0 = time.perf_counter()
         try:
             res = chk.check(test, history, opts)
+        except DeadlineExceeded:
+            sp.set_attr(ops=n, valid="unknown", error=DEADLINE_ERROR)
+            return deadline_res()
         except Exception:
             sp.set_attr(ops=n, valid="unknown", crashed=True)
             return {"valid?": "unknown", "checker": name,
@@ -422,7 +448,8 @@ class QueueChecker(Checker):
         # Concurrent dequeues make strict FIFO order unobservable; the
         # reference's queue checker likewise accepts any order but requires
         # dequeues to return enqueued-and-undelivered items.
-        return analysis(history, unordered_queue())
+        return analysis(history, unordered_queue(),
+                        deadline=(opts or {}).get("deadline"))
 
 
 class LogFilePattern(Checker):
@@ -466,7 +493,8 @@ class Linearizable(Checker):
         from jepsen_tpu.models import cas_register
 
         model = self.model or (test or {}).get("model") or cas_register()
-        return analysis(history, model, algorithm=self.algorithm)
+        return analysis(history, model, algorithm=self.algorithm,
+                        deadline=(opts or {}).get("deadline"))
 
 
 class ConcurrencyLimit(Checker):

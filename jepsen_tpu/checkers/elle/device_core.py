@@ -196,7 +196,7 @@ def core_check_auto(h: PaddedLA, n_keys: int, max_k: int = 128,
 
 
 def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
-                     round_to: int = 1):
+                     round_to: int = 1, deadline=None):
     """Host-side rebatch policy, shared by every fused-check caller.
 
     `run(max_k, max_rounds)` -> (bits, overflowed).  If the sweep
@@ -205,11 +205,23 @@ def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
     size for sharded sweeps); if the fixpoint hits max_rounds, retry with
     doubled rounds.  Gives up (returning the last, inexact result) only
     at the caps — callers then fall back to the host oracle.
+
+    `deadline` (a `resilience.Deadline`) is polled before each fixpoint
+    retry: the grow loop is the unbounded part of the fused check, and
+    a checker time budget must bound it (expiry raises
+    `DeadlineExceeded`, which `check_safe` maps to an unknown verdict).
+    Each `run` dispatch goes through the resilience guard — transient
+    device failures retry, injected faults land here in chaos mode.
     """
     import numpy as np
 
+    from jepsen_tpu import resilience
+
     while True:
-        bits, over = run(max_k, max_rounds)
+        if deadline is not None:
+            deadline.check("elle.grow-until-exact")
+        bits, over = resilience.device_call(
+            "elle.core-check", run, max_k, max_rounds, deadline=deadline)
         over_i = int(np.asarray(over))
         conv = int(np.asarray(bits)[-1]) == 1
         if over_i > 0 and max_k < MAX_K_CAP:
@@ -227,10 +239,11 @@ def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
 
 
 def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
-                     max_rounds: int = 64):
+                     max_rounds: int = 64, deadline=None):
     """core_check with host-side rebatching until exact.  Returns
     (bits, overflowed) like core_check; exact iff bits[-1] == 1 and
-    overflowed == 0."""
+    overflowed == 0.  `deadline` bounds the grow loop (see
+    grow_until_exact)."""
     if _use_staged(h):
         # staged split: infer is independent of max_k/max_rounds, so a
         # budget retry re-runs only the (cheap-on-acyclic) sweep stage —
@@ -239,7 +252,7 @@ def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
         jax.block_until_ready(out)
         return grow_until_exact(
             lambda k, r: _sweep_stage(out, max_k=k, max_rounds=r),
-            max_k, max_rounds)
+            max_k, max_rounds, deadline=deadline)
     return grow_until_exact(
         lambda k, r: core_check(h, n_keys, max_k=k, max_rounds=r),
-        max_k, max_rounds)
+        max_k, max_rounds, deadline=deadline)

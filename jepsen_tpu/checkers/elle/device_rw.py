@@ -350,17 +350,24 @@ RW_CAP_LIMIT = 1 << 24
 
 
 def check(p: PackedTxns | PaddedLA, n_keys: int = None, max_k: int = 128,
-          max_rounds: int = 64) -> dict:
+          max_rounds: int = 64, deadline=None, policy=None,
+          plan=None) -> dict:
     """Fused device check of an rw-register history; summary dict in the
     `check_sharded` row format.  Grows the backward-edge and rw-join
     budgets on overflow (exactness first); returns "unknown" only when
-    every budget is exhausted — callers then use the host checker."""
+    every budget is exhausted — callers then use the host checker.
+
+    Resilience: the fused jit seam runs under the device guard
+    (transient retries per `policy`, synthetic faults per `plan`);
+    `deadline` is polled before each grow-retry and raises
+    `DeadlineExceeded` on expiry — `rw_register.check` and `check_safe`
+    map that to an unknown/degraded verdict."""
     from jepsen_tpu.checkers.elle.device_core import (
         MAX_K_CAP,
         MAX_ROUNDS_CAP,
     )
 
-    from jepsen_tpu import telemetry
+    from jepsen_tpu import resilience, telemetry
 
     h = p if isinstance(p, PaddedLA) else pad_packed(p)
     n_keys = h.n_keys if n_keys is None else n_keys
@@ -374,9 +381,13 @@ def check(p: PackedTxns | PaddedLA, n_keys: int = None, max_k: int = 128,
              t_pad=h.txn_type.shape[0])
 
     while True:
-        bits, over, rw_over = rw_core_check(h, n_keys, max_k=max_k,
-                                            max_rounds=max_rounds,
-                                            rw_cap=rw_cap)
+        if deadline is not None:
+            deadline.check("elle.rw-core-check")
+        bits, over, rw_over = resilience.device_call(
+            "elle.rw-core-check",
+            lambda: rw_core_check(h, n_keys, max_k=max_k,
+                                  max_rounds=max_rounds, rw_cap=rw_cap),
+            policy=policy, deadline=deadline, plan=plan)
         over_i = int(np.asarray(over))
         rw_over_i = int(np.asarray(rw_over))
         conv = int(np.asarray(bits)[-1]) == 1

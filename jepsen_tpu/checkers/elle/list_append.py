@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Sequence, Set, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from jepsen_tpu import telemetry
+from jepsen_tpu import resilience, telemetry
 from jepsen_tpu.checkers.elle import consistency, coverage, oracle
 from jepsen_tpu.checkers.elle.device_infer import PaddedLA, infer, pad_packed
 from jepsen_tpu.checkers.elle.graph import (
@@ -54,9 +54,58 @@ _WARM: Dict[str, bool] = {}
 
 def check(history, consistency_models: Sequence[str] = ("serializable",),
           anomalies: Sequence[str] = (), max_reported: int = 8,
-          _force_no_fallback: bool = False) -> Dict[str, Any]:
+          _force_no_fallback: bool = False, deadline=None, policy=None,
+          plan=None) -> Dict[str, Any]:
     """Check a list-append history on device.  Accepts History / op list /
-    PackedTxns."""
+    PackedTxns.
+
+    Resilience (ISSUE 2): `deadline` (a `resilience.Deadline`) is polled
+    between device stages and per sweep projection — expiry returns
+    ``{"valid?": "unknown", "error": "deadline-exceeded"}`` with
+    whatever anomaly counts inference already produced.  The device
+    entry points (infer, cycle sweep) run under the resilience guard:
+    transient XLA failures retry per `policy`; a persistent device
+    failure degrades to the host oracle with ``"degraded":
+    "host-fallback"`` stamped into the result.  `plan` pins a fault
+    plan (tests/chaos); default is the process-active one."""
+    try:
+        return _check_device(history, consistency_models, anomalies,
+                             max_reported, _force_no_fallback, deadline,
+                             policy, plan)
+    except resilience.DeadlineExceeded:
+        # expiry before/inside a device stage: the canonical unknown —
+        # the sweep loop returns richer partial stats on its own
+        return resilience.deadline_result(checker="list-append")
+    except Exception as e:  # noqa: BLE001 — persistent device failure
+        if _force_no_fallback:
+            raise
+        try:
+            # shared degradation tail: counter + span attr + deadline
+            # poll + "degraded"/"device-error" stamps (guard.py) — an
+            # expired budget is never converted into a host run
+            return resilience.degrade_to_host(
+                "elle.list-append",
+                lambda: oracle.check(history, consistency_models,
+                                     anomalies,
+                                     max_reported=max_reported),
+                e, deadline=deadline)
+        except resilience.DeadlineExceeded:
+            return resilience.deadline_result(checker="list-append")
+
+
+def _check_device(history, consistency_models, anomalies, max_reported,
+                  _force_no_fallback, deadline, policy, plan
+                  ) -> Dict[str, Any]:
+    def poll(site: str) -> None:
+        if deadline is not None:
+            deadline.check(site)
+
+    def dev(site: str, fn, *args):
+        # guarded seam: synthetic faults fire here, transients retry;
+        # a persistent failure raises out to check()'s oracle fallback
+        return resilience.device_call(site, fn, *args, policy=policy,
+                                      deadline=deadline, plan=plan)
+
     # phase spans matching the host oracle's stage names (device=True
     # distinguishes them in one trace); "warm" records whether this
     # process already traced/compiled the infer program — the closest
@@ -72,6 +121,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
         return {"valid?": "unknown", "anomaly-types": [], "anomalies": {},
                 "not": [], "also-not": []}
 
+    poll("elle.infer")
     ph.start("elle.infer", device=True, txns=p.n_txns,
              warm=_WARM.get("infer", False))
     _WARM["infer"] = True
@@ -83,7 +133,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
                 h.txn_complete_pos, h.txn_mask, h.mop_txn, h.mop_kind,
                 h.mop_key, h.mop_val, h.mop_rd_start, h.mop_rd_len,
                 h.mop_mask, h.rd_elems, h.rd_elem_mask)))
-    out = infer(h, h.n_keys)
+    out = dev("elle.infer", infer, h, h.n_keys)
 
     found: Dict[str, List[Any]] = {}
     counts = {k: int(v) for k, v in out["counts"].items()}
@@ -136,6 +186,20 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     ph.start("elle.cycle-sweep", device=True,
              projections=len(projections))
     for rels, group in projections.items():
+        # deadline poll per projection: the sweep fixpoint retries
+        # (grow max_k/max_rounds) can stretch a pathological history —
+        # expiry returns unknown + the counts inference already found
+        # (via check(), not bare expired(), so the telemetry counter
+        # records the expiry site)
+        if deadline is not None:
+            try:
+                deadline.check("elle.cycle-sweep")
+            except resilience.DeadlineExceeded:
+                ph.end()
+                return resilience.deadline_result(
+                    **{"anomaly-types": sorted(found),
+                       "anomalies": found, "not": [], "also-not": [],
+                       "partial": "cycle-sweep interrupted"})
         sel = jnp.zeros_like(base_mask)
         for r in rels:
             sel = sel | (rel_arr == r)
@@ -146,7 +210,8 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
         g = SweepGraph(n_nodes=2 * T, rank=rank, nc_src=e_src, nc_dst=e_dst,
                        nc_mask=mask, chain_nodes=chain_nodes,
                        chain_starts=chain_starts, chain_mask=cmask)
-        res = detect_cycles(g)
+        res = dev("elle.cycle-sweep",
+                  lambda g=g: detect_cycles(g, deadline=deadline))
         if not res.converged:
             needs_fallback = True
             break
@@ -181,6 +246,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
         ph.end()
         if _force_no_fallback:
             raise RuntimeError("cycle sweep did not converge")
+        poll("elle.host-fallback")
         # pass the ORIGINAL input: an op-level history keeps its session
         # checkability through the fallback (packing drops it)
         return oracle.check(history, consistency_models, anomalies,
@@ -190,6 +256,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     # after the fallback decision, so a non-converged sweep doesn't do
     # the (host-side) session walk twice (see coverage.py for the
     # PackedTxns degradation rule)
+    poll("elle.sessions")
     ph.start("elle.sessions", device=False)
     sess_found, sess_checked = coverage.run_la_sessions(
         history, want, isinstance(history, PackedTxns),

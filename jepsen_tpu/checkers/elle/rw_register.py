@@ -62,7 +62,8 @@ def sessions_guarantees():
 
 def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
           anomalies: Sequence[str] = (), use_device: bool = True,
-          max_reported: int = 8) -> Dict[str, Any]:
+          max_reported: int = 8, deadline=None, policy=None,
+          plan=None) -> Dict[str, Any]:
     """Check an rw-register history.  Accepts History / op list /
     PackedTxns (packed with workload='rw-register').
 
@@ -70,7 +71,12 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
     (`device_rw.rw_core_check` — inference AND sweeps on device, config-3
     scale): a clean exact verdict returns without any host inference;
     anything else falls through to this host path, which produces the
-    full anomaly report (witness cycles, Explainer edges)."""
+    full anomaly report (witness cycles, Explainer edges).
+
+    Resilience: a persistent device failure on the fast path (after
+    `policy` retries; synthetic faults per `plan`) degrades to this
+    host path with ``"degraded": "host-fallback"`` stamped; `deadline`
+    expiry returns the canonical deadline-exceeded unknown."""
     p = history if isinstance(history, PackedTxns) \
         else pack_txns(history, "rw-register")
     if p.n_txns == 0 or not (p.txn_type == TXN_OK).any():
@@ -113,16 +119,41 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
                                           for w in sess_want])
         sess_found = sres["anomalies"]
 
+    degraded = None
+    device_error = None
+
     def finalize(result: Dict[str, Any]) -> Dict[str, Any]:
         from jepsen_tpu.checkers.elle import coverage
 
+        if degraded:
+            result["degraded"] = degraded
+            if device_error:
+                result["device-error"] = device_error
         return coverage.apply_unchecked(result, sess_unchecked)
 
     if use_device and p.n_txns >= FUSED_MIN_TXNS:
+        from jepsen_tpu import resilience
         from jepsen_tpu.checkers.elle import device_rw
 
-        fast = device_rw.check(p)
-        if fast["valid?"] is True and fast["exact"]:
+        try:
+            fast = device_rw.check(p, deadline=deadline, policy=policy,
+                                   plan=plan)
+        except resilience.DeadlineExceeded:
+            return resilience.deadline_result(checker="rw-register")
+        except Exception as e:  # noqa: BLE001 — persistent device failure
+            # the host path below IS the oracle; degrade to it through
+            # the shared tail (counter + span attr + deadline poll — an
+            # expired budget must not buy an unbounded host run)
+            try:
+                resilience.degrade_to_host(
+                    "elle.rw-register", lambda: None, e,
+                    deadline=deadline)
+            except resilience.DeadlineExceeded:
+                return resilience.deadline_result(checker="rw-register")
+            degraded = resilience.DEGRADED_HOST
+            device_error = f"{type(e).__name__}: {e}"
+            fast = None
+        if fast is not None and fast["valid?"] is True and fast["exact"]:
             anomaly_types = sorted(sess_found)
             boundary = consistency.friendly_boundary(anomaly_types)
             bad = set(boundary["not"]) | set(boundary["also-not"])

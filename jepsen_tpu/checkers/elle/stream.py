@@ -117,16 +117,24 @@ def stage_chunks(chunks: Iterable, workload: str = "list-append"
 
 
 def check_stored(test_or_dir, workload: str = "list-append",
-                 max_k: int = 128, max_rounds: int = 64) -> Dict[str, Any]:
+                 max_k: int = 128, max_rounds: int = 64,
+                 deadline=None) -> Dict[str, Any]:
     """Check a STORED list-append run end-to-end without materializing
     its op list: lazy chunks -> streamed device staging -> fused core
     check.  Accepts a store dir path or a loaded test map whose history
     is a LazyHistory.  Returns a summary dict (check_sharded row shape).
+
+    `deadline` (or the test map's ``"checker-time-limit"``) bounds the
+    fused check's grow loop — expiry raises `DeadlineExceeded` (callers
+    under `check_safe` get the canonical unknown verdict).
     """
     from jepsen_tpu import store
+    from jepsen_tpu.resilience import Deadline
 
     test = store.load(test_or_dir) if isinstance(test_or_dir, str) \
         else test_or_dir
+    if deadline is None:
+        deadline = Deadline.resolve(None, test)
     hist = test.get("history")
     if hist is None:
         return {"valid?": "unknown", "counts": {}, "cycles": {},
@@ -143,12 +151,14 @@ def check_stored(test_or_dir, workload: str = "list-append",
         # inference — route to the fused rw checker (same staged arrays)
         from jepsen_tpu.checkers.elle import device_rw
 
-        res = device_rw.check(h, max_k=max_k, max_rounds=max_rounds)
+        res = device_rw.check(h, max_k=max_k, max_rounds=max_rounds,
+                              deadline=deadline)
         res["n-txns"] = pk.n_txns
         return res
 
     bits, over = core_check_exact(h, h.n_keys, max_k=max_k,
-                                  max_rounds=max_rounds)
+                                  max_rounds=max_rounds,
+                                  deadline=deadline)
     row = np.asarray(bits)
     over_i = int(np.asarray(over))
     counts = {n: int(row[j]) for j, n in enumerate(COUNT_NAMES)}

@@ -24,7 +24,7 @@ logger = logging.getLogger("jepsen.knossos")
 from jepsen_tpu import telemetry
 from jepsen_tpu.checkers.knossos import device_wgl, linear, wgl
 from jepsen_tpu.checkers.knossos.prep import prepare
-from jepsen_tpu.checkers.knossos.search import ChildSearch
+from jepsen_tpu.checkers.knossos.search import ChildSearch, stamp_abort
 from jepsen_tpu.history.ops import History
 from jepsen_tpu.models import Model
 
@@ -103,7 +103,8 @@ def _race(contestants, ops, model, ctl, _also_accepts=(),
                                 return res
                     except _queue.Empty:
                         pass
-                    return dict(fallback, reason="aborted")
+                    return stamp_abort(dict(fallback, reason="aborted"),
+                                       ctl)
                 continue
             pending -= 1
             if err is not None:
@@ -167,7 +168,7 @@ def _polled(root, fn):
 
 
 def analysis(history: History, model: Model,
-             algorithm: str = "auto", deadline_s=None,
+             algorithm: str = "auto", deadline_s=None, deadline=None,
              **kw) -> Dict[str, Any]:
     """Linearizability analysis.
     algorithm: auto | wgl | linear | device | competition.
@@ -182,24 +183,31 @@ def analysis(history: History, model: Model,
     analysis for exactly the histories where the host DFS would answer
     (measured: a 1300-op 185-info history held the device leg >25 min
     while racing legs bound it).  `deadline_s` bounds the WHOLE
-    analysis (race + fallback), anchored here; a caller-supplied `ctl`
-    is never aborted by the race — losers are cancelled through linked
-    child ctls (`search.ChildSearch`), so one ctl can bound a whole
-    campaign of analyses.  Remaining `**kw` (e.g. max_configs) is
-    forwarded to EVERY leg, device included: an explicit budget bounds
-    the whole analysis, not just the host algorithms.
+    analysis (race + fallback), anchored here; `deadline` (a
+    cooperative `resilience.Deadline`, typically `check_safe`'s
+    checker-time-limit budget) does the same but is shared with the
+    caller, so one budget covers a whole composed check.  A
+    deadline-driven abort returns ``{"valid?": "unknown",
+    "error": "deadline-exceeded", ...partial stats}`` — never a hang.
+    A caller-supplied `ctl` is never aborted by the race — losers are
+    cancelled through linked child ctls (`search.ChildSearch`), so one
+    ctl can bound a whole campaign of analyses.  Remaining `**kw`
+    (e.g. max_configs) is forwarded to EVERY leg, device included: an
+    explicit budget bounds the whole analysis, not just the host
+    algorithms.
     """
     with telemetry.span("knossos.analysis", algorithm=algorithm) as sp:
         with telemetry.span("knossos.prep"):
             ops = prepare(history)
         sp.set_attr(ops=len(ops))
-        res = _dispatch(ops, model, algorithm, deadline_s, kw)
+        res = _dispatch(ops, model, algorithm, deadline_s, deadline, kw)
         sp.set_attr(valid=res.get("valid?"),
-                    algorithm_used=res.get("algorithm", algorithm))
+                    algorithm_used=res.get("algorithm", algorithm),
+                    error=res.get("error"))
         return res
 
 
-def _dispatch(ops, model: Model, algorithm: str, deadline_s,
+def _dispatch(ops, model: Model, algorithm: str, deadline_s, deadline,
               kw: Dict[str, Any]) -> Dict[str, Any]:
     parent = kw.pop("ctl", None)
     # one root per analysis: carries this call's deadline (absolute from
@@ -210,8 +218,9 @@ def _dispatch(ops, model: Model, algorithm: str, deadline_s,
     # poll anyway.
     # `is not None`, not truthiness: deadline_s=0 means "already
     # expired, abort promptly", the opposite of unbounded
-    root = (ChildSearch(parent, deadline_s=deadline_s)
-            if parent is not None or deadline_s is not None else None)
+    root = (ChildSearch(parent, deadline_s=deadline_s, deadline=deadline)
+            if parent is not None or deadline_s is not None
+            or deadline is not None else None)
     if algorithm == "wgl":
         return _polled(root, lambda: wgl.check(ops, model, ctl=root, **kw))
     if algorithm == "linear":

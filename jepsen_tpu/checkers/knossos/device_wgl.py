@@ -75,7 +75,9 @@ _DEBUG = bool(os.environ.get("JT_WGL_DEBUG"))
 
 from jepsen_tpu.checkers.knossos.memo import Memo, StateExplosion, memoize
 from jepsen_tpu.checkers.knossos.prep import NEVER, LinOp
+from jepsen_tpu.checkers.knossos.search import stamp_abort
 from jepsen_tpu.models import Model
+from jepsen_tpu.resilience import NO_PLAN, device_call
 
 INF = jnp.int32(2 ** 30)
 
@@ -224,7 +226,8 @@ def check(ops: Sequence[LinOp], model: Model,
                 "reason": "too many ops for device WGL"}
     if ctl is not None and ctl.aborted():
         # an expired/cancelled ctl skips the memoize/setup/transfer cost
-        return {"valid?": "unknown", "op-count": n, "reason": "aborted"}
+        return stamp_abort({"valid?": "unknown", "op-count": n,
+                            "reason": "aborted"}, ctl)
     try:
         memo = memoize(model, ops)
     except StateExplosion:
@@ -242,7 +245,11 @@ def check(ops: Sequence[LinOp], model: Model,
     # stay cancellable (non-daemon racer threads join at process exit —
     # daemon threads SIGABRT inside native XLA teardown).
     if n <= 1024 and ctl is None:
-        lin, exhausted, overflow = _frontier_search(
+        # guarded device seam: transient XLA failures (or injected
+        # faults) retry per policy; persistent ones propagate to the
+        # caller (the competition treats a crashed leg as a loser)
+        lin, exhausted, overflow = device_call(
+            "knossos.device-wgl", _frontier_search,
             n_pad, W, max_frontier, n + 1,
             jnp.asarray(invokes), jnp.asarray(returns),
             jnp.asarray(op_sym), jnp.asarray(must), jnp.asarray(table),
@@ -253,9 +260,10 @@ def check(ops: Sequence[LinOp], model: Model,
                     "hash_dedup": True}
         # fall through: re-run with host-spilled frontier blocks
 
-    return _blocked_search(n, n_pad, W, invokes, returns, op_sym, must,
-                           table, memo.init_state, z1, z2,
-                           max_frontier, max_configs, ctl)
+    return stamp_abort(
+        _blocked_search(n, n_pad, W, invokes, returns, op_sym, must,
+                        table, memo.init_state, z1, z2,
+                        max_frontier, max_configs, ctl), ctl)
 
 
 def _blocked_and_check(ops: Sequence[LinOp], model: Model,
@@ -266,16 +274,18 @@ def _blocked_and_check(ops: Sequence[LinOp], model: Model,
     and by callers that know the frontier will overflow."""
     n = len(ops)
     if ctl is not None and ctl.aborted():
-        return {"valid?": "unknown", "op-count": n, "reason": "aborted"}
+        return stamp_abort({"valid?": "unknown", "op-count": n,
+                            "reason": "aborted"}, ctl)
     try:
         memo = memoize(model, ops)
     except StateExplosion:
         return {"valid?": "unknown", "op-count": n,
                 "reason": "model state explosion"}
     n_pad, W, invokes, returns, op_sym, must, z1, z2 = _setup(ops, memo)
-    return _blocked_search(n, n_pad, W, invokes, returns, op_sym, must,
-                           memo.table, memo.init_state, z1, z2,
-                           max_frontier, max_configs, ctl)
+    return stamp_abort(
+        _blocked_search(n, n_pad, W, invokes, returns, op_sym, must,
+                        memo.table, memo.init_state, z1, z2,
+                        max_frontier, max_configs, ctl), ctl)
 
 
 # ---------------------------------------------------------------------------
@@ -370,6 +380,14 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
     everything else — the SURVEY §7 "host spill" answer to WGL state
     explosion.
     """
+    from jepsen_tpu.resilience import plan_for
+
+    # resolve the fault plan ONCE per search: the expand loop below is
+    # hot (thousands of block dispatches) and must not re-consult the
+    # env per call; one plan also means one coherent call counter.  The
+    # NO_PLAN sentinel tells device_call "already resolved, none" so
+    # the no-faults case skips the per-call lookup too
+    fault_plan = plan_for(None) or NO_PLAN
     F_max = max(64, min(max_frontier, 16384))
 
     table_dev = jnp.asarray(table)
@@ -548,10 +566,12 @@ def _blocked_search(n, n_pad, W, invokes, returns, op_sym, must, table,
             st, bi, a1, a2, va = work.pop()
             F = len(st)
             C = cap_of(F, A)
-            outs = _expand_block(A, W, F, C, *win, table_dev,
-                                 jnp.asarray(st), jnp.asarray(bi),
-                                 jnp.asarray(a1), jnp.asarray(a2),
-                                 jnp.asarray(va))
+            outs = device_call(
+                "knossos.device-wgl.expand", _expand_block,
+                A, W, F, C, *win, table_dev,
+                jnp.asarray(st), jnp.asarray(bi),
+                jnp.asarray(a1), jnp.asarray(a2),
+                jnp.asarray(va), plan=fault_plan)
             o_st, o_bi, o_h1, o_h2, o_va, n_uniq = (np.asarray(x)
                                                     for x in outs)
             if int(n_uniq) > C:

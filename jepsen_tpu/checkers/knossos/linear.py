@@ -32,7 +32,7 @@ import numpy as np
 
 from jepsen_tpu.checkers.knossos.memo import Memo, StateExplosion, memoize
 from jepsen_tpu.checkers.knossos.prep import NEVER, LinOp, prepare
-from jepsen_tpu.checkers.knossos.search import Search
+from jepsen_tpu.checkers.knossos.search import Search, stamp_abort
 from jepsen_tpu.history.ops import History
 from jepsen_tpu.models import Model
 
@@ -337,7 +337,8 @@ def check(history: "History | Sequence[LinOp]", model: Model,
                 "op-count": len(ops)}
     ok, info = _search(ops, memo, max_configs, ctl)
     if ok is None:
-        return {"valid?": "unknown", **(info or {})}
+        return stamp_abort({"valid?": "unknown", "op-count": len(ops),
+                            **(info or {})}, ctl)
     out: Dict[str, Any] = {"valid?": bool(ok), "op-count": len(ops),
                            "algorithm": "linear"}
     if info:

@@ -20,15 +20,26 @@ class Search:
     `flag` is a (1,) int32 shared with native searches: ctypes calls
     release the GIL, so the C++ WGL polls this memory while another
     thread aborts — the loser of a competition stops within ~1k configs
-    instead of running out its full budget."""
+    instead of running out its full budget.
 
-    def __init__(self, *, deadline_s: Optional[float] = None):
+    Aborts carry a *reason* ("aborted" for competition losers /
+    caller cancels, "deadline-exceeded" for expired budgets) so the
+    final result can attribute WHY the search stopped — the resilience
+    contract that a bounded run returns `error: deadline-exceeded`
+    rather than a bare unknown.  `deadline` may also be a cooperative
+    `resilience.Deadline` object shared with the rest of a composed
+    checker run (one budget over the whole analysis)."""
+
+    def __init__(self, *, deadline_s: Optional[float] = None,
+                 deadline=None):
         self._abort = threading.Event()
         self.flag = np.zeros(1, dtype=np.int32)
         # `is not None`: deadline_s=0 means already expired, not "no
         # deadline"
         self.deadline = (time.monotonic() + deadline_s
                          if deadline_s is not None else None)
+        self.deadline_obj = deadline  # resilience.Deadline, cooperative
+        self.abort_reason: Optional[str] = None
         self._explored_lock = threading.Lock()
         self.explored = 0
         self.result: Optional[dict] = None
@@ -40,7 +51,9 @@ class Search:
         with self._explored_lock:
             self.explored += n
 
-    def abort(self) -> None:
+    def abort(self, reason: str = "aborted") -> None:
+        if self.abort_reason is None:
+            self.abort_reason = reason
         self._abort.set()
         self.flag[0] = 1
 
@@ -48,13 +61,32 @@ class Search:
         if self._abort.is_set():
             return True
         if self.deadline is not None and time.monotonic() > self.deadline:
-            self.abort()
+            self.abort(DEADLINE_REASON)
+            return True
+        if self.deadline_obj is not None and self.deadline_obj.expired():
+            self.abort(DEADLINE_REASON)
             return True
         return False
 
     def report(self, result: dict) -> dict:
         self.result = result
         return result
+
+
+DEADLINE_REASON = "deadline-exceeded"
+
+
+def stamp_abort(res: dict, ctl) -> dict:
+    """Attribute an aborted search's cause in its result: a
+    deadline-driven abort becomes ``error: deadline-exceeded`` (the
+    canonical resilience verdict shape); other aborts keep their
+    ``reason``.  No-op for definitive results or ctl-less calls."""
+    if (ctl is not None and isinstance(res, dict)
+            and res.get("valid?") == "unknown"
+            and getattr(ctl, "abort_reason", None) == DEADLINE_REASON):
+        res = dict(res, error=DEADLINE_REASON)
+        res["explored"] = res.get("explored", ctl.explored)
+    return res
 
 
 class ChildSearch(Search):
@@ -69,14 +101,16 @@ class ChildSearch(Search):
     participant polls this child."""
 
     def __init__(self, parent: Optional[Search] = None, *,
-                 deadline_s: Optional[float] = None):
-        super().__init__(deadline_s=deadline_s)
+                 deadline_s: Optional[float] = None, deadline=None):
+        super().__init__(deadline_s=deadline_s, deadline=deadline)
         self._parent = parent
 
     def aborted(self) -> bool:
         p = self._parent
         if p is not None and p.aborted():
-            self.abort()
+            # inherit the parent's reason: a deadline that fired on the
+            # root must surface as deadline-exceeded from every leg
+            self.abort(p.abort_reason or "aborted")
         return super().aborted()
 
     # `explored` forwards up the chain so a campaign polling ITS handle

@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from jepsen_tpu.checkers.knossos.memo import Memo, StateExplosion, memoize
 from jepsen_tpu.checkers.knossos.prep import NEVER, LinOp, prepare
+from jepsen_tpu.checkers.knossos.search import stamp_abort
 from jepsen_tpu.history.ops import History
 from jepsen_tpu.models import Inconsistent, Model
 
@@ -200,7 +201,10 @@ def check(history: History | Sequence[LinOp], model: Model,
     except StateExplosion:
         ok, info = _search_direct(ops, model, max_configs, ctl)
     if ok is None:
-        return {"valid?": "unknown", **(info or {})}
+        # an aborted search names its cause: deadline-driven aborts
+        # surface as error=deadline-exceeded (resilience contract)
+        return stamp_abort({"valid?": "unknown", "op-count": len(ops),
+                            **(info or {})}, ctl)
     out: Dict[str, Any] = {"valid?": bool(ok), "op-count": len(ops)}
     if info:
         out["final-info"] = info

@@ -73,6 +73,11 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    help='number of workers, e.g. "30" or "10n" (per node)')
     p.add_argument("--time-limit", type=float, default=60.0,
                    help="seconds to run the workload")
+    p.add_argument("--checker-time-limit", type=float, default=None,
+                   help="seconds of analysis budget per check; past it "
+                        "checkers return valid? = unknown with "
+                        "error = deadline-exceeded instead of running "
+                        "unbounded (see docs/RESILIENCE.md)")
     p.add_argument("--test-count", type=int, default=1,
                    help="how many times to run the test")
     p.add_argument("--username", default="root", help="ssh user")
@@ -101,6 +106,7 @@ def opts_to_test_map(opts: argparse.Namespace) -> Dict[str, Any]:
         "concurrency": parse_concurrency(opts.concurrency, len(nodes)),
         "concurrency-spec": opts.concurrency,
         "time-limit": opts.time_limit,
+        "checker-time-limit": getattr(opts, "checker_time_limit", None),
         "leave-db-running": opts.leave_db_running,
         "store-dir": opts.store_dir,
     })

@@ -29,6 +29,7 @@ import traceback
 from typing import Any, Dict, Optional
 
 from . import db as db_proto
+from . import net as net_proto
 from . import os_setup, store, telemetry
 from .checkers import api as checker_api
 from .control import api as control
@@ -49,6 +50,7 @@ def noop_test(**overrides) -> dict:
         "concurrency": 1,
         "os": os_setup.noop,
         "db": db_proto.Noop(),
+        "net": net_proto.noop,
         "client": None,
         "nemesis": None,
         "generator": None,

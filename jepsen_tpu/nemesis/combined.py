@@ -14,9 +14,9 @@ and composes the requested ones into a single package whose nemesis is a
 schedules (interval-driven: sleep -> start -> sleep -> stop -> ...).
 
 `opts["faults"]` picks packages: any of {"partition", "kill", "pause",
-"clock", "file"}; `opts["interval"]` (seconds, default 10) spaces fault
-start/stop pairs; `opts["db"]` supplies Process/Pause facets for
-kill/pause; `opts["file"]` the corruption target.
+"clock", "file", "traffic"}; `opts["interval"]` (seconds, default 10)
+spaces fault start/stop pairs; `opts["db"]` supplies Process/Pause
+facets for kill/pause; `opts["file"]` the corruption target.
 """
 
 from __future__ import annotations
@@ -217,10 +217,46 @@ def file_package(opts: dict) -> Optional[dict]:
     }
 
 
+# ---------------------------------------------------------------- traffic
+
+def traffic_package(opts: dict) -> Optional[dict]:
+    """Traffic-shaping fault package: drives the `Net.slow/flaky/shape`
+    protocol (which no package exercised before) through a
+    :class:`~jepsen_tpu.nemesis.core.TrafficShaper`.  Each cycle picks
+    one shaping mode at random, holds it for `interval`, then heals
+    with ``fast``."""
+    if "traffic" not in opts.get("faults", ()):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    rng = opts.get("rng") or _random
+
+    def degrade(test, ctx):
+        f = rng.choice(["slow", "flaky", "shape"])
+        value = {
+            "slow": {"mean_ms": float(rng.choice([20, 50, 200])),
+                     "variance_ms": float(rng.choice([5, 10, 50]))},
+            "flaky": {"loss_pct": float(rng.choice([5, 20, 45])),
+                      "correlation_pct": 75.0},
+            "shape": ["delay", f"{rng.choice([10, 100, 500])}ms",
+                      "loss", f"{rng.choice([1, 5])}%"],
+        }[f]
+        return {"f": f, "value": value}
+
+    return {
+        "nemesis": nc.traffic_shaper(),
+        "generator": gen.cycle([gen.sleep(interval), gen.once(degrade),
+                                gen.sleep(interval),
+                                {"f": "fast", "value": None}]),
+        "final_generator": {"f": "fast", "value": None},
+        "perf": {"name": "traffic", "start": {"slow", "flaky", "shape"},
+                 "stop": {"fast"}, "fs": set()},
+    }
+
+
 # ---------------------------------------------------------------- compose
 
 PACKAGE_FNS = [partition_package, kill_package, pause_package,
-               clock_package, file_package]
+               clock_package, file_package, traffic_package]
 
 
 def _fs_of(pkg: dict) -> set:

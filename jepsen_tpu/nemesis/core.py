@@ -18,8 +18,17 @@ import random as _random
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 from jepsen_tpu import control
+from jepsen_tpu import net as net_
 from jepsen_tpu.control import on_nodes
 from jepsen_tpu.utils.core import majority
+
+
+def _net(test: dict) -> net_.Net:
+    """The test's Net, defaulting to the noop net: nemeses must work on
+    test maps without a ``"net"`` key (`core.noop_test` now carries
+    one, but hand-built maps routinely don't — a KeyError here used to
+    kill Partitioner.setup/invoke/teardown)."""
+    return test.get("net") or net_.noop
 
 
 class Nemesis:
@@ -161,13 +170,13 @@ class Partitioner(Nemesis):
         self.stop_f = stop_f
 
     def setup(self, test):
-        test["net"].heal(test)
+        _net(test).heal(test)
         return self
 
     def invoke(self, test, op):
         if op["f"] == self.start_f:
             grudge = op.get("value") or self.grudge_fn(test["nodes"])
-            net = test["net"]
+            net = _net(test)
             if hasattr(net, "drop_all"):
                 net.drop_all(test, grudge)
             else:
@@ -177,12 +186,12 @@ class Partitioner(Nemesis):
             return dict(op, type="info",
                         value={d: sorted(s) for d, s in grudge.items()})
         elif op["f"] == self.stop_f:
-            test["net"].heal(test)
+            _net(test).heal(test)
             return dict(op, type="info", value="network healed")
         raise ValueError(f"partitioner can't handle op f={op['f']!r}")
 
     def teardown(self, test):
-        test["net"].heal(test)
+        _net(test).heal(test)
 
 
 def partitioner(grudge_fn: Optional[Callable] = None, **kw) -> Nemesis:
@@ -282,3 +291,58 @@ def hammer_time(process_pattern: str,
 
     return NodeStartStopper(targeter, stop, start,
                             start_f="start-pause", stop_f="stop-pause")
+
+
+class TrafficShaper(Nemesis):
+    """Drives the Net traffic-shaping protocol (the `net.py` methods
+    nothing drove before this): ``slow``/``flaky``/``shape`` ops apply
+    latency/loss/raw-netem behaviors cluster-wide; ``fast`` heals.
+
+    Op values:
+      slow  — kwargs dict for `Net.slow` (mean_ms, variance_ms,
+              distribution); None for defaults
+      flaky — kwargs dict for `Net.flaky` (loss_pct, correlation_pct)
+      shape — raw netem behavior list, e.g. ["delay", "100ms",
+              "loss", "5%"]
+      fast  — ignored
+
+    The completion's value echoes what was applied so the history
+    records the actual shaping (same contract as the partitioner's
+    grudge echo).
+    """
+
+    def __init__(self, *, fast_f: str = "fast"):
+        self.fast_f = fast_f
+
+    def setup(self, test):
+        _net(test).fast(test)
+        return self
+
+    def invoke(self, test, op):
+        net = _net(test)
+        f = op["f"]
+        if f == "slow":
+            kw = dict(op.get("value") or {})
+            net.slow(test, **kw)
+            return dict(op, type="info", value=["slow", kw])
+        if f == "flaky":
+            kw = dict(op.get("value") or {})
+            net.flaky(test, **kw)
+            return dict(op, type="info", value=["flaky", kw])
+        if f == "shape":
+            behaviors = list(op.get("value") or ())
+            if not behaviors:
+                raise ValueError("shape op needs a netem behavior list")
+            net.shape(test, behaviors)
+            return dict(op, type="info", value=["shape", behaviors])
+        if f == self.fast_f:
+            net.fast(test)
+            return dict(op, type="info", value="shaping removed")
+        raise ValueError(f"traffic shaper can't handle op f={f!r}")
+
+    def teardown(self, test):
+        _net(test).fast(test)
+
+
+def traffic_shaper(**kw) -> Nemesis:
+    return TrafficShaper(**kw)

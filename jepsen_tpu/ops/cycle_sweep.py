@@ -396,13 +396,20 @@ class SweepResult:
 
 
 def detect_cycles(g: SweepGraph, max_k: int = 128,
-                  max_rounds: int = 64) -> SweepResult:
+                  max_rounds: int = 64, deadline=None) -> SweepResult:
     """Run the sweep; rebatch automatically if backward edges exceed max_k.
 
     Exact: cycle reported iff one exists in the (masked) graph, provided
     converged=True.  Witnesses identify backward edges on cycles (for the
     first max_k; enough to hand the host a subgraph to classify).
+
+    `deadline` (a `resilience.Deadline`) is polled before each grow-
+    retry — the budget-doubling fixpoint is this driver's unbounded
+    loop, and a pathological graph must not hold the checker past its
+    time budget (expiry raises `DeadlineExceeded`).
     """
+    if deadline is not None:
+        deadline.check("cycle-sweep")
     has, wit, n_back, conv = _sweep(
         g.n_nodes, max_k, max_rounds, g.rank, g.nc_src, g.nc_dst, g.nc_mask,
         g.chain_nodes, g.chain_starts, g.chain_mask)
@@ -422,14 +429,15 @@ def detect_cycles(g: SweepGraph, max_k: int = 128,
         return detect_cycles(g,
                              max_k=min(max(max_k * 2, _pow2(n_back)),
                                        MAX_K_CAP),
-                             max_rounds=max_rounds)
+                             max_rounds=max_rounds, deadline=deadline)
     if not bool(conv) and max_rounds < MAX_ROUNDS_CAP:
         # fixpoint truncated: grow rounds like grow_until_exact does for
         # the fused path (histories dense with injected cycles can need
         # hundreds of rounds) before surrendering to the host fallback
         return detect_cycles(g, max_k=max_k,
                              max_rounds=min(max_rounds * 2,
-                                            MAX_ROUNDS_CAP))
+                                            MAX_ROUNDS_CAP),
+                             deadline=deadline)
     wit = np.asarray(wit)
     conv = bool(conv)
     has = bool(has)

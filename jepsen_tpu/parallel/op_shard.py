@@ -162,10 +162,12 @@ def shard_padded(h: PaddedLA, mesh: Mesh, axis: str = "dp"
 
 def check_sharded(p: PackedTxns | PaddedLA, mesh: Optional[Mesh] = None,
                   axis: str = "dp", max_k: int = 128,
-                  max_rounds: int = 64) -> dict:
+                  max_rounds: int = 64, deadline=None) -> dict:
     """Check ONE history sharded across the mesh; summary dict like a
     `check_batch` row.  Falls back to growing budgets (like
-    `core_check_exact`) when the sweep overflows."""
+    `core_check_exact`) when the sweep overflows.  `deadline` bounds
+    the grow loop (resilience contract; expiry raises
+    `DeadlineExceeded`)."""
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), (axis,))
     h = p if isinstance(p, PaddedLA) else pad_packed(p)
@@ -179,7 +181,7 @@ def check_sharded(p: PackedTxns | PaddedLA, mesh: Optional[Mesh] = None,
     bits, over = grow_until_exact(
         lambda k, r: _core_check_sharded(h, n_keys, mesh, axis,
                                          max_k=k, max_rounds=r),
-        max_k, max_rounds, round_to=n_shards)
+        max_k, max_rounds, round_to=n_shards, deadline=deadline)
     over_i = int(np.asarray(over))
 
     row = np.asarray(bits)

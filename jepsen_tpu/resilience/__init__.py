@@ -1,0 +1,54 @@
+"""Resilience layer (ISSUE 2): the checking pipeline survives faults in
+*itself* — transient XLA errors, device OOM, pathological histories —
+and always terminates with an attributable verdict.
+
+Three pieces, wired through the elle and knossos checking stacks:
+
+- :mod:`~.policy` — :class:`RetryPolicy` (seeded backoff + JAX/XLA
+  transient classifier) and the cooperative :class:`Deadline`
+  (`check_safe` honors ``opts["time-limit"]`` / test
+  ``"checker-time-limit"`` and converts expiry into
+  ``{"valid?": "unknown", "error": "deadline-exceeded"}``);
+- :mod:`~.faults` — the deterministic seeded :class:`FaultPlan`
+  (chaos mode via test ``"faults"`` spec / ``JEPSEN_FAULTS``, and the
+  resilience layer's own test harness);
+- :mod:`~.guard` — :func:`device_call` / :func:`with_fallback`, the
+  seam wrapper that retries transients and degrades to the host oracle
+  with a ``"degraded": "host-fallback"`` stamp.
+
+See ``docs/RESILIENCE.md``.
+"""
+
+from jepsen_tpu.resilience.faults import (
+    FaultInjected,
+    FaultPlan,
+    active_plan,
+    parse_spec,
+    plan_for,
+    use,
+)
+from jepsen_tpu.resilience.guard import (
+    DEGRADED_HOST,
+    NO_PLAN,
+    degrade_to_host,
+    device_call,
+    with_fallback,
+)
+from jepsen_tpu.resilience.policy import (
+    DEADLINE_ERROR,
+    DEFAULT_POLICY,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    deadline_result,
+    is_transient,
+)
+
+__all__ = [
+    "Deadline", "DeadlineExceeded", "RetryPolicy", "is_transient",
+    "DEADLINE_ERROR", "DEFAULT_POLICY", "deadline_result",
+    "FaultPlan", "FaultInjected", "parse_spec", "plan_for", "use",
+    "active_plan",
+    "device_call", "with_fallback", "degrade_to_host", "DEGRADED_HOST",
+    "NO_PLAN",
+]

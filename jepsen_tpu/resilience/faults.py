@@ -1,0 +1,279 @@
+"""Deterministic, seeded fault injection for the checking pipeline.
+
+The framework's whole business is injecting faults into systems under
+test; this module points the same weapon at our own device pipeline.  A
+:class:`FaultPlan` wraps the device entry points (via
+``guard.device_call``'s pre-call hook) and raises synthetic
+OOM/XlaRuntimeError-shaped failures — or injects stalls — at chosen
+call indices.  Seeded and deterministic: the same plan spec over the
+same call sequence injects the same faults, so a chaos run that found a
+bug replays exactly.
+
+Doubles as:
+
+- the test harness for the resilience layer (inject a persistent
+  device fault, assert the checker degrades to the host oracle and
+  still produces the fault-free verdict);
+- a chaos mode for whole runs — enable per test map
+  (``test["faults"] = {...spec...}``) or process-wide via the
+  ``JEPSEN_FAULTS`` env var (``"seed=7,p=0.05,kinds=oom|xla"``).
+
+Spec keys (dict or ``k=v,k=v`` env string):
+
+    seed         int, default 0 — drives the probabilistic decisions
+    p            float, default 0 — per-call fault probability
+    kinds        iterable / "|"-joined — any of {"oom", "xla",
+                 "device-lost", "stall"}; default ("oom", "xla")
+    at           {call_index: kind} — explicit injections (exact runs)
+    persistent   iterable of site names (or True for all sites) that
+                 fault on EVERY call — the degradation-drill mode
+    max_faults   int — stop injecting after this many faults
+    stall_s      float, default 0.05 — stall duration
+    sites        iterable — restrict injection to these site names
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+__all__ = ["FaultInjected", "FaultPlan", "parse_spec", "plan_for",
+           "use", "install", "clear", "active_plan", "KIND_MESSAGES"]
+
+#: synthetic messages mimic the real jaxlib failure strings so the
+#: transient classifier (policy.is_transient) exercises its production
+#: match rules, not a test-only backdoor
+KIND_MESSAGES = {
+    "oom": ("RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "synthetic fault (injected)"),
+    "xla": ("INTERNAL: Compilation failure: synthetic XLA compile flake "
+            "(injected)"),
+    "device-lost": "UNAVAILABLE: device lost (injected)",
+    "stall": "stall",  # not raised; injected as a sleep
+}
+
+#: kinds a retry could clear; "device-lost" persists until re-dial, so
+#: a plan can model both regimes
+_TRANSIENT_KINDS = {"oom": True, "xla": True, "device-lost": False}
+
+
+class FaultInjected(RuntimeError):
+    """A synthetic device fault.  Carries its own transience verdict so
+    the classifier needs no special-casing, plus the injection site and
+    call index for attribution in logs/telemetry."""
+
+    def __init__(self, kind: str, site: str, index: int,
+                 transient: bool = True):
+        super().__init__(f"{KIND_MESSAGES.get(kind, kind)} "
+                         f"[site={site} call={index}]")
+        self.kind = kind
+        self.site = site
+        self.index = index
+        self.transient = transient
+
+
+def _split(v: Union[str, Iterable[str], None]) -> Optional[List[str]]:
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return [s for s in v.replace("|", ",").split(",") if s]
+    return list(v)
+
+
+def parse_spec(spec: Union[str, dict, None]) -> Optional[dict]:
+    """Normalize a fault spec: env-string form to a dict; dicts pass
+    through (copied).  Returns None for empty/falsy specs."""
+    if not spec:
+        return None
+    if isinstance(spec, dict):
+        return dict(spec)
+    out: Dict[str, Any] = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad JEPSEN_FAULTS entry {part!r} "
+                             "(want key=value[,key=value...])")
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out or None
+
+
+class FaultPlan:
+    """Deterministic schedule of synthetic device faults.
+
+    Each guarded call asks :meth:`fire` with its site name; the plan
+    keeps one global call counter (thread-safe) and decides from its
+    seed/spec whether that call faults.  Decisions depend only on
+    (seed, call index, site filters) — never on wall time — so two runs
+    over the same call sequence inject identically.
+    """
+
+    def __init__(self, seed: int = 0, p: float = 0.0,
+                 kinds: Iterable[str] = ("oom", "xla"),
+                 at: Optional[Dict[int, str]] = None,
+                 persistent: Union[bool, Iterable[str], None] = None,
+                 max_faults: Optional[int] = None,
+                 stall_s: float = 0.05,
+                 sites: Optional[Iterable[str]] = None):
+        self.seed = int(seed)
+        self.p = float(p)
+        self.kinds = tuple(_split(kinds) or ())
+        for k in self.kinds:
+            if k not in KIND_MESSAGES:
+                raise ValueError(f"unknown fault kind {k!r} "
+                                 f"(have {sorted(KIND_MESSAGES)})")
+        self.at = {int(k): v for k, v in (at or {}).items()}
+        if persistent is True or persistent in ("1", "true", "all"):
+            self.persistent: Union[bool, frozenset] = True
+        else:
+            self.persistent = frozenset(_split(persistent) or ())
+        self.max_faults = int(max_faults) if max_faults is not None else None
+        self.stall_s = float(stall_s)
+        self.sites = frozenset(_split(sites) or ()) or None
+        self._lock = threading.Lock()
+        self._n_calls = 0
+        #: injection log: (call_index, site, kind) — determinism tests
+        #: and chaos-sweep reports read this
+        self.injected: List[Tuple[int, str, str]] = []
+
+    @classmethod
+    def from_spec(cls, spec: Union[str, dict, None]
+                  ) -> Optional["FaultPlan"]:
+        d = parse_spec(spec)
+        if d is None:
+            return None
+        return cls(**d)
+
+    # -- decision ----------------------------------------------------------
+
+    def _decide(self, index: int, site: str) -> Optional[str]:
+        """The pure decision function: which fault (if any) fires at
+        this (index, site)?  Hash-seeded per call index so decisions
+        are order-independent across sites with the same counter."""
+        if self.sites is not None and site not in self.sites:
+            return None
+        if self.persistent is True or \
+                (self.persistent and site in self.persistent):
+            return self.kinds[0] if self.kinds else "oom"
+        if index in self.at:
+            return self.at[index]
+        if self.p > 0.0 and self.kinds:
+            import random
+            rng = random.Random((self.seed << 20) ^ index)
+            if rng.random() < self.p:
+                return self.kinds[rng.randrange(len(self.kinds))]
+        return None
+
+    # -- the guard-facing hook ---------------------------------------------
+
+    def fire(self, site: str) -> None:
+        """Called by the guard before each device entry point: count the
+        call and inject the planned fault (raise, or sleep for stalls).
+        """
+        with self._lock:
+            index = self._n_calls
+            self._n_calls += 1
+            if self.max_faults is not None and \
+                    len(self.injected) >= self.max_faults:
+                return
+            kind = self._decide(index, site)
+            if kind is None:
+                return
+            self.injected.append((index, site, kind))
+        from jepsen_tpu import telemetry
+
+        telemetry.registry().counter("resilience-faults-injected",
+                                     site=site, kind=kind).inc()
+        if kind == "stall":
+            import time
+            time.sleep(self.stall_s)
+            return
+        raise FaultInjected(kind, site, index,
+                            transient=_TRANSIENT_KINDS.get(kind, True))
+
+    def __repr__(self) -> str:
+        return (f"<FaultPlan seed={self.seed} p={self.p} "
+                f"kinds={self.kinds} at={self.at} "
+                f"persistent={self.persistent!r} "
+                f"calls={self._n_calls} injected={len(self.injected)}>")
+
+
+# ---------------------------------------------------------------------------
+# Activation: explicit install > test map > JEPSEN_FAULTS env.
+# ---------------------------------------------------------------------------
+
+_active: Optional[FaultPlan] = None
+_env_plan: Optional[FaultPlan] = None
+_env_spec_seen: Optional[str] = None
+
+
+def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install a process-wide plan (None clears).  Returns the plan."""
+    global _active
+    _active = plan
+    return plan
+
+
+def clear() -> None:
+    install(None)
+
+
+class use:
+    """Context manager: install a plan for a block, restoring after —
+    the unit-test idiom (`with faults.use(plan): ...`)."""
+
+    def __init__(self, plan: Optional[FaultPlan]):
+        self.plan = plan
+        self._prev: Optional[FaultPlan] = None
+
+    def __enter__(self) -> Optional[FaultPlan]:
+        global _active
+        self._prev = _active
+        _active = self.plan
+        return self.plan
+
+    def __exit__(self, *exc) -> bool:
+        global _active
+        _active = self._prev
+        return False
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The explicitly installed plan, else the JEPSEN_FAULTS env plan
+    (parsed once per distinct spec value), else None."""
+    if _active is not None:
+        return _active
+    global _env_plan, _env_spec_seen
+    spec = os.environ.get("JEPSEN_FAULTS", "").strip()
+    if not spec:
+        return None
+    if spec != _env_spec_seen:
+        _env_spec_seen = spec
+        _env_plan = FaultPlan.from_spec(spec)
+    return _env_plan
+
+
+def plan_for(test: Optional[dict]) -> Optional[FaultPlan]:
+    """Resolve the fault plan for a run: the test map's ``"faults"``
+    resilience spec (cached on the map so every checker in the run
+    shares ONE call counter), else :func:`active_plan`.
+
+    Note: `nemesis/combined.py` also reads ``opts["faults"]`` as a SET
+    of package names ({"partition", "kill", ...}); a set/sequence there
+    is the nemesis vocabulary, not a resilience spec — only dict/str
+    specs (or a FaultPlan) select device-fault injection."""
+    if test:
+        spec = test.get("faults")
+        if isinstance(spec, FaultPlan):
+            return spec
+        if isinstance(spec, (dict, str)) and spec:
+            cached = test.get("faults-plan")
+            if isinstance(cached, FaultPlan):
+                return cached
+            plan = FaultPlan.from_spec(spec)
+            test["faults-plan"] = plan
+            return plan
+    return active_plan()

@@ -1,0 +1,153 @@
+"""The device-call guard: retry transients, degrade to host, stay observable.
+
+One entry point, :func:`device_call`, wraps every device pipeline seam
+(elle infer, cycle sweeps, the knossos device WGL, the fused rw check):
+
+1. polls the cooperative :class:`~.policy.Deadline` before each attempt;
+2. consults the active :class:`~.faults.FaultPlan` (chaos mode / test
+   harness) — the plan may raise a synthetic device fault here;
+3. retries transient JAX/XLA failures per :class:`~.policy.RetryPolicy`
+   with seeded backoff;
+4. re-raises once the policy is exhausted (or the failure is
+   non-transient) so the caller can degrade to its host oracle via
+   :func:`with_fallback`, stamping ``"degraded": "host-fallback"``.
+
+Every retry/fallback increments a telemetry counter and annotates the
+innermost open span, so a degraded run is diagnosable straight from
+``telemetry.json``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from jepsen_tpu.resilience import faults as faults_mod
+from jepsen_tpu.resilience.policy import (
+    DEFAULT_POLICY,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+)
+
+logger = logging.getLogger("jepsen.resilience")
+
+__all__ = ["device_call", "with_fallback", "degrade_to_host",
+           "DEGRADED_HOST", "NO_PLAN"]
+
+DEGRADED_HOST = "host-fallback"
+
+#: sentinel for "definitely no fault plan": a hot loop that resolved the
+#: plan ONCE (and found none) passes this so device_call skips the
+#: per-call plan_for/env lookup entirely — plan=None means "resolve"
+NO_PLAN = object()
+
+
+def _registry():
+    from jepsen_tpu import telemetry
+
+    return telemetry.registry()
+
+
+def _annotate(**attrs: Any) -> None:
+    from jepsen_tpu import telemetry
+
+    sp = telemetry.current()
+    if sp is not None:
+        sp.set_attr(**attrs)
+
+
+def device_call(site: str, fn: Callable, *args: Any,
+                policy: Optional[RetryPolicy] = None,
+                deadline: Optional[Deadline] = None,
+                plan: Optional[faults_mod.FaultPlan] = None,
+                test: Optional[dict] = None,
+                **kw: Any) -> Any:
+    """Run a device entry point under the resilience policy.
+
+    `site` names the seam for fault targeting and telemetry labels
+    (e.g. ``"elle.infer"``).  `plan` defaults to the run's resolved
+    plan (`faults.plan_for(test)` — explicit install > test map >
+    JEPSEN_FAULTS); pass ``plan=...`` to pin one.  Raises the last
+    error when retries are exhausted or the failure is non-transient;
+    :class:`DeadlineExceeded` always propagates immediately.
+    """
+    policy = policy or DEFAULT_POLICY
+    if plan is NO_PLAN:
+        plan = None
+    elif plan is None:
+        plan = faults_mod.plan_for(test)
+    delays = policy.delays()
+    attempt = 0
+    while True:
+        if deadline is not None:
+            deadline.check(site)
+        attempt += 1
+        try:
+            if plan is not None:
+                plan.fire(site)
+            return fn(*args, **kw)
+        except DeadlineExceeded:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not policy.classify(e):
+                raise
+            delay = next(delays, None)
+            if delay is None:  # attempts exhausted: the original error
+                _annotate(retries=attempt - 1, retry_exhausted=True)
+                raise
+            _registry().counter("resilience-retries", site=site,
+                                kind=type(e).__name__).inc()
+            _annotate(retries=attempt)
+            logger.warning("transient device failure at %s (attempt "
+                           "%d/%d), retrying in %.3fs: %s", site, attempt,
+                           policy.max_attempts, delay, e)
+            if deadline is not None:
+                delay = deadline.bound_sleep(delay)
+            if delay > 0:
+                time.sleep(delay)
+
+
+def degrade_to_host(site: str, host_fn: Callable[[], Any],
+                    exc: BaseException, *,
+                    deadline: Optional[Deadline] = None) -> Any:
+    """The shared degradation tail every device->host fallback goes
+    through: count the fallback, annotate the open span, poll the
+    deadline (an expired budget must NOT be converted into a possibly
+    much slower host run — expiry raises :class:`DeadlineExceeded`),
+    run the host oracle, and stamp dict results with
+    ``"degraded": "host-fallback"`` plus the device error."""
+    _registry().counter("resilience-fallbacks", site=site).inc()
+    _annotate(degraded=DEGRADED_HOST, device_error=type(exc).__name__)
+    logger.warning("persistent device failure at %s; degrading to "
+                   "host oracle: %s", site, exc)
+    if deadline is not None:
+        deadline.check(site)
+    res = host_fn()
+    if isinstance(res, dict):
+        res["degraded"] = DEGRADED_HOST
+        res["device-error"] = f"{type(exc).__name__}: {exc}"
+    return res
+
+
+def with_fallback(site: str, device_fn: Callable[[], Any],
+                  host_fn: Callable[[], Any], *,
+                  policy: Optional[RetryPolicy] = None,
+                  deadline: Optional[Deadline] = None,
+                  plan: Optional[faults_mod.FaultPlan] = None,
+                  test: Optional[dict] = None
+                  ) -> Tuple[Any, Optional[str]]:
+    """Run `device_fn` under :func:`device_call`; on persistent device
+    failure run `host_fn` via :func:`degrade_to_host`.  Returns
+    ``(result, degraded)`` where `degraded` is None on the device path
+    and :data:`DEGRADED_HOST` after the oracle fallback (dict results
+    also carry the stamp).  Only :class:`DeadlineExceeded` escapes."""
+    try:
+        return device_call(site, device_fn, policy=policy,
+                           deadline=deadline, plan=plan, test=test), None
+    except DeadlineExceeded:
+        raise
+    except Exception as e:  # noqa: BLE001 — any persistent device failure
+        return degrade_to_host(site, host_fn, e,
+                               deadline=deadline), DEGRADED_HOST

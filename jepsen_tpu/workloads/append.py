@@ -64,15 +64,25 @@ class AppendChecker(checker_api.Checker):
 
     def check(self, test, history, opts=None):
         from ..checkers.elle import list_append, viz  # defers jax init
+        from ..resilience import plan_for
 
         opts = opts or {}
         res = list_append.check(
             history,
             consistency_models=opts.get("consistency-models", self.models),
-            anomalies=opts.get("anomalies", self.anomalies))
+            anomalies=opts.get("anomalies", self.anomalies),
+            # resilience plumbing: the shared checker deadline placed in
+            # opts by check_safe, the run's fault plan, and an optional
+            # retry-policy override from the test map
+            deadline=opts.get("deadline"),
+            policy=(test or {}).get("retry-policy"),
+            plan=plan_for(test))
         if test and test.get("store-dir") is not None:
             viz.viz_for_test(res, test, history)
         return res
+
+    def name(self):
+        return "list-append"
 
 
 def workload(*, key_count: int = 10, min_txn_length: int = 1,

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Seeded chaos sweep over the resilience layer (ISSUE 2 satellite).
+
+For each seed, builds a deterministic FaultPlan and runs the elle
+list-append check, the elle rw-register check, and a knossos
+linearizability analysis under it, asserting the resilience invariant:
+
+    every faulted run terminates with a verdict, and that verdict
+    either equals the fault-free one or is an attributable unknown
+    (deadline-exceeded / budget exhaustion) — never a crash, never a
+    hang, never a silently wrong answer.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/fuzz_faults.py --rounds 20
+    python scripts/fuzz_faults.py --rounds 5 --p 0.3 --deadline 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu.utils.backend import force_cpu_backend  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu" \
+        or os.environ.get("JT_FORCE_CPU"):
+    force_cpu_backend()
+
+
+def run_one(seed: int, p: float, deadline_s: float) -> dict:
+    from jepsen_tpu.checkers.elle import list_append, rw_register
+    from jepsen_tpu.checkers.knossos import analysis
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.resilience import Deadline, FaultPlan, RetryPolicy, use
+    from jepsen_tpu.workloads import synth
+
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, seed=seed)
+    row = {"seed": seed, "injected": 0, "degraded": 0, "unknown": 0}
+
+    def verify(name, clean, faulted):
+        assert "valid?" in faulted, f"{name}: no verdict ({faulted})"
+        if faulted["valid?"] == "unknown":
+            # unknowns must be attributable, not silent
+            assert faulted.get("error") or faulted.get("reason"), \
+                f"{name}: unattributed unknown ({faulted})"
+            row["unknown"] += 1
+        else:
+            assert faulted["valid?"] == clean["valid?"], \
+                f"{name}: verdict flipped under faults " \
+                f"({clean['valid?']} -> {faulted['valid?']})"
+        if faulted.get("degraded"):
+            row["degraded"] += 1
+
+    # --- elle list-append (every other round carries a real anomaly) --
+    h = synth.la_history(n_txns=50, seed=seed)
+    if seed % 2:
+        synth.inject_wr_cycle(h)
+    clean = list_append.check(h)
+    plan = FaultPlan(seed=seed, p=p, kinds=("oom", "xla", "stall"),
+                     stall_s=0.01)
+    faulted = list_append.check(h, plan=plan, policy=policy,
+                                deadline=Deadline(deadline_s))
+    verify("list-append", clean, faulted)
+    row["injected"] += len(plan.injected)
+
+    # --- elle rw-register (fused fast path forced on) ------------------
+    hrw = synth.rw_history(n_txns=40, seed=seed)
+    clean_rw = rw_register.check(hrw)
+    plan_rw = FaultPlan(seed=seed + 1, p=p, kinds=("oom", "xla"))
+    orig_min = rw_register.FUSED_MIN_TXNS
+    rw_register.FUSED_MIN_TXNS = 1
+    try:
+        faulted_rw = rw_register.check(hrw, plan=plan_rw, policy=policy,
+                                       deadline=Deadline(deadline_s))
+    finally:
+        rw_register.FUSED_MIN_TXNS = orig_min
+    verify("rw-register", clean_rw, faulted_rw)
+    row["injected"] += len(plan_rw.injected)
+
+    # --- knossos (fault plan active process-wide during analysis) ------
+    hl = synth.lin_register_history(n_ops=40, concurrency=3,
+                                    info_prob=0.05, seed=seed)
+    clean_k = analysis(hl, cas_register())
+    plan_k = FaultPlan(seed=seed + 2, p=p, kinds=("oom", "xla"))
+    with use(plan_k):
+        faulted_k = analysis(hl, cas_register(),
+                             deadline=Deadline(deadline_s))
+    verify("knossos", clean_k, faulted_k)
+    row["injected"] += len(plan_k.injected)
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--seed0", type=int, default=0)
+    ap.add_argument("--p", type=float, default=0.2,
+                    help="per-call fault probability")
+    ap.add_argument("--deadline", type=float, default=60.0,
+                    help="per-check deadline seconds")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    totals = {"injected": 0, "degraded": 0, "unknown": 0}
+    for seed in range(args.seed0, args.seed0 + args.rounds):
+        row = run_one(seed, args.p, args.deadline)
+        for k in totals:
+            totals[k] += row[k]
+        print(f"seed {seed}: injected={row['injected']} "
+              f"degraded={row['degraded']} unknown={row['unknown']}")
+    print(f"\n{args.rounds} rounds in {time.time() - t0:.1f}s: "
+          f"{totals['injected']} faults injected, "
+          f"{totals['degraded']} host fallbacks, "
+          f"{totals['unknown']} attributed unknowns — every run "
+          "terminated with a verdict")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
