@@ -258,7 +258,44 @@ def _run_size(n_txns: int, repeats: int):
     }
 
 
+def emit_campaign_spec(path, sizes=None, seeds=(0,)):
+    """Write the bench ladder as a `jepsen_tpu.campaign` spec, so BENCH
+    trajectories and soak runs drive the same fleet engine (`cli
+    campaign run <spec>`): one labeled list-append workload entry per
+    rung, op-count-bound (no wall-clock cap), telemetry on so the
+    campaign index accumulates checker span durations across
+    generations (`Index.span_trend`)."""
+    if sizes is None:
+        sizes = [int(s) for s in os.environ.get(
+            "BENCH_SIZES", "100000,1000000").split(",") if s.strip()]
+    spec = {
+        "name": "bench-ladder",
+        "workloads": [
+            {"name": "append", "label": f"la-{n}",
+             "opts": {"ops": n, "time-limit": None}}
+            for n in sizes
+        ],
+        "faults": [None],
+        "seeds": list(seeds),
+        "opts": {"telemetry": True,
+                 "checker-time-limit": float(
+                     os.environ.get("BENCH_DEADLINE", 2700))},
+    }
+    with open(path, "w") as f:
+        json.dump(spec, f, indent=1)
+    return spec
+
+
 def main():
+    # emit-spec mode: no backend init, no watchdog — just the ladder as
+    # campaign data (BENCH_EMIT_CAMPAIGN_SPEC=<path>)
+    emit_path = os.environ.get("BENCH_EMIT_CAMPAIGN_SPEC")
+    if emit_path:
+        spec = emit_campaign_spec(emit_path)
+        _emit({"campaign_spec": emit_path,
+               "runs": len(spec["workloads"]) * len(spec["seeds"])})
+        return 0
+
     # arm the watchdog before anything that can raise or hang — the
     # one-JSON-line contract must survive malformed env knobs too.
     # Default 2700 s: a COLD 1M TPU compile measured 1161 s on the

@@ -1,0 +1,49 @@
+"""Campaign layer (ISSUE 3): run, index, and regression-check whole
+*fleets* of tests.
+
+`core.run` (L6) orchestrates exactly one test; the ROADMAP north star is
+a production-scale system, which means running many seeds × workloads ×
+fault plans concurrently and keeping the verdicts queryable across
+campaigns.  Four pieces:
+
+- :mod:`~.plan` — declarative campaign spec (JSON/dict matrix) expanded
+  into :class:`~.plan.RunSpec` rows with *stable* per-run ids (same
+  spec → same ids, the resume/regression key);
+- :mod:`~.scheduler` — a device-aware worker pool: host-only runs fill
+  all workers freely, device-pipeline runs serialize through a bounded
+  set of device slots; per-run isolation via thread or subprocess
+  executors, with `resilience.RetryPolicy` retries on crashed runs and
+  `Deadline` budgets threaded into each test map;
+- :mod:`~.index` — the persistent results database: an append-only,
+  fsync'd, torn-line-tolerant jsonl ledger keyed by run id, supporting
+  resume (completed runs skipped on restart) and regression queries
+  (verdict flips per (workload, fault, seed) key, checker span
+  duration trends across campaign generations);
+- :mod:`~.core` — the orchestrator: `run_campaign(spec)` → summary,
+  plus `status`/`report` and the single-run executor the scheduler and
+  the subprocess runner share.
+
+Surfaces: `cli campaign run/status/report <spec.json>`, the web UI's
+campaign dashboard (verdict grid, degraded/deadline runs highlighted),
+`report.render_campaign` (suite rollup), and `bench.py`'s ladder
+emitted as a campaign spec (``BENCH_EMIT_CAMPAIGN_SPEC=path``).
+
+See ``docs/CAMPAIGN.md``.
+"""
+
+from jepsen_tpu.campaign.core import (
+    execute_run,
+    report_campaign,
+    run_campaign,
+    status_campaign,
+)
+from jepsen_tpu.campaign.index import Index
+from jepsen_tpu.campaign.plan import RunSpec, expand, load_spec
+from jepsen_tpu.campaign.scheduler import DeviceSlots, Scheduler
+
+__all__ = [
+    "RunSpec", "expand", "load_spec",
+    "Scheduler", "DeviceSlots",
+    "Index",
+    "run_campaign", "status_campaign", "report_campaign", "execute_run",
+]

@@ -1,0 +1,238 @@
+"""Campaign orchestration: spec → scheduled fleet → indexed verdicts.
+
+`run_campaign` is the L6-of-L6: where `core.run` turns one test map
+into one verdict, this turns a campaign spec into a fully-indexed
+fleet of `core.run` invocations — expanded by `plan.expand`, placed by
+`scheduler.Scheduler` (device-aware slots, retries, isolation),
+recorded durably by `index.Index` as each run lands (a killed campaign
+resumes where it stopped), and rolled up into a summary the CLI, the
+web dashboard, and `report.render_campaign` all share.
+
+The per-run contract matches the resilience layer's: every scheduled
+run terminates with an attributable verdict (True / False / "unknown"
+with an error) — a crashing workload, checker, or executor becomes an
+``unknown`` record, never a campaign abort.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from jepsen_tpu import store
+from jepsen_tpu.campaign import plan as plan_mod
+from jepsen_tpu.campaign.index import Index
+from jepsen_tpu.campaign.plan import RunSpec
+from jepsen_tpu.campaign.scheduler import Scheduler
+from jepsen_tpu.resilience import DEADLINE_ERROR
+
+logger = logging.getLogger("jepsen.campaign")
+
+__all__ = ["run_campaign", "status_campaign", "report_campaign",
+           "execute_run", "index_path", "result_flags", "summarize"]
+
+
+def index_path(name: str, base: Optional[str] = None) -> str:
+    """The campaign's ledger path: ``<store>/campaigns/<name>.jsonl``."""
+    return os.path.join(base or store.BASE, "campaigns",
+                        store.sanitize(name) + ".jsonl")
+
+
+def result_flags(results: Any) -> Dict[str, Any]:
+    """Scan a (possibly nested, composed-checker) results map for the
+    attribution flags the index and the web badges surface: the first
+    ``error`` string, any ``degraded`` stamp, and whether any level
+    reported ``deadline-exceeded``."""
+    out: Dict[str, Any] = {"error": None, "degraded": None,
+                           "deadline": False}
+
+    def walk(r: Any) -> None:
+        if not isinstance(r, dict):
+            return
+        err = r.get("error")
+        if isinstance(err, str) and err:
+            if out["error"] is None:
+                out["error"] = err
+            if DEADLINE_ERROR in err:
+                out["deadline"] = True
+        deg = r.get("degraded")
+        if deg and out["degraded"] is None:
+            out["degraded"] = str(deg)
+        for v in r.values():
+            walk(v)
+
+    walk(results)
+    return out
+
+
+def _spans_from_dir(d: Optional[str], cap: int = 48) -> Dict[str, float]:
+    """Per-span total durations (seconds) from a run's telemetry.json —
+    the material for the index's span-duration trend queries.  Missing
+    or unreadable telemetry is just an empty dict."""
+    if not d:
+        return {}
+    path = os.path.join(d, "telemetry.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    out: Dict[str, float] = {}
+
+    def walk(sp: Dict[str, Any]) -> None:
+        dur = sp.get("dur_ns")
+        if dur is not None:
+            out[sp["name"]] = out.get(sp["name"], 0.0) + dur / 1e9
+        for c in sp.get("children") or []:
+            walk(c)
+
+    for r in doc.get("spans", []):
+        walk(r)
+    if len(out) > cap:  # biggest spans win: the trend queries want the
+        out = dict(sorted(out.items(),  # expensive stages, not leaf noise
+                          key=lambda kv: -kv[1])[:cap])
+    return {k: round(v, 6) for k, v in out.items()}
+
+
+def execute_run(rs: RunSpec, base: str) -> Dict[str, Any]:
+    """Run one campaign cell end to end and build its index record.
+    Exceptions out of `core.run` (setup/workload crashes — checker
+    crashes are already absorbed by `check_safe`) PROPAGATE: the
+    scheduler owns the retry policy and converts whatever survives its
+    retries into the attributable ``unknown`` crash record — absorbing
+    them here would silently disable those retries."""
+    from jepsen_tpu import core as jcore
+
+    t0 = time.monotonic()
+    test = plan_mod.build_test(rs, base)
+    done = jcore.run(test)
+    results = done.get("results") or {}
+    flags = result_flags(results)
+    d = store.test_dir(done)
+    rel = os.path.relpath(d, base)
+    try:
+        ops = len(done.get("history") or ())
+    except TypeError:
+        ops = 0
+    return {
+        "run": rs.run_id, "key": rs.key, "campaign": rs.campaign,
+        "workload": rs.workload_label, "fault": rs.fault_label,
+        "seed": rs.seed,
+        "valid?": results.get("valid?", "unknown"),
+        "error": flags["error"],
+        "degraded": flags["degraded"],
+        "deadline": flags["deadline"],
+        "dir": rel,
+        "ops": ops,
+        "wall_s": round(time.monotonic() - t0, 3),
+        "spans": _spans_from_dir(d),
+    }
+
+
+def summarize(spec: Union[str, dict], base: Optional[str] = None,
+              *, executed: int = 0, skipped: int = 0,
+              wall_s: float = 0.0, idx: Optional[Index] = None
+              ) -> Dict[str, Any]:
+    """Build the suite rollup for a spec from its index: the one
+    summary shape `report.render_campaign`, the CLI, and the web
+    dashboard consume.  Pass `idx` to reuse an already-loaded Index
+    (run_campaign does) instead of re-parsing the ledger."""
+    spec = plan_mod.load_spec(spec)
+    base = base or store.BASE
+    specs = plan_mod.expand(spec)
+    if idx is None:
+        idx = Index(index_path(spec["name"], base))
+    rows: List[Dict[str, Any]] = []
+    for rs in specs:
+        rec = idx.latest(rs.run_id)
+        row = {"run": rs.run_id, "key": rs.key,
+               "workload": rs.workload_label, "fault": rs.fault_label,
+               "seed": rs.seed, "device": rs.device}
+        if rec is not None:
+            row.update({k: rec.get(k) for k in
+                        ("valid?", "error", "degraded", "deadline",
+                         "dir", "ops", "wall_s", "gen")})
+        else:
+            row["valid?"] = None  # not yet run
+        rows.append(row)
+    flips = idx.flips()
+    return {
+        "campaign": spec["name"],
+        "spec-digest": plan_mod.spec_digest(spec),
+        "index": idx.path,
+        "total": len(specs),
+        "executed": executed,
+        "skipped": skipped,
+        "pending": sum(1 for r in rows if r["valid?"] is None),
+        "wall_s": round(wall_s, 3),
+        "counts": idx.verdict_counts(runs=[rs.run_id for rs in specs]),
+        "seeds": sorted({rs.seed for rs in specs}),
+        "rows": rows,
+        "regressions": [f for f in flips if f["regression"]],
+        "flips": flips,
+        "span-stats": idx.span_stats(),
+    }
+
+
+def run_campaign(spec: Union[str, dict], base: Optional[str] = None, *,
+                 workers: int = 2, device_slots: int = 1,
+                 executor: str = "thread", rerun: bool = False,
+                 run_deadline_s: Optional[float] = None,
+                 retry=None) -> Dict[str, Any]:
+    """Run a campaign: expand, skip already-indexed runs (unless
+    `rerun`), schedule the rest over `workers`, index every verdict as
+    it lands, and return the suite summary."""
+    spec = plan_mod.load_spec(spec)
+    base = base or store.BASE
+    specs = plan_mod.expand(spec)
+    idx = Index(index_path(spec["name"], base))
+    done = set() if rerun else idx.completed_ids()
+    todo = [rs for rs in specs if rs.run_id not in done]
+    gen = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    digest = plan_mod.spec_digest(spec)
+    logger.info("campaign %s: %d runs (%d already indexed), %d workers, "
+                "%s executor", spec["name"], len(specs),
+                len(specs) - len(todo), workers, executor)
+    for rs in todo:
+        # a hard per-run wall also bounds the checkers cooperatively
+        if run_deadline_s and rs.opts.get("checker-time-limit") is None:
+            rs.opts["checker-time-limit"] = run_deadline_s
+        rs.opts["_base"] = base  # the subprocess runner needs the store
+
+    def on_result(rec: Dict[str, Any]) -> None:
+        rec["gen"] = gen
+        rec["spec"] = digest
+        idx.append(rec)
+        logger.info("campaign %s: %s -> valid? = %s", spec["name"],
+                    rec.get("run"), rec.get("valid?"))
+
+    t0 = time.monotonic()
+    sched = Scheduler(workers, device_slots=device_slots,
+                      executor=executor, retry=retry,
+                      run_deadline_s=run_deadline_s)
+    sched.run(todo, lambda rs: execute_run(rs, base),
+              on_result=on_result)
+    return summarize(spec, base, executed=len(todo),
+                     skipped=len(specs) - len(todo),
+                     wall_s=time.monotonic() - t0, idx=idx)
+
+
+def status_campaign(spec: Union[str, dict], base: Optional[str] = None
+                    ) -> Dict[str, Any]:
+    """Cheap index-only view: how much of the spec has verdicts."""
+    s = summarize(spec, base)
+    return {k: s[k] for k in ("campaign", "index", "total", "pending",
+                              "counts")}
+
+
+def report_campaign(spec: Union[str, dict], base: Optional[str] = None
+                    ) -> str:
+    """The suite-level text rollup (grid + aggregates + regressions)."""
+    from jepsen_tpu import report
+
+    return report.render_campaign(summarize(spec, base))

@@ -1,0 +1,219 @@
+"""The campaign results database: an append-only jsonl ledger.
+
+One file per campaign (``<store>/campaigns/<name>.jsonl``), one JSON
+record per completed run, fsync'd on append — the same durability and
+torn-line story as `parallel.batch.check_batch_checkpointed`'s
+checkpoints and the original `scripts/tpu_campaign.py` stage ledger: a
+crash mid-append leaves at most one torn trailing line, which a reload
+drops (and truncates) before resuming.
+
+Records are keyed two ways:
+
+- ``run`` — the RunSpec's stable run id.  A run id with a verdict on
+  file is *complete*; `run_campaign` skips it on restart (resume).
+- ``key`` — ``workload|fault|seed``, stable across spec-opt tweaks and
+  campaign generations; the regression-query key.
+
+Each record carries the verdict (``valid?``), attribution (``error``,
+``degraded``, ``deadline``), the run's store dir, wall time, and — for
+telemetric runs — per-span checker durations pulled from the run's
+``telemetry.json``, which powers the "checker p95 span duration trend"
+query (:meth:`Index.span_trend`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Index"]
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    """Nearest-rank percentile over a non-empty list (stdlib-only)."""
+    s = sorted(xs)
+    i = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[i]
+
+
+class Index:
+    """In-memory view over one campaign's jsonl ledger.
+
+    Loading tolerates a torn trailing record (crash mid-append): the
+    first unparsable or unterminated line and everything after it are
+    dropped from the in-memory view, like the batch checkpoint reader.
+    The FILE is only healed (truncated back to the last durable record)
+    lazily on the next :meth:`append` — read-only consumers (the web
+    dashboard, `campaign status`) must never truncate, because their
+    "torn line" may just be a live writer's append in flight.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.records: List[Dict[str, Any]] = []
+        #: byte offset of the last durable record seen at load; a
+        #: resuming WRITER truncates to it before its first append
+        self._good_bytes: Optional[int] = None
+        self._load()
+
+    # -- persistence --------------------------------------------------------
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        good_bytes = 0
+        torn = False
+        recs: List[Dict[str, Any]] = []
+        with open(self.path, "rb") as f:
+            for line in f:
+                if not line.strip():
+                    good_bytes += len(line)
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn = True  # torn trailing record
+                    break
+                if not line.endswith(b"\n"):
+                    torn = True  # parseable but unterminated: a later
+                    break        # append would fuse with it
+                recs.append(rec)
+                good_bytes += len(line)
+        # arm the heal only on an OBSERVED torn line — never because the
+        # file merely grew between our read and now (that's a concurrent
+        # writer's complete record, which truncation would destroy)
+        if torn:
+            self._good_bytes = good_bytes
+        self.records = recs
+
+    def append(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """Durably append one record (fsync'd) and index it.  If the
+        load saw a torn tail, the writer truncates it away first so the
+        new record can't fuse with crash debris."""
+        rec = dict(rec)
+        rec.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()))
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if self._good_bytes is not None:
+            with open(self.path, "r+b") as f:
+                f.truncate(self._good_bytes)
+            self._good_bytes = None
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.records.append(rec)
+        return rec
+
+    # -- resume -------------------------------------------------------------
+
+    def completed_ids(self) -> set:
+        """Run ids that already hold an attributable verdict — skipped
+        on resume.  Any verdict counts (True / False / "unknown"): the
+        contract is *attributable termination*, not success."""
+        return {r["run"] for r in self.records if "valid?" in r}
+
+    def latest(self, run_id: str) -> Optional[Dict[str, Any]]:
+        for r in reversed(self.records):
+            if r.get("run") == run_id:
+                return r
+        return None
+
+    def by_key(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Records grouped by regression key, in append order."""
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for r in self.records:
+            if "valid?" in r and r.get("key"):
+                out.setdefault(r["key"], []).append(r)
+        return out
+
+    # -- regression queries -------------------------------------------------
+
+    def flips(self) -> List[Dict[str, Any]]:
+        """Verdict flips per key: every consecutive pair of records for
+        the same (workload, fault, seed) whose ``valid?`` changed.
+        ``regression`` marks the bad direction (away from True) — the
+        "which (workload, seed) flipped valid? since the last campaign"
+        query."""
+        out: List[Dict[str, Any]] = []
+        for key, recs in sorted(self.by_key().items()):
+            for prev, cur in zip(recs[:-1], recs[1:]):
+                if prev.get("valid?") != cur.get("valid?"):
+                    out.append({
+                        "key": key,
+                        "run": cur.get("run"),
+                        "from": prev.get("valid?"),
+                        "to": cur.get("valid?"),
+                        "regression": prev.get("valid?") is True,
+                        "when": cur.get("ts"),
+                        "gen": cur.get("gen"),
+                    })
+        return out
+
+    def regressions(self) -> List[Dict[str, Any]]:
+        return [f for f in self.flips() if f["regression"]]
+
+    # -- telemetry aggregates ----------------------------------------------
+
+    def _span_values(self) -> Dict[str, List[float]]:
+        out: Dict[str, List[float]] = {}
+        for r in self.records:
+            for name, dur in (r.get("spans") or {}).items():
+                if isinstance(dur, (int, float)):
+                    out.setdefault(name, []).append(float(dur))
+        return out
+
+    def span_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-span duration aggregates across every indexed run:
+        count / min / p50 / p95 / max (seconds)."""
+        return {
+            name: {
+                "count": len(vals),
+                "min": round(min(vals), 6),
+                "p50": round(_percentile(vals, 50), 6),
+                "p95": round(_percentile(vals, 95), 6),
+                "max": round(max(vals), 6),
+            }
+            for name, vals in sorted(self._span_values().items())
+        }
+
+    def span_trend(self, name: str) -> List[Tuple[str, float]]:
+        """p95 of one span per campaign generation, in first-seen gen
+        order — the "checker p95 span duration trend" query."""
+        by_gen: Dict[str, List[float]] = {}
+        order: List[str] = []
+        for r in self.records:
+            dur = (r.get("spans") or {}).get(name)
+            if not isinstance(dur, (int, float)):
+                continue
+            gen = str(r.get("gen") or "?")
+            if gen not in by_gen:
+                order.append(gen)
+            by_gen.setdefault(gen, []).append(float(dur))
+        return [(g, round(_percentile(by_gen[g], 95), 6)) for g in order]
+
+    # -- rollups ------------------------------------------------------------
+
+    def verdict_counts(self, runs: Optional[Iterable[str]] = None
+                       ) -> Dict[str, int]:
+        """Verdict histogram over the LATEST record per run id."""
+        latest: Dict[str, Dict[str, Any]] = {}
+        for r in self.records:
+            if "valid?" in r:
+                latest[r["run"]] = r
+        if runs is not None:
+            wanted = set(runs)
+            latest = {k: v for k, v in latest.items() if k in wanted}
+        counts = {"true": 0, "false": 0, "unknown": 0,
+                  "degraded": 0, "deadline": 0}
+        for r in latest.values():
+            v = r.get("valid?")
+            counts["true" if v is True else
+                   "false" if v is False else "unknown"] += 1
+            if r.get("degraded"):
+                counts["degraded"] += 1
+            if r.get("deadline"):
+                counts["deadline"] += 1
+        return counts

@@ -1,0 +1,263 @@
+"""Campaign spec → expanded run list with stable per-run ids.
+
+A campaign spec is a small declarative JSON document describing a
+matrix of runs::
+
+    {
+      "name": "nightly",
+      "workloads": ["append", {"name": "wr", "opts": {"concurrency": 8}}],
+      "faults": [null, {"seed": 7, "p": 0.2, "kinds": "oom|xla"}],
+      "seeds": [0, 1, 2],
+      "opts": {"time-limit": 2.0, "telemetry": true,
+               "checker-time-limit": 30}
+    }
+
+`expand` turns it into the cartesian product workload × fault × seed —
+one :class:`RunSpec` per cell, in deterministic (workload-major) order.
+Every RunSpec carries a *stable* ``run_id`` derived from a digest of
+its canonicalized cell (campaign name, workload entry, fault entry,
+seed, merged opts): re-expanding the same spec yields the same ids,
+which is what makes the index resumable and regression queries
+well-keyed across campaign generations.
+
+Workloads resolve by name against the demo registry (`__main__._wl`,
+the in-process sim cluster) plus ``"noop"`` (`core.noop_test` — runs no
+ops, always valid; the campaign smoke workload).  A db suite extends
+the table via :func:`register_workload`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from jepsen_tpu.resilience import faults as faults_mod
+
+__all__ = ["RunSpec", "expand", "load_spec", "spec_digest",
+           "build_test", "register_workload", "DEVICE_WORKLOADS"]
+
+#: workload names whose checkers dispatch to the device pipelines (elle
+#: list-append/rw-register, knossos device WGL) — the scheduler
+#: serializes these through device slots; host-only checkers run freely
+DEVICE_WORKLOADS = frozenset({
+    "append", "wr", "causal", "long-fork", "lin-register", "queue",
+})
+
+#: extension point: name -> builder(opts_dict) -> test map (db suites
+#: add their own); names here shadow the demo registry
+_EXTRA_WORKLOADS: Dict[str, Callable[[Dict[str, Any]], dict]] = {}
+
+
+def register_workload(name: str, builder: Callable[[Dict[str, Any]], dict],
+                      device: bool = False) -> None:
+    """Register a campaign-runnable workload: `builder(opts) -> test
+    map`.  `device=True` marks it for device-slot serialization."""
+    _EXTRA_WORKLOADS[name] = builder
+    if device:
+        global DEVICE_WORKLOADS
+        DEVICE_WORKLOADS = DEVICE_WORKLOADS | {name}
+
+
+@dataclass
+class RunSpec:
+    """One cell of the campaign matrix — everything needed to build and
+    run the test, declaratively (so a subprocess executor can rebuild
+    it from JSON)."""
+
+    run_id: str
+    campaign: str
+    workload: str
+    seed: int
+    fault: Optional[Union[dict, str]] = None
+    fault_label: str = "nofault"
+    workload_label: str = ""
+    opts: Dict[str, Any] = field(default_factory=dict)
+    device: bool = False
+
+    @property
+    def key(self) -> str:
+        """The regression key: stable across campaign generations."""
+        return f"{self.workload_label}|{self.fault_label}|s{self.seed}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "run_id": self.run_id, "campaign": self.campaign,
+            "workload": self.workload, "seed": self.seed,
+            "fault": self.fault, "fault_label": self.fault_label,
+            "workload_label": self.workload_label, "opts": self.opts,
+            "device": self.device,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RunSpec":
+        return cls(**d)
+
+
+def _digest(obj: Any, n: int = 8) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, default=str).encode()
+    ).hexdigest()[:n]
+
+
+def spec_digest(spec: dict) -> str:
+    """Digest of the whole (normalized) spec — stamped into index
+    records so a ledger mixing two different specs is detectable."""
+    return _digest(load_spec(spec), 12)
+
+
+def load_spec(spec: Union[str, dict]) -> dict:
+    """Load + normalize a campaign spec (path or dict).  Raises
+    ValueError on malformed specs with a message naming the field."""
+    if isinstance(spec, str):
+        with open(spec) as f:
+            spec = json.load(f)
+    if not isinstance(spec, dict):
+        raise ValueError(f"campaign spec must be a dict, got {type(spec).__name__}")
+    out = dict(spec)
+    out["name"] = str(out.get("name") or "campaign")
+    wls = out.get("workloads")
+    if not wls or not isinstance(wls, list):
+        raise ValueError('campaign spec needs a non-empty "workloads" list')
+    # dedupe each axis after normalization (order-preserving): entries
+    # that alias to the same cell — e.g. faults [null, "", {}] all
+    # normalize to None — would otherwise expand to runs with IDENTICAL
+    # run_ids that race each other in the store
+    out["workloads"] = _uniq([_norm_workload(w) for w in wls])
+    out["faults"] = _uniq(
+        [_norm_fault(fp) for fp in (out.get("faults") or [None])])
+    seeds = out.get("seeds") or [0]
+    out["seeds"] = _uniq([int(s) for s in seeds])
+    out["opts"] = dict(out.get("opts") or {})
+    return out
+
+
+def _uniq(xs: list) -> list:
+    out, seen = [], set()
+    for x in xs:
+        k = json.dumps(x, sort_keys=True, default=str)
+        if k not in seen:
+            seen.add(k)
+            out.append(x)
+    return out
+
+
+def _norm_workload(w: Union[str, dict]) -> dict:
+    if isinstance(w, str):
+        w = {"name": w}
+    if not isinstance(w, dict) or not w.get("name"):
+        raise ValueError(f'bad workload entry {w!r} (want "name" or '
+                         '{"name": ..., "opts": {...}})')
+    out = {"name": str(w["name"]), "opts": dict(w.get("opts") or {})}
+    if w.get("label"):
+        out["label"] = str(w["label"])
+    return out
+
+
+def _norm_fault(fp: Union[None, str, dict]) -> Optional[dict]:
+    """Normalize a fault-plan entry; validates via the FaultPlan parser
+    so a bad spec fails at plan time, not mid-campaign."""
+    if fp is None:
+        return None
+    if isinstance(fp, dict) and "spec" in fp:  # labeled form
+        d = faults_mod.parse_spec(fp["spec"])
+        if d is None:
+            return None
+        faults_mod.FaultPlan.from_spec(d)  # raises on unknown keys/kinds
+        return {"label": str(fp.get("label") or "f-" + _digest(d, 6)),
+                "spec": d}
+    d = faults_mod.parse_spec(fp)
+    if d is None:
+        return None
+    faults_mod.FaultPlan.from_spec(d)  # raises on unknown keys/kinds
+    return {"label": "f-" + _digest(d, 6), "spec": d}
+
+
+def _wl_label(w: dict) -> str:
+    if w.get("label"):
+        return w["label"]
+    return w["name"] + (f"-{_digest(w['opts'], 4)}" if w["opts"] else "")
+
+
+def expand(spec: Union[str, dict]) -> List[RunSpec]:
+    """Expand a campaign spec into its RunSpec list (workload-major,
+    then fault, then seed — deterministic)."""
+    spec = load_spec(spec)
+    name = spec["name"]
+    base_opts = spec["opts"]
+    out: List[RunSpec] = []
+    for w in spec["workloads"]:
+        wl_label = _wl_label(w)
+        merged = {**base_opts, **w["opts"]}
+        for fp in spec["faults"]:
+            f_label = fp["label"] if fp else "nofault"
+            f_spec = fp["spec"] if fp else None
+            for seed in spec["seeds"]:
+                cell = {"campaign": name, "workload": w, "fault": f_spec,
+                        "seed": seed, "opts": merged}
+                rid = f"{wl_label}-{f_label}-s{seed}-{_digest(cell)}"
+                out.append(RunSpec(
+                    run_id=rid, campaign=name, workload=w["name"],
+                    seed=seed, fault=f_spec, fault_label=f_label,
+                    workload_label=wl_label, opts=dict(merged),
+                    device=bool(merged.get(
+                        "device", w["name"] in DEVICE_WORKLOADS)),
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RunSpec -> runnable test map
+# ---------------------------------------------------------------------------
+
+def build_test(rs: RunSpec, base: str) -> dict:
+    """Build the `core.run`-able test map for one campaign cell.
+
+    Workloads resolve by name: registered builders first, then
+    ``"noop"``, then the demo registry over the in-process sim cluster.
+    Opts honored: ``time-limit`` (seconds of workload; None = ops-bound
+    only), ``ops`` (op-count cap), ``concurrency``, ``nodes``,
+    ``telemetry``, ``checker-time-limit``.  The run's fault spec (if
+    any) lands in ``test["faults"]`` — the resilience FaultPlan key."""
+    from jepsen_tpu import core as jcore
+    from jepsen_tpu.generator import core as g
+
+    opts = dict(rs.opts)
+    name = f"{rs.campaign}--{rs.run_id}"
+    if rs.workload in _EXTRA_WORKLOADS:
+        t = _EXTRA_WORKLOADS[rs.workload]({**opts, "seed": rs.seed})
+        t["name"] = name
+    elif rs.workload == "noop":
+        t = jcore.noop_test(name=name)
+    else:
+        from jepsen_tpu.__main__ import _wl
+
+        wl, client = _wl(rs.workload, {**opts, "seed": rs.seed})
+        gen = g.clients(wl["generator"])
+        if opts.get("ops"):
+            gen = g.limit(int(opts["ops"]), gen)
+        tl = opts.get("time-limit", 1.0)
+        if tl:
+            gen = g.time_limit(float(tl), gen)
+        t = jcore.noop_test(
+            name=name,
+            nodes=list(opts.get("nodes") or ["n1", "n2", "n3"]),
+            concurrency=int(opts.get("concurrency", 4)),
+            client=client, generator=gen, checker=wl["checker"])
+        for k, v in wl.items():
+            if k not in ("generator", "checker", "final-generator"):
+                t.setdefault(k, v)
+        if "final-generator" in wl:
+            t["final-generator"] = wl["final-generator"]
+    t["store-dir"] = base
+    t["seed"] = rs.seed
+    t["campaign"] = rs.campaign
+    t["campaign-run-id"] = rs.run_id
+    if opts.get("telemetry"):
+        t["telemetry"] = True
+    if opts.get("checker-time-limit") is not None:
+        t["checker-time-limit"] = float(opts["checker-time-limit"])
+    if rs.fault is not None:
+        t["faults"] = rs.fault
+    return t

@@ -196,7 +196,9 @@ def core_check_auto(h: PaddedLA, n_keys: int, max_k: int = 128,
 
 
 def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
-                     round_to: int = 1, deadline=None):
+                     round_to: int = 1, deadline=None,
+                     site: str = "elle.core-check", plan=None,
+                     policy=None):
     """Host-side rebatch policy, shared by every fused-check caller.
 
     `run(max_k, max_rounds)` -> (bits, overflowed).  If the sweep
@@ -212,6 +214,11 @@ def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
     `DeadlineExceeded`, which `check_safe` maps to an unknown verdict).
     Each `run` dispatch goes through the resilience guard — transient
     device failures retry, injected faults land here in chaos mode.
+    `site`/`plan`/`policy` let callers label and pin that ONE guard
+    (e.g. the sharded sweeps use site "parallel.op-shard") — callers
+    must NOT wrap `run` in a second device_call: nested guards multiply
+    retries (attempts²) and double-advance the fault plan's call
+    counter, breaking the deterministic replay contract.
     """
     import numpy as np
 
@@ -221,7 +228,8 @@ def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
         if deadline is not None:
             deadline.check("elle.grow-until-exact")
         bits, over = resilience.device_call(
-            "elle.core-check", run, max_k, max_rounds, deadline=deadline)
+            site, run, max_k, max_rounds, deadline=deadline, plan=plan,
+            policy=policy)
         over_i = int(np.asarray(over))
         conv = int(np.asarray(bits)[-1]) == 1
         if over_i > 0 and max_k < MAX_K_CAP:

@@ -87,7 +87,8 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
                 "elle.list-append",
                 lambda: oracle.check(history, consistency_models,
                                      anomalies,
-                                     max_reported=max_reported),
+                                     max_reported=max_reported,
+                                     deadline=deadline),
                 e, deadline=deadline)
         except resilience.DeadlineExceeded:
             return resilience.deadline_result(checker="list-append")
@@ -248,9 +249,10 @@ def _check_device(history, consistency_models, anomalies, max_reported,
             raise RuntimeError("cycle sweep did not converge")
         poll("elle.host-fallback")
         # pass the ORIGINAL input: an op-level history keeps its session
-        # checkability through the fallback (packing drops it)
+        # checkability through the fallback (packing drops it); the
+        # budget follows — the oracle polls it itself now
         return oracle.check(history, consistency_models, anomalies,
-                            max_reported=max_reported)
+                            max_reported=max_reported, deadline=deadline)
 
     # session-guarantee tokens run the dedicated per-process checker —
     # after the fallback decision, so a non-converged sweep doesn't do

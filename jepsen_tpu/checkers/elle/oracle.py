@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from jepsen_tpu import telemetry
+from jepsen_tpu.resilience import DeadlineExceeded, deadline_result
 from jepsen_tpu.checkers.elle import consistency
 from jepsen_tpu.checkers.elle.graph import (
     REL_NAMES,
@@ -87,8 +88,16 @@ def _unpack(p: PackedTxns) -> List[Txn]:
 
 def check(history, consistency_models: Sequence[str] = ("serializable",),
           anomalies: Sequence[str] = (), max_cycle_steps: int = 2_000_000,
-          max_reported: int = 8) -> Dict[str, Any]:
-    """Check a list-append history.  Accepts a History / op list / PackedTxns."""
+          max_reported: int = 8, deadline=None) -> Dict[str, Any]:
+    """Check a list-append history.  Accepts a History / op list / PackedTxns.
+
+    `deadline` (a `resilience.Deadline`, e.g. the shared
+    ``opts["deadline"]`` placed by `check_safe`) is polled between
+    stages and inside the per-txn / per-key / per-spec loops: expiry
+    returns ``{"valid?": "unknown", "error": "deadline-exceeded"}``
+    carrying whatever anomalies the interrupted stages already found,
+    instead of running unbounded — the host oracle honors the same
+    budget contract as the device pipelines it backs up."""
     # sequential phase spans (telemetry, no-op when disabled): the same
     # infer / graph-build / cycle-sweep stage names as the device
     # pipeline, so host-vs-device time is comparable in one trace
@@ -101,6 +110,33 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     ph.start("elle.infer", device=False, txns=p.n_txns)
     txns = _unpack(p)
     found: Dict[str, List[Any]] = {}
+    try:
+        return _check_body(history, p, txns, found, consistency_models,
+                           anomalies, max_cycle_steps, max_reported,
+                           deadline, ph)
+    except DeadlineExceeded as e:
+        ph.end()
+        return deadline_result(
+            checker="elle-oracle",
+            **{"anomaly-types": sorted(found), "anomalies": found,
+               "not": [], "also-not": [],
+               "partial": f"interrupted at {e.what or 'oracle'}"})
+
+
+def _check_body(history, p: PackedTxns, txns, found,
+                consistency_models, anomalies, max_cycle_steps,
+                max_reported, deadline, ph) -> Dict[str, Any]:
+    # cooperative budget: cheap monotonic poll every POLL_EVERY
+    # iterations of the hot loops, and once per stage boundary
+    POLL_EVERY = 256
+    n_polls = [0]
+
+    def poll(site: str, every: int = 1) -> None:
+        if deadline is None:
+            return
+        n_polls[0] += 1
+        if n_polls[0] % every == 0:
+            deadline.check(site)
 
     def report(name: str, item: Any):
         found.setdefault(name, [])
@@ -111,6 +147,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     writer: Dict[int, int] = {}
     final_append: Dict[int, bool] = {}
     for t in txns:
+        poll("elle.infer", POLL_EVERY)
         last_per_key: Dict[int, int] = {}
         for (kind, key, val, _) in t.mops:
             if kind == MOP_APPEND:
@@ -130,6 +167,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
 
     # -- internal consistency + duplicate elements (ok txns only) ----------
     for t in txns:
+        poll("elle.internal", POLL_EVERY)
         if t.type != TXN_OK:
             continue
         cur: Dict[int, Optional[List[int]]] = {}
@@ -161,6 +199,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
 
     # -- G1a (aborted read) / G1b (intermediate read) -----------------------
     for t in txns:
+        poll("elle.g1", POLL_EVERY)
         if t.type != TXN_OK:
             continue
         for mi, (kind, key, val, rd) in enumerate(t.mops):
@@ -192,6 +231,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
 
     version_order: Dict[int, List[int]] = {}
     for key, reads in reads_by_key.items():
+        poll("elle.version-order", 64)
         longest = max(reads, key=lambda r: len(r[0]))[0]
         for (rd, ti, mi) in reads:
             if rd != longest[: len(rd)]:
@@ -214,6 +254,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
                         "committed-writer": txns[wb].orig_index})
 
     # -- dependency edges ---------------------------------------------------
+    poll("elle.graph-build")
     ph.start("elle.graph-build", device=False)
 
     def graph_txn(i: int) -> bool:
@@ -223,12 +264,14 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     wr_s: List[int] = []; wr_d: List[int] = []
     rw_s: List[int] = []; rw_d: List[int] = []
     for key, order in version_order.items():
+        poll("elle.graph-build", 64)
         for a, b in zip(order[:-1], order[1:]):
             wa, wb = writer.get(a), writer.get(b)
             if (wa is not None and wb is not None and wa != wb
                     and graph_txn(wa) and graph_txn(wb)):
                 ww_s.append(wa); ww_d.append(wb)
     for key, reads in reads_by_key.items():
+        poll("elle.graph-build", 64)
         order = version_order[key]
         for (rd, ti, mi) in reads:
             if rd != order[: len(rd)]:
@@ -285,6 +328,7 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
     # op-level input; coverage.py owns the degradation rule
     from jepsen_tpu.checkers.elle import coverage
 
+    poll("elle.sessions")
     ph.start("elle.sessions", device=False)
     sess_found, sess_checked = coverage.run_la_sessions(
         history, want, isinstance(history, PackedTxns),
@@ -297,12 +341,16 @@ def check(history, consistency_models: Sequence[str] = ("serializable",),
 
     ph.start("elle.cycle-sweep", device=False, specs=len(cycle_specs))
     for name in cycle_specs:
+        # per-spec poll: the SCC + rel-constrained search is the
+        # unbounded part of the host path — the budget must bite here
+        poll("elle.cycle-sweep")
         spec = CYCLE_ANOMALY_SPECS[name]
         proj = edges.project(spec.rels)
         if not len(proj):
             continue
         sccs = nontrivial_sccs(total_nodes, proj.src, proj.dst)
         for scc in sccs:
+            poll("elle.cycle-sweep", 16)
             cyc = find_cycle(scc, proj, spec, max_steps=max_cycle_steps)
             if cyc is not None:
                 report(name, {"cycle": _render_cycle(cyc, txns, n_nodes),

@@ -164,6 +164,43 @@ def trace_cmd(opts: argparse.Namespace) -> int:
     return 0
 
 
+def campaign_cmd(opts: argparse.Namespace) -> int:
+    """`campaign run|status|report <spec.json>` — drive a whole fleet
+    of tests through `jepsen_tpu.campaign` (see docs/CAMPAIGN.md)."""
+    from . import campaign, report
+
+    try:
+        spec = campaign.load_spec(opts.spec)
+    except (OSError, ValueError) as e:
+        print(f"campaign: bad spec {opts.spec!r}: {e}", file=sys.stderr)
+        return 2
+    base = opts.store_dir
+    if opts.action == "run":
+        summary = campaign.run_campaign(
+            spec, base, workers=opts.workers,
+            device_slots=opts.device_slots, executor=opts.executor,
+            rerun=opts.rerun, run_deadline_s=opts.run_deadline)
+        print(report.render_campaign(summary))
+        bad = summary["counts"]["false"]
+        if bad:
+            print(f"{bad} invalid run(s)", file=sys.stderr)
+        return 1 if bad else 0
+    if opts.action == "status":
+        s = campaign.status_campaign(spec, base)
+        c = s["counts"]
+        print(f"campaign {s['campaign']}: {s['total']} runs, "
+              f"{s['pending']} pending — {c['true']} ok, "
+              f"{c['false']} invalid, {c['unknown']} unknown "
+              f"({c['degraded']} degraded, {c['deadline']} "
+              f"deadline-expired)\nindex: {s['index']}")
+        return 0
+    if opts.action == "report":
+        print(campaign.report_campaign(spec, base))
+        return 0
+    print(f"campaign: unknown action {opts.action!r}", file=sys.stderr)
+    return 2
+
+
 def analyze_cmd(opts: argparse.Namespace,
                 checker_fn: Optional[Callable[[], Any]] = None) -> int:
     """Re-check a stored run (reference: store/load + re-check path)."""
@@ -203,6 +240,30 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                          help="summarize a stored run's telemetry")
     ptr.add_argument("dir", help="store run directory")
 
+    pc = sub.add_parser("campaign",
+                        help="run/inspect a fleet of tests from a "
+                             "campaign spec (docs/CAMPAIGN.md)")
+    pc.add_argument("action", choices=("run", "status", "report"))
+    pc.add_argument("spec", help="campaign spec JSON file")
+    pc.add_argument("--workers", type=int, default=2,
+                    help="concurrent campaign workers")
+    pc.add_argument("--device-slots", type=int, default=1,
+                    help="concurrent device-pipeline runs (host-only "
+                         "runs are unthrottled)")
+    pc.add_argument("--executor", choices=("thread", "subprocess"),
+                    default="thread",
+                    help="per-run isolation: in-process threads (warm "
+                         "jit cache) or one subprocess per run "
+                         "(crash/hang isolation)")
+    pc.add_argument("--rerun", action="store_true",
+                    help="re-execute runs already in the index "
+                         "(appends fresh records; this is what makes "
+                         "verdict flips observable)")
+    pc.add_argument("--run-deadline", type=float, default=None,
+                    help="per-run budget in seconds (hard kill under "
+                         "the subprocess executor; cooperative checker "
+                         "deadline otherwise)")
+
     def dispatch(opts: argparse.Namespace) -> int:
         if opts.cmd == "test":
             return run_test_cmd(test_fn, opts)
@@ -212,6 +273,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
             return analyze_cmd(opts, checker_fn)
         if opts.cmd == "trace":
             return trace_cmd(opts)
+        if opts.cmd == "campaign":
+            return campaign_cmd(opts)
         p.error(f"unknown command {opts.cmd}")
         return 2
 

@@ -190,8 +190,11 @@ def _sweep_window(n_nodes: int, k_total: int, k_local: int, max_rounds: int,
         if axis_name is not None:
             # the label plane is varying over the mesh axis (its window
             # depends on axis_index), so the whole carry must be too
-            changed0 = jax.lax.pcast(changed0, axis_name, to="varying")
-            rounds0 = jax.lax.pcast(rounds0, axis_name, to="varying")
+            # (no-op on jax versions without the varying-type system)
+            from jepsen_tpu.utils.backend import pcast_varying
+
+            changed0 = pcast_varying(changed0, axis_name)
+            rounds0 = pcast_varying(rounds0, axis_name)
         labels, changed, rounds = jax.lax.while_loop(
             cond, body, (chain_pass(labels0), changed0, rounds0))
         converged = ~(changed & (rounds >= max_rounds))

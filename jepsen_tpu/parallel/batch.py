@@ -22,9 +22,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from jepsen_tpu import resilience
 from jepsen_tpu.checkers.elle.device_core import core_check
 from jepsen_tpu.checkers.elle.device_infer import PaddedLA, pad_packed
 from jepsen_tpu.history.soa import PackedTxns
+from jepsen_tpu.utils.backend import get_shard_map
+
+shard_map = get_shard_map()
 
 
 def make_mesh(n_devices: int = 0, axis: str = "dp") -> Mesh:
@@ -86,7 +90,8 @@ def _batched_core(batch: PaddedLA, n_keys: int):
 
 
 def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
-                axis: str = "dp", caps: tuple = None) -> List[dict]:
+                axis: str = "dp", caps: tuple = None,
+                deadline=None, plan=None, policy=None) -> List[dict]:
     """Check a batch of histories, sharded across the mesh if given.
 
     Returns one summary dict per history: {"valid?", "bits", "exact"}.
@@ -95,6 +100,12 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
     the default backward-edge budget are re-run alone with a grown budget,
     so verdicts are definitive whenever the caps allow.  `caps` pins the
     padded capacities (see `batch_caps`).
+
+    The device dispatch runs under the resilience guard: `deadline` is
+    polled before it, transient failures retry per `policy`, and the
+    active `plan` (explicit > JEPSEN_FAULTS chaos) fires its synthetic
+    faults at the ``parallel.batch`` site — the multi-device paths are
+    inside the chaos perimeter, not around it.
     """
     n_real = len(ps)
     if mesh is not None:
@@ -106,7 +117,9 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
     n_keys = batch.n_keys
 
     if mesh is None:
-        bits, over = _batched_core(batch, n_keys)
+        bits, over = resilience.device_call(
+            "parallel.batch", _batched_core, batch, n_keys,
+            deadline=deadline, plan=plan, policy=policy)
     else:
         spec = P(axis)
         in_shard = NamedSharding(mesh, spec)
@@ -116,13 +129,15 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
 
         batch = jax.tree_util.tree_map(put, batch)
 
-        @partial(jax.shard_map, mesh=mesh, in_specs=(spec,),
+        @partial(shard_map, mesh=mesh, in_specs=(spec,),
                  out_specs=(spec, spec))
         def sharded(b):
             bits, over = jax.vmap(lambda h: core_check(h, n_keys))(b)
             return bits, over
 
-        bits, over = sharded(batch)
+        bits, over = resilience.device_call(
+            "parallel.batch", sharded, batch,
+            deadline=deadline, plan=plan, policy=policy)
 
     return summarize_batch_bits(bits, over, batch, n_keys, n_real)
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from jepsen_tpu import resilience
 from jepsen_tpu.checkers.elle.device_infer import infer
 from jepsen_tpu.history.soa import PackedTxns
 from jepsen_tpu.ops.cycle_sweep import _sweep_window
@@ -44,6 +45,9 @@ from jepsen_tpu.parallel.batch import (
     summarize_batch_bits,
 )
 from jepsen_tpu.parallel.op_shard import projection_sweep_bits
+from jepsen_tpu.utils.backend import get_shard_map
+
+shard_map = get_shard_map()
 
 
 def make_hybrid_mesh(n_dcn: int, n_k: int, devices=None) -> Mesh:
@@ -62,7 +66,7 @@ def _hybrid_core(batch, n_keys: int, mesh: Mesh, max_k: int = 128,
 
     bspec = P("dcn")
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(bspec,),
+    @partial(shard_map, mesh=mesh, in_specs=(bspec,),
              out_specs=(bspec, bspec))
     def rows(b):
         def one(h):
@@ -83,7 +87,8 @@ def _hybrid_core(batch, n_keys: int, mesh: Mesh, max_k: int = 128,
 
 
 def check_batch_hybrid(ps: Sequence[PackedTxns], mesh: Mesh,
-                       max_k: int = 128, max_rounds: int = 64
+                       max_k: int = 128, max_rounds: int = 64,
+                       deadline=None, plan=None, policy=None
                        ) -> List[dict]:
     """Check a batch of histories over a 2D ("dcn", "k") mesh; one
     summary dict per history (the `check_batch` row shape).
@@ -91,7 +96,9 @@ def check_batch_hybrid(ps: Sequence[PackedTxns], mesh: Mesh,
     The batch is padded to a multiple of the dcn axis with copies of the
     first history (dropped from the results).  Inexact verdicts
     (overflow / non-convergence) are re-run alone through the exact
-    single-device path rather than approximated.
+    single-device path rather than approximated.  The 2D dispatch is a
+    guarded fault-plan site (``parallel.hybrid``) like the other
+    sharded seams.
     """
     n_dcn = mesh.shape["dcn"]
     n_k = mesh.shape["k"]
@@ -105,7 +112,9 @@ def check_batch_hybrid(ps: Sequence[PackedTxns], mesh: Mesh,
     batch = jax.tree_util.tree_map(
         lambda x: jax.device_put(x, NamedSharding(mesh, P("dcn"))), batch)
 
-    bits, over = _hybrid_core(batch, batch.n_keys, mesh, max_k=max_k,
-                              max_rounds=max_rounds)
+    bits, over = resilience.device_call(
+        "parallel.hybrid", _hybrid_core, batch, batch.n_keys, mesh,
+        max_k=max_k, max_rounds=max_rounds,
+        deadline=deadline, plan=plan, policy=policy)
     return summarize_batch_bits(bits, over, batch, batch.n_keys, n_real,
                                 k_floor=max_k)

@@ -47,6 +47,9 @@ from jepsen_tpu.checkers.elle.device_core import (
 from jepsen_tpu.checkers.elle.device_infer import PaddedLA, infer, pad_packed
 from jepsen_tpu.history.soa import PackedTxns
 from jepsen_tpu.ops.cycle_sweep import _sweep_window
+from jepsen_tpu.utils.backend import get_shard_map
+
+shard_map = get_shard_map()
 
 
 def projection_sweep_bits(out, max_k: int, sweep):
@@ -116,7 +119,7 @@ def _core_check_sharded(h: PaddedLA, n_keys: int, mesh: Mesh, axis: str,
     T = h.txn_type.shape[0]
     rep = P()
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(rep,) * 12, out_specs=(rep, rep, rep, rep))
     def sharded_sweep(rank_, e_src_, e_dst_, m_, cn_, cs_, cm_,
                       ib_, bid_, nb_, bsrc_, bdst_):
@@ -162,12 +165,15 @@ def shard_padded(h: PaddedLA, mesh: Mesh, axis: str = "dp"
 
 def check_sharded(p: PackedTxns | PaddedLA, mesh: Optional[Mesh] = None,
                   axis: str = "dp", max_k: int = 128,
-                  max_rounds: int = 64, deadline=None) -> dict:
+                  max_rounds: int = 64, deadline=None, plan=None,
+                  policy=None) -> dict:
     """Check ONE history sharded across the mesh; summary dict like a
     `check_batch` row.  Falls back to growing budgets (like
     `core_check_exact`) when the sweep overflows.  `deadline` bounds
     the grow loop (resilience contract; expiry raises
-    `DeadlineExceeded`)."""
+    `DeadlineExceeded`); the sharded dispatch itself is a guarded
+    fault-plan site (``parallel.op-shard``), so JEPSEN_FAULTS chaos
+    reaches the K-axis sharded sweep too."""
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), (axis,))
     h = p if isinstance(p, PaddedLA) else pad_packed(p)
@@ -181,7 +187,8 @@ def check_sharded(p: PackedTxns | PaddedLA, mesh: Optional[Mesh] = None,
     bits, over = grow_until_exact(
         lambda k, r: _core_check_sharded(h, n_keys, mesh, axis,
                                          max_k=k, max_rounds=r),
-        max_k, max_rounds, round_to=n_shards, deadline=deadline)
+        max_k, max_rounds, round_to=n_shards, deadline=deadline,
+        site="parallel.op-shard", plan=plan, policy=policy)
     over_i = int(np.asarray(over))
 
     row = np.asarray(bits)

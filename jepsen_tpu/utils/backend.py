@@ -39,6 +39,47 @@ def force_cpu_backend(n_devices: int | None = None) -> None:
         pass
 
 
+def get_shard_map():
+    """Version-portable ``shard_map``: newer jax exposes it as
+    ``jax.shard_map``; this box's 0.4.x only has
+    ``jax.experimental.shard_map.shard_map``.  Resolve whichever
+    exists (preferring the public one) — the `parallel/` modules bind
+    it once at import instead of touching `jax.shard_map` directly.
+
+    The legacy experimental API defaults ``check_rep=True``, whose
+    replication checker has no rule for ``while_loop`` (the cycle-sweep
+    fixpoint) and rejects our kernels; the wrapper defaults it off,
+    matching the public API's behavior."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    import functools
+
+    from jax.experimental.shard_map import shard_map as legacy
+
+    @functools.wraps(legacy)
+    def sm(f, *args, **kw):
+        kw.setdefault("check_rep", False)
+        return legacy(f, *args, **kw)
+
+    return sm
+
+
+def pcast_varying(x, axis_name):
+    """Version-portable ``jax.lax.pcast(x, axis, to="varying")``: jax
+    versions without the varying-axis type system (no ``lax.pcast``)
+    don't track replication in manual-mesh code either, so the cast is
+    simply unnecessary there — return the operand unchanged."""
+    import jax
+
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axis_name, to="varying")
+
+
 def enable_compile_cache(cache_dir: str | None = None) -> str:
     """Point jax at a persistent XLA compilation cache (honors the
     BENCH_CACHE_DIR env knob; defaults to <repo>/.jax_cache).  Driver

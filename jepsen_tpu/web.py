@@ -25,32 +25,76 @@ logger = logging.getLogger("jepsen.web")
 
 
 def _run_summary(d: str) -> Dict[str, Any]:
-    """Cheap summary of one run dir: verdict comes from results.json (fast
-    path) or the .jepsen results block."""
+    """Cheap summary of one run dir: verdict + attribution flags
+    (deadline-expired, degraded-to-host) from results.json (fast path)
+    or the .jepsen results block."""
     out: Dict[str, Any] = {
         "dir": d,
         "name": os.path.basename(os.path.dirname(d)),
         "timestamp": os.path.basename(d),
         "valid?": "?",
+        "error": None,
+        "degraded": None,
+        "deadline": False,
     }
     rj = os.path.join(d, "results.json")
     try:
         if os.path.exists(rj):
             with open(rj) as f:
-                out["valid?"] = json.load(f).get("valid?", "?")
+                res = json.load(f)
         else:
             res = store.load(d).get("results")
-            if res:
-                out["valid?"] = res.get("valid?", "?")
+        if res:
+            from .campaign.core import result_flags
+
+            out["valid?"] = res.get("valid?", "?")
+            out.update(result_flags(res))
     except Exception:  # noqa: BLE001 — a corrupt run still gets listed
         out["valid?"] = "corrupt"
     return out
 
 
-def _verdict_cell(v: Any) -> str:
-    color = {"True": "#9ce29c", "False": "#f2a3a3",
-             "unknown": "#ffd37a"}.get(str(v), "#ddd")
-    return f'<td style="background:{color};text-align:center">{html.escape(str(v))}</td>'
+#: shared badge CSS — every page that renders verdict cells embeds it
+_BADGE_CSS = """
+.b { padding: 1px 7px; border-radius: 3px; white-space: nowrap; }
+.b-true { background: #9ce29c; }
+.b-false { background: #f2a3a3; }
+.b-unknown { background: #ffd37a; }
+.b-deadline { background: #ffb347; border: 1px solid #c07a2d; }
+.b-degraded { background: #a8c8f0; border: 1px solid #5a82b4;
+              font-size: 85%; margin-left: 4px; }
+.b-other { background: #ddd; }
+"""
+
+
+def _verdict_badges(v: Any, error: Any = None, degraded: Any = None,
+                    deadline: Any = None) -> str:
+    """Verdict badge HTML: unknown-because-deadline and degraded-to-
+    host runs get DISTINCT badges so they're tellable apart from plain
+    unknowns/valids at a glance (ROADMAP open item).  `deadline` takes
+    a precomputed flag (campaign index records carry one); when absent
+    it is derived from `error` with the canonical marker."""
+    if deadline is None:
+        from .resilience import DEADLINE_ERROR
+
+        deadline = isinstance(error, str) and DEADLINE_ERROR in error
+    cls = {"True": "b-true", "False": "b-false",
+           "unknown": "b-unknown"}.get(str(v), "b-other")
+    label = str(v)
+    if deadline:
+        cls = "b-deadline"
+        label = f"{v} · deadline"
+    out = f'<span class="b {cls}">{html.escape(label)}</span>'
+    if degraded:
+        out += (f'<span class="b b-degraded" title="device pipeline '
+                f'degraded">{html.escape(str(degraded))}</span>')
+    return out
+
+
+def _verdict_cell(v: Any, error: Any = None, degraded: Any = None,
+                  deadline: Any = None) -> str:
+    return ('<td style="text-align:center">'
+            f"{_verdict_badges(v, error, degraded, deadline)}</td>")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -90,6 +134,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._zip(path[len("/zip/"):])
             if path.startswith("/telemetry/"):
                 return self._telemetry(path[len("/telemetry/"):])
+            if path.startswith("/run/"):
+                return self._run(path[len("/run/"):])
+            if path in ("/campaigns", "/campaigns/"):
+                return self._campaigns()
+            if path.startswith("/campaign/"):
+                return self._campaign(path[len("/campaign/"):])
             self._send(404, b"not found", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
             pass
@@ -107,21 +157,173 @@ class _Handler(BaseHTTPRequestHandler):
                    else "<td></td>")
             rows.append(
                 "<tr>"
-                f'<td><a href="/files/{quote(rel)}/">{html.escape(s["name"])}</a></td>'
+                f'<td><a href="/run/{quote(rel)}">{html.escape(s["name"])}</a></td>'
                 f'<td><a href="/files/{quote(rel)}/">{html.escape(s["timestamp"])}</a></td>'
-                f"{_verdict_cell(s['valid?'])}"
+                f"{_verdict_cell(s['valid?'], s['error'], s['degraded'], s['deadline'])}"
                 f"{tel}"
                 f'<td><a href="/zip/{quote(rel)}">zip</a></td>'
                 "</tr>")
+        camp = ('<p><a href="/campaigns">campaigns</a></p>'
+                if os.path.isdir(os.path.join(self.base, "campaigns"))
+                else "")
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
 <title>jepsen-tpu</title><style>
 body {{ font-family: sans-serif; margin: 2em; }}
 table {{ border-collapse: collapse; }}
 td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
-</style></head><body>
-<h1>jepsen-tpu runs</h1>
+{_BADGE_CSS}</style></head><body>
+<h1>jepsen-tpu runs</h1>{camp}
 <table><tr><th>test</th><th>time</th><th>valid?</th><th>telemetry</th><th>download</th></tr>
 {"".join(rows)}</table></body></html>"""
+        self._send(200, doc.encode())
+
+    def _run(self, rel: str):
+        """Per-run page: the verdict (with deadline/degraded badges),
+        the results map, and links to the artifacts."""
+        rel = rel.rstrip("/")
+        p = self._safe_path(rel)
+        if p is None or not os.path.isdir(p):
+            return self._send(404, b"no such run", "text/plain")
+        s = _run_summary(p)
+        results = None
+        rj = os.path.join(p, "results.json")
+        if os.path.exists(rj):
+            try:
+                with open(rj) as f:
+                    results = json.dumps(json.load(f), indent=1,
+                                         sort_keys=True)
+            except (OSError, ValueError) as e:
+                results = f"results.json unreadable: {e}"
+        tel = (f'&middot; <a href="/telemetry/{quote(rel)}">telemetry</a> '
+               if os.path.exists(os.path.join(p, "telemetry.json"))
+               else "")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>{html.escape(rel)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/">&larr; runs</a></p>
+<h2>{html.escape(s["name"])} <small>{html.escape(s["timestamp"])}</small>
+{_verdict_badges(s["valid?"], s["error"], s["degraded"], s["deadline"])}</h2>
+<p><a href="/files/{quote(rel)}/">files</a> {tel}&middot;
+<a href="/zip/{quote(rel)}">zip</a></p>
+<pre>{html.escape(results or "no results.json (run still in flight, "
+                             "or it crashed before analysis)")}</pre>
+</body></html>"""
+        self._send(200, doc.encode())
+
+    def _campaigns(self):
+        """Campaign list: every jsonl ledger under <store>/campaigns."""
+        from .campaign.index import Index
+
+        cdir = os.path.join(self.base, "campaigns")
+        rows = []
+        if os.path.isdir(cdir):
+            for fn in sorted(os.listdir(cdir)):
+                if not fn.endswith(".jsonl"):
+                    continue
+                name = fn[:-len(".jsonl")]
+                try:
+                    idx = Index(os.path.join(cdir, fn))
+                    c = idx.verdict_counts()
+                    n_reg = len(idx.regressions())
+                except Exception:  # noqa: BLE001 — list corrupt ledgers too
+                    c, n_reg = {}, 0
+                reg = (f'<td style="background:#f2a3a3">{n_reg}</td>'
+                       if n_reg else "<td>0</td>")
+                rows.append(
+                    "<tr>"
+                    f'<td><a href="/campaign/{quote(name)}">'
+                    f"{html.escape(name)}</a></td>"
+                    f"<td>{c.get('true', '?')}</td>"
+                    f"<td>{c.get('false', '?')}</td>"
+                    f"<td>{c.get('unknown', '?')}</td>"
+                    f"<td>{c.get('degraded', '?')}</td>"
+                    f"<td>{c.get('deadline', '?')}</td>"
+                    f"{reg}</tr>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>campaigns</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/">&larr; runs</a></p><h1>campaigns</h1>
+<table><tr><th>campaign</th><th>ok</th><th>invalid</th><th>unknown</th>
+<th>degraded</th><th>deadline</th><th>regressions</th></tr>
+{"".join(rows)}</table></body></html>"""
+        self._send(200, doc.encode())
+
+    def _campaign(self, name: str):
+        """Campaign dashboard: the workload × fault × seed verdict grid
+        (cells link to the run pages; degraded / deadline-expired runs
+        carry distinct badges), plus regressions and span aggregates."""
+        from .campaign.index import Index
+
+        name = unquote(name).rstrip("/")
+        path = self._safe_path(os.path.join("campaigns", name + ".jsonl"))
+        if path is None or not os.path.exists(path):
+            return self._send(404, b"no such campaign", "text/plain")
+        idx = Index(path)
+        latest: Dict[str, Dict[str, Any]] = {}
+        for r in idx.records:
+            if "valid?" in r and r.get("run"):
+                latest[r["run"]] = r
+        seeds = sorted({r.get("seed") for r in latest.values()
+                        if r.get("seed") is not None})
+        grid: Dict[tuple, Dict[Any, Dict[str, Any]]] = {}
+        for r in latest.values():
+            grid.setdefault((str(r.get("workload")), str(r.get("fault"))),
+                            {})[r.get("seed")] = r
+        rows = []
+        for (wl, fl), cells in sorted(grid.items()):
+            tds = []
+            for s in seeds:
+                r = cells.get(s)
+                if r is None:
+                    tds.append("<td>-</td>")
+                    continue
+                badge = _verdict_badges(
+                    r.get("valid?"), r.get("error"), r.get("degraded"),
+                    r.get("deadline"))
+                if r.get("dir"):
+                    badge = (f'<a href="/run/{quote(str(r["dir"]))}">'
+                             f"{badge}</a>")
+                tds.append(f'<td style="text-align:center">{badge}</td>')
+            rows.append(f"<tr><td>{html.escape(wl)}</td>"
+                        f"<td>{html.escape(fl)}</td>{''.join(tds)}</tr>")
+        regs = idx.regressions()
+        reg_html = ""
+        if regs:
+            items = "".join(
+                f"<li><code>{html.escape(str(r['key']))}</code>: "
+                f"{html.escape(str(r['from']))} &rarr; "
+                f"{html.escape(str(r['to']))} ({html.escape(str(r.get('when') or ''))})</li>"
+                for r in regs)
+            reg_html = (f'<h2 style="color:#b03030">regressions</h2>'
+                        f"<ul>{items}</ul>")
+        stats = idx.span_stats()
+        stat_rows = "".join(
+            f"<tr><td>{html.escape(n)}</td><td>{st['count']}</td>"
+            f"<td>{st['p50']:.4f}</td><td>{st['p95']:.4f}</td>"
+            f"<td>{st['max']:.4f}</td></tr>"
+            for n, st in list(stats.items())[:24])
+        stat_html = (f"<h2>checker span durations (s)</h2><table>"
+                     f"<tr><th>span</th><th>n</th><th>p50</th><th>p95</th>"
+                     f"<th>max</th></tr>{stat_rows}</table>"
+                     if stat_rows else "")
+        head = "".join(f"<th>s{s}</th>" for s in seeds)
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>campaign {html.escape(name)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+a {{ text-decoration: none; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/campaigns">&larr; campaigns</a></p>
+<h1>campaign {html.escape(name)}</h1>
+<table><tr><th>workload</th><th>fault</th>{head}</tr>
+{"".join(rows)}</table>
+{reg_html}{stat_html}</body></html>"""
         self._send(200, doc.encode())
 
     def _telemetry(self, rel: str):

@@ -91,6 +91,28 @@ def run_one(seed: int, p: float, deadline_s: float) -> dict:
                              deadline=Deadline(deadline_s))
     verify("knossos", clean_k, faulted_k)
     row["injected"] += len(plan_k.injected)
+
+    # --- parallel batch path (multi-device seam, ISSUE 3 satellite) ----
+    # the guarded `parallel.batch` dispatch has no host fallback: a
+    # transient fault must be retried away (same verdicts), and an
+    # exhausted retry budget must surface as the attributable
+    # FaultInjected — never a silent wrong answer
+    from jepsen_tpu.history.soa import pack_txns
+    from jepsen_tpu.parallel.batch import check_batch
+    from jepsen_tpu.resilience import FaultInjected
+
+    ps = [pack_txns(synth.la_history(n_txns=30, seed=seed * 10 + i),
+                    "list-append") for i in range(3)]
+    clean_b = check_batch(ps)
+    plan_b = FaultPlan(seed=seed + 3, p=p, kinds=("oom", "xla"))
+    try:
+        faulted_b = check_batch(ps, plan=plan_b, policy=policy,
+                                deadline=Deadline(deadline_s))
+        assert faulted_b == clean_b, \
+            "parallel.batch verdicts changed under faults"
+    except FaultInjected:
+        row["exhausted"] = row.get("exhausted", 0) + 1
+    row["injected"] += len(plan_b.injected)
     return row
 
 

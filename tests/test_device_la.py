@@ -238,6 +238,7 @@ def test_device_duplicate_elements_slow_path():
     assert "incompatible-order" in r["anomaly-types"]
 
 
+@pytest.mark.slow  # ~90 s (dense 900-txn graph) — tier-1 budget hog (ISSUE 3)
 def test_device_finds_nonadjacent_oracle_budget_misses():
     """Fuzz find (2026-07-30, seed 999 case 33): on a dense 900-txn
     graph the device's witness-region search finds a genuine

@@ -176,6 +176,7 @@ def test_device_wgl_blocked_above_singlejit_cap():
     assert r.get("blocked") is True
 
 
+@pytest.mark.slow  # ~106 s on this box — tier-1 budget hog (ISSUE 3)
 def test_device_wgl_crash_heavy_dominance_prune():
     """VERDICT r03 item 8: crashed (`info`) ops used to multiply BFS
     frontiers until the device path ceded the regime to the host DFS.
@@ -243,6 +244,7 @@ def test_device_wgl_blocked_beyond_old_4096_wall():
     assert r.get("blocked") is True
 
 
+@pytest.mark.slow  # 4 legs x ~55-69 s each — tier-1 budget hogs (ISSUE 3)
 @pytest.mark.parametrize("seed", range(4))
 def test_device_wgl_blocked_differential_small_frontier(seed):
     # tiny max_frontier forces multi-block waves + host spill on a
