@@ -119,7 +119,7 @@ def execute_run(rs: RunSpec, base: str) -> Dict[str, Any]:
         ops = len(done.get("history") or ())
     except TypeError:
         ops = 0
-    return {
+    rec = {
         "run": rs.run_id, "key": rs.key, "campaign": rs.campaign,
         "workload": rs.workload_label, "fault": rs.fault_label,
         "seed": rs.seed,
@@ -132,6 +132,41 @@ def execute_run(rs: RunSpec, base: str) -> Dict[str, Any]:
         "wall_s": round(time.monotonic() - t0, 3),
         "spans": _spans_from_dir(d),
     }
+    if rec["valid?"] is False and rs.opts.get("shrink"):
+        rec["witness"] = _auto_shrink(rs, done, d)
+    return rec
+
+
+def _auto_shrink(rs: RunSpec, done: dict, d: str) -> Optional[dict]:
+    """The campaign auto-shrink hook (spec opts ``"shrink": true`` or a
+    knob dict): delta-debug an invalid cell's history right after the
+    run, while its checker object is still live, and index the witness
+    summary alongside the verdict.  A failed shrink never fails the
+    cell — the verdict already stands."""
+    from jepsen_tpu import minimize
+
+    knobs = rs.opts.get("shrink")
+    knobs = dict(knobs) if isinstance(knobs, dict) else {}
+    try:
+        s = minimize.shrink(
+            done, checker=done.get("checker"),
+            rounds=knobs.get("rounds"),
+            # bounded by default: ddmin generates exactly the
+            # adversarial sub-histories per-probe deadlines exist for,
+            # and a thread-executor campaign has no hard kill
+            probe_deadline_s=knobs.get("probe-deadline", 30.0),
+            workers=int(knobs.get("workers", 2)),
+            device_slots=int(knobs.get("device-slots", 1)),
+            host_oracle=bool(knobs.get("host-oracle", True)))
+    except Exception as e:  # noqa: BLE001 — triage must not fail the run
+        logger.warning("auto-shrink of %s failed: %s", rs.run_id, e)
+        return {"error": f"{type(e).__name__}: {e}"}
+    if s.get("error"):
+        return {"error": s["error"]}
+    return {"ops": s.get("ops"), "source-ops": s.get("source-ops"),
+            "digest": s.get("digest"),
+            "anomaly-types": s.get("anomaly-types"),
+            "probes": s.get("probes"), "cached": bool(s.get("cached"))}
 
 
 def summarize(spec: Union[str, dict], base: Optional[str] = None,
@@ -156,7 +191,7 @@ def summarize(spec: Union[str, dict], base: Optional[str] = None,
         if rec is not None:
             row.update({k: rec.get(k) for k in
                         ("valid?", "error", "degraded", "deadline",
-                         "dir", "ops", "wall_s", "gen")})
+                         "dir", "ops", "wall_s", "gen", "witness")})
         else:
             row["valid?"] = None  # not yet run
         rows.append(row)

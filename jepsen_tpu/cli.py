@@ -201,6 +201,41 @@ def campaign_cmd(opts: argparse.Namespace) -> int:
     return 2
 
 
+def shrink_cmd(opts: argparse.Namespace,
+               checker_fn: Optional[Callable[[], Any]] = None) -> int:
+    """`shrink <run-dir>` — delta-debug an invalid run's history to a
+    minimal failing witness (see docs/MINIMIZE.md)."""
+    from . import minimize
+
+    chk = checker_fn() if checker_fn else None
+    try:
+        s = minimize.shrink(
+            opts.dir, checker=chk, rounds=opts.rounds,
+            probe_deadline_s=opts.probe_deadline,
+            workers=opts.workers, device_slots=opts.device_slots,
+            host_oracle=opts.host_oracle, anomalies=opts.anomaly,
+            force=opts.force)
+    except (ValueError, FileNotFoundError) as e:
+        print(f"shrink: {e}", file=sys.stderr)
+        return 2
+    if s.get("error") == "not-invalid":
+        print(f"shrink: run is valid? = {s.get('valid?')}; nothing to "
+              "shrink", file=sys.stderr)
+        return 1
+    if s.get("error") == "target-absent":
+        print(f"shrink: requested anomaly {s.get('requested')} not in "
+              f"this run's set {s.get('anomaly-types')}", file=sys.stderr)
+        return 1
+    kinds = ",".join(s.get("anomaly-types") or ()) or "?"
+    src = s.get("source-ops", "?")
+    print(f"witness: {s['ops']} ops (from {src}) — {kinds}"
+          f"{' [cached]' if s.get('cached') else ''}")
+    print(f"rounds: {s.get('rounds', 0)}  probes: {s.get('probes', 0)}"
+          f"  digest: {s.get('digest')}")
+    print(f"written: {s['paths']['ops']}")
+    return 0 if s.get("valid?") is False else 1
+
+
 def analyze_cmd(opts: argparse.Namespace,
                 checker_fn: Optional[Callable[[], Any]] = None) -> int:
     """Re-check a stored run (reference: store/load + re-check path)."""
@@ -240,6 +275,34 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                          help="summarize a stored run's telemetry")
     ptr.add_argument("dir", help="store run directory")
 
+    psh = sub.add_parser("shrink",
+                         help="delta-debug an invalid run to a minimal "
+                              "failing witness (docs/MINIMIZE.md)")
+    psh.add_argument("dir", help="store run directory")
+    psh.add_argument("--rounds", type=int, default=None,
+                     help="cap on probe rounds (default: run to "
+                          "1-minimality)")
+    psh.add_argument("--probe-deadline", type=float, default=30.0,
+                     help="seconds of checker budget per candidate "
+                          "probe (expired probes count as "
+                          "non-reproducing)")
+    psh.add_argument("--workers", type=int, default=2,
+                     help="concurrent probe workers (host probes run "
+                          "wide; device probes serialize through "
+                          "--device-slots)")
+    psh.add_argument("--device-slots", type=int, default=1,
+                     help="concurrent device-pipeline probes")
+    psh.add_argument("--host-oracle", action="store_true",
+                     help="probe through the exact host reference "
+                          "checker where one exists (much cheaper for "
+                          "the many small candidates)")
+    psh.add_argument("--anomaly", action="append", default=None,
+                     help="pin the shrink target to this anomaly type "
+                          "(repeatable; default: any of the run's)")
+    psh.add_argument("--force", action="store_true",
+                     help="re-shrink even when a cached witness "
+                          "matches the history digest")
+
     pc = sub.add_parser("campaign",
                         help="run/inspect a fleet of tests from a "
                              "campaign spec (docs/CAMPAIGN.md)")
@@ -273,6 +336,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
             return analyze_cmd(opts, checker_fn)
         if opts.cmd == "trace":
             return trace_cmd(opts)
+        if opts.cmd == "shrink":
+            return shrink_cmd(opts, checker_fn)
         if opts.cmd == "campaign":
             return campaign_cmd(opts)
         p.error(f"unknown command {opts.cmd}")

@@ -40,14 +40,31 @@ logger = logging.getLogger("jepsen.interpreter")
 _STOP = object()
 _TICK_S = 0.001  # poll granularity when pending with no wake time
 
+#: the client-side chaos seam (ISSUE 4 satellite): a FaultPlan that
+#: EXPLICITLY names this site (``sites`` or ``persistent``) injects
+#: stalls (op latency) and crash-kind faults (``info`` completions —
+#: the op's effect is unknown, the process is re-opened) into every
+#: worker's invoke path.  Strictly opt-in: checker-chaos plans without
+#: the site never touch the workload.
+FAULT_SITE = "interpreter"
+
+#: index-stream stride per worker: fire_at decisions hash the supplied
+#: index, so giving each worker a disjoint arithmetic stream makes
+#: injection deterministic per (seed, worker, local op) regardless of
+#: thread interleaving
+_FAULT_STRIDE = 1_000_003
+
 
 class _ClientWorker:
     """Owns one thread + queue; opens a client per process incarnation."""
 
-    def __init__(self, thread_id: int, test: dict, completions: queue.Queue):
+    def __init__(self, thread_id: int, test: dict, completions: queue.Queue,
+                 plan=None):
         self.thread_id = thread_id
         self.test = test
         self.completions = completions
+        self.plan = plan  # a FaultPlan targeting FAULT_SITE, or None
+        self._n_ops = 0
         self.q: "queue.Queue" = queue.Queue()
         self.process: Optional[int] = None
         self.client: Optional[Client] = None
@@ -83,12 +100,29 @@ class _ClientWorker:
                         logger.warning("client close failed: %s", e)
                 return
             op: dict = msg
-            try:
-                client = self._ensure_client(op["process"])
-                comp = invoke_with_errors(client, self.test, op)
-            except Exception as e:  # noqa: BLE001 — open() itself failed
-                comp = dict(op, type="info",
-                            error=f"open failed: {type(e).__name__}: {e}")
+            comp = None
+            if self.plan is not None:
+                # stalls sleep here (client latency), crash kinds turn
+                # the op into an :info completion without invoking the
+                # client — indistinguishable from a client that died
+                # mid-call, which is exactly what checkers must absorb
+                from jepsen_tpu.resilience.faults import FaultInjected
+
+                idx = self.thread_id * _FAULT_STRIDE + self._n_ops
+                self._n_ops += 1
+                try:
+                    self.plan.fire_at(FAULT_SITE, idx)
+                except FaultInjected as e:
+                    comp = dict(op, type="info",
+                                error=f"fault-injected: {e.kind}")
+            if comp is None:
+                try:
+                    client = self._ensure_client(op["process"])
+                    comp = invoke_with_errors(client, self.test, op)
+                except Exception as e:  # noqa: BLE001 — open() failed
+                    comp = dict(op, type="info",
+                                error=f"open failed: "
+                                      f"{type(e).__name__}: {e}")
             self.completions.put((self.thread_id, comp))
 
 
@@ -133,8 +167,14 @@ def run(test: dict) -> History:
     ctx = context(test)
     init_time_origin()
 
+    from jepsen_tpu.resilience import faults as faults_mod
+
+    plan = faults_mod.plan_for(test)
+    if plan is not None and not plan.targets_site(FAULT_SITE):
+        plan = None
+
     completions: "queue.Queue" = queue.Queue()
-    workers = {t: _ClientWorker(t, test, completions)
+    workers = {t: _ClientWorker(t, test, completions, plan=plan)
                for t in range(concurrency)}
     nemesis_worker = _NemesisWorker(test, completions)
     events: List[dict] = []

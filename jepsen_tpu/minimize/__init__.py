@@ -1,0 +1,47 @@
+"""Automated anomaly triage (ISSUE 4): shrink invalid runs to minimal
+failing witnesses.
+
+An invalid verdict over a 100k-op history is not actionable on its own;
+what a human debugs is the 6-op core of the cycle (the Elle insight:
+minimal witnesses are what make anomaly reports usable).  This package
+delta-debugs (ddmin, Zeller & Hildebrandt TSE '02) any stored run whose
+checker returned ``valid? false`` down to a minimal sub-history that
+STILL fails with the same anomaly class:
+
+- :mod:`~.reduce`  — the ddmin engine over closure-safe invoke/ok
+  units, with structure-aware phases (drop processes → project keys →
+  ddmin op ranges);
+- :mod:`~.probe`   — candidate re-checks through the original checker,
+  fanned out in parallel via the campaign scheduler (device probes
+  serialized through DeviceSlots), each under a per-probe Deadline;
+- :mod:`~.witness` — the persisted ``witness.jsonl`` + ``witness.json``
+  (explained cycle, stable digests; re-shrinking an unchanged run is a
+  cache hit);
+- :mod:`~.core`    — the :func:`shrink` orchestrator with per-round
+  telemetry spans.
+
+Surfaces: ``cli shrink <run-dir>``, the campaign spec key
+``"shrink": true`` (invalid cells get a witness column), and the web
+``/run/<rel>/witness`` page.  See ``docs/MINIMIZE.md``.
+"""
+
+from jepsen_tpu.minimize.core import shrink
+from jepsen_tpu.minimize.probe import ProbePool, resolve_checker
+from jepsen_tpu.minimize.reduce import (
+    Reducer,
+    Unit,
+    build_history,
+    units_of,
+)
+from jepsen_tpu.minimize.witness import (
+    history_digest,
+    load_witness,
+    save_witness,
+    witness_paths,
+)
+
+__all__ = [
+    "shrink", "ProbePool", "resolve_checker", "Reducer", "Unit",
+    "build_history", "units_of", "history_digest", "load_witness",
+    "save_witness", "witness_paths",
+]

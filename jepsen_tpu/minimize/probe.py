@@ -1,0 +1,238 @@
+"""Candidate re-checking — the shrinker's execution engine.
+
+Each ddmin candidate is a re-closed sub-history that must be run back
+through the SAME checker that judged the original run, under a
+per-probe :class:`resilience.Deadline` (a pathological candidate must
+cost at most ``probe_deadline_s``, never the whole shrink budget) and
+the `device_call` guard the checkers already wrap their device seams in
+(transient XLA flakes retry, persistent failures degrade to the host
+oracle — a degraded probe still yields a usable verdict).
+
+Fan-out reuses the campaign layer's machinery wholesale: candidates of
+one round become throwaway :class:`~jepsen_tpu.campaign.plan.RunSpec`\\s
+executed by :class:`~jepsen_tpu.campaign.scheduler.Scheduler` — device
+-pipeline probes (elle list-append / rw-register, knossos device WGL)
+serialize through its :class:`DeviceSlots` exactly like campaign cells
+(one jax runtime), while host-only probes fill all workers.  A probe
+that crashes out of its retries comes back as an attributable
+``valid? unknown`` record, which the shrinker conservatively treats as
+"does not reproduce" — a flaky probe can cost minimality, never
+soundness.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.campaign.plan import RunSpec
+from jepsen_tpu.campaign.scheduler import Scheduler
+from jepsen_tpu.checkers import api as checker_api
+from jepsen_tpu.history.ops import History
+
+from jepsen_tpu.minimize.reduce import Unit, build_history
+
+__all__ = ["resolve_checker", "is_device_checker", "host_equivalent",
+           "ProbePool"]
+
+#: checker name() values whose check() dispatches to the device
+#: pipelines — probes of these serialize through DeviceSlots
+DEVICE_CHECKER_NAMES = frozenset({
+    "list-append", "rw-register", "Linearizable", "QueueChecker",
+})
+
+
+def resolve_checker(test: Optional[dict], history: History
+                    ) -> checker_api.Checker:
+    """Rebuild a checker for a stored run.
+
+    Stored tests persist checker objects only as ``"§obj"``
+    placeholders, so re-checking needs a fresh instance.  A live
+    checker on the test map wins; otherwise the history's own shape
+    decides (the same dispatch the workloads encode): list-append txns
+    → the elle list-append pipeline, rw-register txns → rw-register,
+    read/write/cas registers → knossos linearizability."""
+    chk = (test or {}).get("checker")
+    if chk is not None and hasattr(chk, "check"):
+        return chk
+    for op in history:
+        if op.f == "txn" and isinstance(op.value, (list, tuple)):
+            for m in op.value:
+                if not (isinstance(m, (list, tuple)) and m):
+                    continue
+                if m[0] == "append":
+                    from jepsen_tpu.workloads.append import AppendChecker
+
+                    return AppendChecker()
+                if m[0] == "w":
+                    from jepsen_tpu.workloads.wr import WrChecker
+
+                    return WrChecker()
+        if op.f in ("read", "write", "cas") and op.is_client_op():
+            return checker_api.Linearizable()
+    raise ValueError(
+        "cannot infer a checker from this history's op shapes; "
+        "pass one explicitly (shrink(..., checker=...))")
+
+
+def is_device_checker(chk: checker_api.Checker) -> bool:
+    try:
+        return chk.name() in DEVICE_CHECKER_NAMES
+    except Exception:  # noqa: BLE001 — a broken name() is not a device
+        return False
+
+
+def host_equivalent(chk: checker_api.Checker
+                    ) -> Optional[checker_api.Checker]:
+    """A host-side twin for cheap probing, or None.
+
+    Shrink probes are many and SMALL — the opposite of the shape the
+    device pipeline is built for (one big history amortizing its jit
+    compiles).  For list-append the exact host oracle is the reference
+    the device path is differentially tested against, so probing
+    through it cannot change a verdict, only skip per-shape compile
+    cost; other checkers have no faster exact twin and probe as-is."""
+    if _name(chk) == "list-append":
+        from jepsen_tpu.checkers.elle import oracle
+
+        models = tuple(getattr(chk, "models", ("serializable",)))
+        anomalies = tuple(getattr(chk, "anomalies", ()))
+
+        def fn(test, history, opts):
+            return oracle.check(history, models, anomalies,
+                                deadline=(opts or {}).get("deadline"))
+
+        return checker_api.FnChecker(fn, "list-append-host")
+    return None
+
+
+class ProbePool:
+    """Runs batches of candidate sub-histories through the checker.
+
+    One pool per shrink: holds the scheduler configuration (workers,
+    device slots, per-probe deadline), the target-anomaly signature,
+    and the probe tallies (count, durations) the orchestrator turns
+    into per-round telemetry attrs.
+    """
+
+    def __init__(self, test: dict, chk: checker_api.Checker, *,
+                 target: Sequence[str] = (),
+                 probe_deadline_s: Optional[float] = None,
+                 workers: int = 2, device_slots: int = 1,
+                 device: Optional[bool] = None):
+        self.test = test
+        self.checker = chk
+        self.target = frozenset(target)
+        self.probe_deadline_s = probe_deadline_s
+        self.workers = max(1, int(workers))
+        self.device = is_device_checker(chk) if device is None \
+            else bool(device)
+        self.slots = max(1, int(device_slots))
+        self.n_probes = 0
+        self.durations_s: List[float] = []
+        self._seq = 0
+
+    # -- verdict interpretation ---------------------------------------------
+
+    def reproduces(self, result: Dict[str, Any]) -> bool:
+        """Does a probe result still show the target anomaly?  Invalid
+        AND (no target pinned, or anomaly classes overlap).  Unknowns
+        (deadline-expired, crashed probes) never count: the shrinker
+        may only keep a candidate it POSITIVELY re-confirmed, else the
+        witness could stop reproducing."""
+        if result.get("valid?") is not False:
+            return False
+        if not self.target:
+            return True
+        return bool(self.target & set(result.get("anomaly-types") or ()))
+
+    # -- probing ------------------------------------------------------------
+
+    def check_history(self, h: History, *,
+                      bounded: bool = True) -> Dict[str, Any]:
+        """One candidate through check_safe: the per-probe Deadline is
+        created by check_safe from opts["time-limit"]; the checkers'
+        own device_call guards pick it up from there.  `bounded=False`
+        skips the per-probe deadline — the baseline re-check of the
+        FULL history (which legitimately needs the original run's
+        budget) and the final confirm must not be refused by a budget
+        sized for small ddmin candidates."""
+        opts: Dict[str, Any] = {}
+        if bounded and self.probe_deadline_s is not None:
+            opts["time-limit"] = float(self.probe_deadline_s)
+        # probes must not re-render per-run artifacts into the store
+        # dir on every candidate (blank the store-dir the viz hooks key
+        # on), and must NOT replay the run's own fault plan: the
+        # anomaly lives in the HISTORY, and a chaos plan's shared call
+        # counter advanced by parallel probes would make verdicts
+        # scheduling-dependent.  A process-installed/env plan (the
+        # degradation-drill idiom) still applies.
+        t = {k: v for k, v in self.test.items()
+             if k not in ("store-dir", "faults", "faults-plan")}
+        return checker_api.check_safe(self.checker, t, h, opts)
+
+    def probe_batch(self, phase: str, candidates: List[List[Unit]]
+                    ) -> List[bool]:
+        """Probe every candidate of one round in parallel; returns the
+        reproduces-flags in candidate order (deterministic regardless
+        of scheduling).  `phase` is the reduction phase label (the
+        orchestrator wraps this in a per-round telemetry span)."""
+        if not candidates:
+            return []
+        base = self._seq + 1
+        self._seq += len(candidates)
+        specs = [RunSpec(run_id=f"probe-{base + i}", campaign="minimize",
+                         workload="probe", seed=0, device=self.device)
+                 for i in range(len(candidates))]
+        histories = [build_history(c) for c in candidates]
+        results: Dict[str, Dict[str, Any]] = {}
+
+        def execute(rs: RunSpec) -> Dict[str, Any]:
+            i = int(rs.run_id.rsplit("-", 1)[1]) - base
+            t0 = time.perf_counter()
+            res = self.check_history(histories[i])
+            dt = time.perf_counter() - t0
+            telemetry.registry().histogram(
+                "shrink-probe-duration-s",
+                buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+                checker=_name(self.checker)).observe(dt)
+            return {"run": rs.run_id, "valid?": res.get("valid?"),
+                    "result": res, "wall_s": dt}
+
+        sched = Scheduler(min(self.workers, len(specs)),
+                          device_slots=self.slots)
+        for rec in sched.run(specs, execute):
+            results[rec["run"]] = rec
+        out: List[bool] = []
+        for rs in specs:
+            rec = results.get(rs.run_id) or {}
+            self.n_probes += 1
+            if "wall_s" in rec:
+                self.durations_s.append(float(rec["wall_s"]))
+            out.append(self.reproduces(rec.get("result") or rec))
+        return out
+
+    # -- probe latency aggregates (telemetry attrs) -------------------------
+
+    def latency_quantiles(self) -> Dict[str, float]:
+        if not self.durations_s:
+            return {}
+        s = sorted(self.durations_s)
+        return {"probe_p50_s": quantile(s, 0.50),
+                "probe_p95_s": quantile(s, 0.95)}
+
+
+def quantile(sorted_vals: List[float], p: float) -> float:
+    """THE quantile rule for probe durations (index-based, like the
+    campaign index's nearest-rank) — shared by the per-round span
+    attrs and the persisted witness meta so the two never disagree."""
+    return round(sorted_vals[min(len(sorted_vals) - 1,
+                                 int(p * (len(sorted_vals) - 1)))], 4)
+
+
+def _name(chk: checker_api.Checker) -> str:
+    try:
+        return chk.name()
+    except Exception:  # noqa: BLE001
+        return type(chk).__name__

@@ -1,0 +1,292 @@
+"""Delta debugging over histories — the reduction engine.
+
+Classic ddmin (Zeller & Hildebrandt, "Simplifying and Isolating
+Failure-Inducing Input", TSE '02) specialized for Jepsen histories.
+The unit of reduction is never a single op: dropping an ``ok`` without
+its ``invoke`` would leave an orphaned completion, so the engine works
+over :class:`Unit`\\s — invoke/completion *pairs* (plus lone infos /
+unpaired tails as single-op units) — and every candidate sub-history is
+re-closed by construction (`build_history` keeps original op order and
+reindexes densely).
+
+Reduction runs in three structure-aware phases, cheapest first, exactly
+because a history has exploitable structure a flat byte-ddmin lacks:
+
+1. **processes** — drop every op of one process at a time (a whole
+   worker's timeline is the coarsest irrelevant chunk);
+2. **keys** — project keys away from transactional mop lists
+   (`elle`-style independence: an anomaly on keys {x, y} survives the
+   removal of every other key's mops);
+3. **ops** — classic ddmin over the remaining units (subsets, then
+   complements, doubling granularity), which ends 1-minimal: no single
+   remaining unit can be removed.
+
+Every phase asks the same question — "does this candidate still
+reproduce the anomaly?" — through a caller-supplied *batch* probe
+callback ``probe_batch(list[list[Unit]]) -> list[bool]``, so all
+candidates of one round fan out in parallel (the campaign scheduler is
+the execution engine, see :mod:`~.probe`) while the *choice* among
+failing candidates stays canonical-order deterministic: same history +
+same probe verdicts → same witness, regardless of probe completion
+order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from jepsen_tpu.history.ops import History, Op
+
+__all__ = ["Unit", "units_of", "build_history", "unit_keys",
+           "drop_key", "Reducer"]
+
+#: mop kinds whose middle element is a key (list-append + rw-register
+#: transactional values: ["append" k v] / ["w" k v] / ["r" k v-or-nil])
+_TXN_MOP_KINDS = ("append", "w", "r")
+
+ProbeBatch = Callable[[str, List[List["Unit"]]], List[bool]]
+
+
+@dataclass
+class Unit:
+    """One irreducible chunk of history: an invoke/completion pair, or
+    a single unpaired op.  `ops` holds the original Op objects in
+    original order; `order` is the first op's original index (the sort
+    key that keeps rebuilt histories in real-time order)."""
+
+    ops: Tuple[Op, ...]
+    order: int
+
+    @property
+    def process(self) -> Any:
+        return self.ops[0].process
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+
+def units_of(history: History) -> List[Unit]:
+    """Group a history into closure-safe units via the pair index."""
+    paired: Dict[int, int] = {}
+    for op in history:
+        j = history.pair_index(op.index) if 0 <= op.index < len(history) \
+            else -1
+        if j >= 0:
+            paired[op.index] = j
+    units: List[Unit] = []
+    seen = set()
+    for op in history:
+        if op.index in seen:
+            continue
+        j = paired.get(op.index, -1)
+        if j >= 0 and j > op.index:
+            seen.add(op.index)
+            seen.add(j)
+            units.append(Unit(ops=(op, history.get_index(j)),
+                              order=op.index))
+        elif j < 0:
+            seen.add(op.index)
+            units.append(Unit(ops=(op,), order=op.index))
+    units.sort(key=lambda u: u.order)
+    return units
+
+
+def build_history(units: Sequence[Unit]) -> History:
+    """Re-close a candidate: flatten units, restore original op order,
+    reindex densely.  Ops are copied so reduction never mutates the
+    source history."""
+    ops = [op for u in units for op in u.ops]
+    ops.sort(key=lambda op: op.index)
+    return History([op.with_() for op in ops], reindex=True)
+
+
+# -- key projection ---------------------------------------------------------
+
+def unit_keys(u: Unit) -> set:
+    """Keys touched by a unit's transactional mops (empty for non-txn
+    ops — those are untouched by the key phase)."""
+    out: set = set()
+    for op in u.ops:
+        if op.f == "txn" and isinstance(op.value, (list, tuple)):
+            for m in op.value:
+                if (isinstance(m, (list, tuple)) and len(m) >= 2
+                        and m[0] in _TXN_MOP_KINDS):
+                    out.add(m[1])
+    return out
+
+
+def drop_key(units: Sequence[Unit], key: Any) -> List[Unit]:
+    """Project one key away: filter its mops out of every txn value
+    (invoke and completion alike); units whose txns become empty are
+    dropped entirely.  Non-txn units pass through untouched."""
+    out: List[Unit] = []
+    for u in units:
+        if key not in unit_keys(u):
+            out.append(u)
+            continue
+        new_ops = []
+        empty = False
+        for op in u.ops:
+            if op.f == "txn" and isinstance(op.value, (list, tuple)):
+                mops = [list(m) for m in op.value
+                        if not (isinstance(m, (list, tuple)) and
+                                len(m) >= 2 and m[0] in _TXN_MOP_KINDS
+                                and m[1] == key)]
+                if not mops and op.type != "info":
+                    empty = True
+                new_ops.append(op.with_(value=mops))
+            else:
+                new_ops.append(op)
+        if not empty:
+            out.append(Unit(ops=tuple(new_ops), order=u.order))
+    return out
+
+
+# -- the reducer ------------------------------------------------------------
+
+@dataclass
+class RoundStats:
+    phase: str
+    candidates: int
+    ops_remaining: int
+    improved: bool
+
+
+@dataclass
+class Reducer:
+    """Drives the three phases against a batch probe.
+
+    `probe_batch` maps candidate unit lists to "still fails?" booleans;
+    `max_rounds` bounds the TOTAL number of probe rounds across phases
+    (None = run to 1-minimality); `on_round(RoundStats)` observes
+    progress (the telemetry hook)."""
+
+    probe_batch: ProbeBatch
+    max_rounds: Optional[int] = None
+    on_round: Optional[Callable[[RoundStats], None]] = None
+    rounds: int = 0
+    probes: int = 0
+    history: List[RoundStats] = field(default_factory=list)
+
+    def _budget_left(self) -> bool:
+        return self.max_rounds is None or self.rounds < self.max_rounds
+
+    def _probe(self, phase: str, candidates: List[List[Unit]]
+               ) -> List[bool]:
+        self.rounds += 1
+        self.probes += len(candidates)
+        return self.probe_batch(phase, candidates)
+
+    def _note(self, phase: str, n_cand: int, units: Sequence[Unit],
+              improved: bool) -> None:
+        st = RoundStats(phase=phase, candidates=n_cand,
+                        ops_remaining=sum(len(u) for u in units),
+                        improved=improved)
+        self.history.append(st)
+        if self.on_round is not None:
+            self.on_round(st)
+
+    # -- phase 1: processes -------------------------------------------------
+
+    def drop_processes(self, units: List[Unit]) -> List[Unit]:
+        """Greedy complement search over processes: each round probes
+        "units minus process p" for every remaining process in
+        parallel, then keeps the smallest failing complement (ties →
+        canonical process order).  Repeats until no process can go."""
+        while self._budget_left():
+            procs = sorted({u.process for u in units}, key=repr)
+            if len(procs) <= 1:
+                return units
+            cands = [[u for u in units if u.process != p] for p in procs]
+            keep = [(p, c) for (p, c) in zip(procs, cands) if c]
+            if not keep:
+                return units
+            res = self._probe("processes", [c for _, c in keep])
+            failing = [(len(c), i, c) for i, ((_, c), ok) in
+                       enumerate(zip(keep, res)) if ok]
+            if not failing:
+                self._note("processes", len(keep), units, False)
+                return units
+            failing.sort()
+            units = failing[0][2]
+            self._note("processes", len(keep), units, True)
+        return units
+
+    # -- phase 2: keys ------------------------------------------------------
+
+    def project_keys(self, units: List[Unit]) -> List[Unit]:
+        """Greedy key projection: probe "units with key k projected
+        away" for every key in parallel; keep the smallest failing
+        projection; repeat."""
+        while self._budget_left():
+            keys = sorted({k for u in units for k in unit_keys(u)},
+                          key=repr)
+            if len(keys) <= 1:
+                return units
+            cands = [(k, drop_key(units, k)) for k in keys]
+            keep = [(k, c) for (k, c) in cands if c and c != list(units)]
+            if not keep:
+                return units
+            res = self._probe("keys", [c for _, c in keep])
+            failing = [(sum(len(u) for u in c), i, c)
+                       for i, ((_, c), ok) in enumerate(zip(keep, res))
+                       if ok]
+            if not failing:
+                self._note("keys", len(keep), units, False)
+                return units
+            failing.sort(key=lambda t: (t[0], t[1]))
+            units = failing[0][2]
+            self._note("keys", len(keep), units, True)
+        return units
+
+    # -- phase 3: ddmin over op units ---------------------------------------
+
+    def ddmin(self, units: List[Unit]) -> List[Unit]:
+        """Classic ddmin: probe the n chunks, then the n complements,
+        all in one parallel batch per round; reduce to the FIRST
+        failing subset in canonical order; double granularity when
+        nothing fails.  Terminates 1-minimal (granularity == length and
+        no single-unit removal reproduces)."""
+        n = 2
+        while len(units) >= 2 and self._budget_left():
+            n = min(n, len(units))
+            chunks = _split(units, n)
+            cands: List[List[Unit]] = list(chunks)
+            kinds = [("subset", i) for i in range(len(chunks))]
+            if n > 2:
+                for i in range(len(chunks)):
+                    cands.append([u for j, c in enumerate(chunks)
+                                  if j != i for u in c])
+                    kinds.append(("complement", i))
+            res = self._probe("ops", cands)
+            hit = next((k for k, (kind, ok) in
+                        enumerate(zip(kinds, res)) if ok), None)
+            if hit is not None:
+                kind, _ = kinds[hit]
+                units = cands[hit]
+                n = 2 if kind == "subset" else max(n - 1, 2)
+                self._note("ops", len(cands), units, True)
+                continue
+            self._note("ops", len(cands), units, False)
+            if n >= len(units):
+                break
+            n = min(len(units), 2 * n)
+        return units
+
+    def run(self, units: List[Unit]) -> List[Unit]:
+        units = self.drop_processes(units)
+        units = self.project_keys(units)
+        return self.ddmin(units)
+
+
+def _split(xs: List[Unit], n: int) -> List[List[Unit]]:
+    """n near-equal contiguous chunks (ddmin's partition)."""
+    k, m = divmod(len(xs), n)
+    out, i = [], 0
+    for j in range(n):
+        size = k + (1 if j < m else 0)
+        if size:
+            out.append(xs[i:i + size])
+        i += size
+    return out

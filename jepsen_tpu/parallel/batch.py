@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jepsen_tpu import resilience
+from jepsen_tpu import resilience, telemetry
 from jepsen_tpu.checkers.elle.device_core import core_check
 from jepsen_tpu.checkers.elle.device_infer import PaddedLA, pad_packed
 from jepsen_tpu.history.soa import PackedTxns
@@ -113,33 +113,52 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
         # padding rows are dropped by summarize_batch_bits (the same
         # pre-stack fill check_batch_hybrid and _checkpointed use)
         ps = list(ps) + [ps[0]] * ((-n_real) % mesh.devices.size)
-    batch = pad_batch(ps, caps)
-    n_keys = batch.n_keys
+    # one child span per sharded dispatch (ROADMAP telemetry open item:
+    # the parallel/ paths were span-invisible, so shrink probes and
+    # campaign cells over them were unattributable); bytes staged is
+    # what the mesh actually holds resident during the check
+    with telemetry.span("parallel.batch", histories=n_real,
+                        shards=(mesh.devices.size if mesh is not None
+                                else 0)) as sp:
+        batch = pad_batch(ps, caps)
+        n_keys = batch.n_keys
+        _stage_bytes(sp, batch)
 
-    if mesh is None:
-        bits, over = resilience.device_call(
-            "parallel.batch", _batched_core, batch, n_keys,
-            deadline=deadline, plan=plan, policy=policy)
-    else:
-        spec = P(axis)
-        in_shard = NamedSharding(mesh, spec)
+        if mesh is None:
+            bits, over = resilience.device_call(
+                "parallel.batch", _batched_core, batch, n_keys,
+                deadline=deadline, plan=plan, policy=policy)
+        else:
+            spec = P(axis)
+            in_shard = NamedSharding(mesh, spec)
 
-        def put(x):
-            return jax.device_put(x, in_shard)
+            def put(x):
+                return jax.device_put(x, in_shard)
 
-        batch = jax.tree_util.tree_map(put, batch)
+            batch = jax.tree_util.tree_map(put, batch)
 
-        @partial(shard_map, mesh=mesh, in_specs=(spec,),
-                 out_specs=(spec, spec))
-        def sharded(b):
-            bits, over = jax.vmap(lambda h: core_check(h, n_keys))(b)
-            return bits, over
+            @partial(shard_map, mesh=mesh, in_specs=(spec,),
+                     out_specs=(spec, spec))
+            def sharded(b):
+                bits, over = jax.vmap(lambda h: core_check(h, n_keys))(b)
+                return bits, over
 
-        bits, over = resilience.device_call(
-            "parallel.batch", sharded, batch,
-            deadline=deadline, plan=plan, policy=policy)
+            bits, over = resilience.device_call(
+                "parallel.batch", sharded, batch,
+                deadline=deadline, plan=plan, policy=policy)
 
-    return summarize_batch_bits(bits, over, batch, n_keys, n_real)
+        return summarize_batch_bits(bits, over, batch, n_keys, n_real)
+
+
+def _stage_bytes(sp, tree) -> None:
+    """Attach the staged-array byte total to a dispatch span + the
+    device-bytes-staged counter (no-op when telemetry is off)."""
+    if not telemetry.enabled():
+        return
+    n = sum(int(getattr(x, "nbytes", 0))
+            for x in jax.tree_util.tree_leaves(tree))
+    sp.set_attr(bytes_staged=n)
+    telemetry.registry().counter("device-bytes-staged").inc(n)
 
 
 def summarize_batch_bits(bits, over, batch, n_keys: int, n_real: int,

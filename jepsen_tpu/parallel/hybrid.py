@@ -100,6 +100,9 @@ def check_batch_hybrid(ps: Sequence[PackedTxns], mesh: Mesh,
     guarded fault-plan site (``parallel.hybrid``) like the other
     sharded seams.
     """
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.parallel.batch import _stage_bytes
+
     n_dcn = mesh.shape["dcn"]
     n_k = mesh.shape["k"]
     if max_k % n_k:
@@ -108,13 +111,17 @@ def check_batch_hybrid(ps: Sequence[PackedTxns], mesh: Mesh,
     caps = batch_caps(ps)
     n_real = len(ps)
     fill = (-n_real) % n_dcn
-    batch = pad_batch(list(ps) + [ps[0]] * fill, caps)
-    batch = jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, P("dcn"))), batch)
+    with telemetry.span("parallel.hybrid", histories=n_real,
+                        dcn=n_dcn, k=n_k, max_k=max_k) as sp:
+        batch = pad_batch(list(ps) + [ps[0]] * fill, caps)
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P("dcn"))),
+            batch)
+        _stage_bytes(sp, batch)
 
-    bits, over = resilience.device_call(
-        "parallel.hybrid", _hybrid_core, batch, batch.n_keys, mesh,
-        max_k=max_k, max_rounds=max_rounds,
-        deadline=deadline, plan=plan, policy=policy)
-    return summarize_batch_bits(bits, over, batch, batch.n_keys, n_real,
-                                k_floor=max_k)
+        bits, over = resilience.device_call(
+            "parallel.hybrid", _hybrid_core, batch, batch.n_keys, mesh,
+            max_k=max_k, max_rounds=max_rounds,
+            deadline=deadline, plan=plan, policy=policy)
+        return summarize_batch_bits(bits, over, batch, batch.n_keys,
+                                    n_real, k_floor=max_k)

@@ -174,22 +174,30 @@ def check_sharded(p: PackedTxns | PaddedLA, mesh: Optional[Mesh] = None,
     `DeadlineExceeded`); the sharded dispatch itself is a guarded
     fault-plan site (``parallel.op-shard``), so JEPSEN_FAULTS chaos
     reaches the K-axis sharded sweep too."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.parallel.batch import _stage_bytes
+
     if mesh is None:
         mesh = Mesh(np.array(jax.devices()), (axis,))
     h = p if isinstance(p, PaddedLA) else pad_packed(p)
     n_keys = h.n_keys
-    h, infer_sharded = shard_padded(h, mesh, axis)
     n_shards = mesh.shape[axis]
-    if max_k % n_shards:
-        # non-power-of-two meshes: round the budget up to a mesh multiple
-        max_k = ((max_k // n_shards) + 1) * n_shards
+    with telemetry.span("parallel.op-shard", shards=n_shards,
+                        max_k=max_k) as sp:
+        h, infer_sharded = shard_padded(h, mesh, axis)
+        _stage_bytes(sp, h)
+        sp.set_attr(inference_sharded=infer_sharded)
+        if max_k % n_shards:
+            # non-power-of-two meshes: round the budget up to a mesh
+            # multiple
+            max_k = ((max_k // n_shards) + 1) * n_shards
 
-    bits, over = grow_until_exact(
-        lambda k, r: _core_check_sharded(h, n_keys, mesh, axis,
-                                         max_k=k, max_rounds=r),
-        max_k, max_rounds, round_to=n_shards, deadline=deadline,
-        site="parallel.op-shard", plan=plan, policy=policy)
-    over_i = int(np.asarray(over))
+        bits, over = grow_until_exact(
+            lambda k, r: _core_check_sharded(h, n_keys, mesh, axis,
+                                             max_k=k, max_rounds=r),
+            max_k, max_rounds, round_to=n_shards, deadline=deadline,
+            site="parallel.op-shard", plan=plan, policy=policy)
+        over_i = int(np.asarray(over))
 
     row = np.asarray(bits)
     counts = {n: int(row[j]) for j, n in enumerate(COUNT_NAMES)}

@@ -149,10 +149,15 @@ class FaultPlan:
 
     # -- decision ----------------------------------------------------------
 
-    def _decide(self, index: int, site: str) -> Optional[str]:
+    def _decide(self, index: int, site: str,
+                salt: int = 0) -> Optional[str]:
         """The pure decision function: which fault (if any) fires at
         this (index, site)?  Hash-seeded per call index so decisions
-        are order-independent across sites with the same counter."""
+        are order-independent across sites with the same counter.
+        `salt` separates index SPACES: caller-indexed streams
+        (:meth:`fire_at`) draw from a different probabilistic sequence
+        than the global counter, so e.g. interpreter worker 0's op k
+        does not fault in lockstep with device call k."""
         if self.sites is not None and site not in self.sites:
             return None
         if self.persistent is True or \
@@ -162,7 +167,7 @@ class FaultPlan:
             return self.at[index]
         if self.p > 0.0 and self.kinds:
             import random
-            rng = random.Random((self.seed << 20) ^ index)
+            rng = random.Random((self.seed << 20) ^ index ^ salt)
             if rng.random() < self.p:
                 return self.kinds[rng.randrange(len(self.kinds))]
         return None
@@ -176,13 +181,54 @@ class FaultPlan:
         with self._lock:
             index = self._n_calls
             self._n_calls += 1
+        self._fire_decided(site, index, salt=0)
+
+    #: rng-stream salt for caller-indexed decisions (fire_at): keeps
+    #: the interpreter's per-worker streams independent of the global
+    #: device-call counter's stream for the same seed
+    _CALLER_SPACE_SALT = 0x5EED5A17
+
+    def fire_at(self, site: str, index: int) -> None:
+        """Like :meth:`fire`, but the decision index is supplied by the
+        caller instead of the plan's global counter — the per-worker
+        idiom (ISSUE 4 satellite): each interpreter worker derives its
+        own index stream from (thread id, local op count), so
+        injections are seeded-deterministic regardless of thread
+        interleaving.  ``max_faults`` and the injection log stay
+        plan-wide (lock-shared).  Probabilistic decisions draw from a
+        salted stream so they don't correlate with the global
+        counter's; explicit ``at`` indices are interpreted in the
+        CALLER's index space — a plan mixing ``at`` with both guard
+        and interpreter sites should use ``sites`` filters to
+        disambiguate."""
+        self._fire_decided(site, index, salt=self._CALLER_SPACE_SALT)
+
+    def _fire_decided(self, site: str, index: int, salt: int) -> None:
+        """Shared decide-log-execute tail of fire/fire_at: the
+        max_faults gate and the injection-log append stay atomic under
+        the plan lock; the execution (raise / stall) happens outside
+        it."""
+        with self._lock:
             if self.max_faults is not None and \
                     len(self.injected) >= self.max_faults:
                 return
-            kind = self._decide(index, site)
+            kind = self._decide(index, site, salt=salt)
             if kind is None:
                 return
             self.injected.append((index, site, kind))
+        self._execute(kind, site, index)
+
+    def targets_site(self, site: str) -> bool:
+        """Does this plan EXPLICITLY name `site`?  Sites outside the
+        device-call guard (the interpreter's client-side chaos seam)
+        are strictly opt-in: a bare ``p=0.2`` checker-chaos plan must
+        not silently start crashing client ops."""
+        if self.sites is not None and site in self.sites:
+            return True
+        return isinstance(self.persistent, frozenset) and \
+            site in self.persistent
+
+    def _execute(self, kind: str, site: str, index: int) -> None:
         from jepsen_tpu import telemetry
 
         telemetry.registry().counter("resilience-faults-injected",

@@ -27,7 +27,7 @@ from . import metrics as _metrics
 from .spans import Collector, Span
 
 __all__ = ["span_to_dict", "snapshot", "chrome_trace", "write_run",
-           "summarize"]
+           "summarize", "histogram_quantiles"]
 
 TELEMETRY_FILE = "telemetry.json"
 TRACE_FILE = "trace.json"
@@ -141,6 +141,40 @@ def write_run(dirpath: str, collector: Collector,
     return {"telemetry": tel_path, "trace": trace_path}
 
 
+def histogram_quantiles(bounds: List[Any], counts: List[int],
+                        qs: List[float] = (0.50, 0.95, 0.99)
+                        ) -> Dict[str, float]:
+    """Quantile estimates from fixed-bucket counts — the
+    histogram_quantile rule: find the bucket holding the target rank,
+    linear-interpolate within its [lower, upper) bounds.  `bounds` is
+    the snapshot's ``buckets`` list (finite upper bounds, possibly with
+    a trailing ``"+inf"``); a rank landing in the +inf bucket clamps to
+    the largest finite bound (no upper edge to interpolate toward).
+    Returns {"p50": ..., "p95": ..., "p99": ...} (empty when count 0)."""
+    finite = [float(b) for b in bounds if isinstance(b, (int, float))]
+    total = sum(counts)
+    if not total or not finite:
+        return {}
+    out: Dict[str, float] = {}
+    for q in qs:
+        rank = q * total
+        cum = 0.0
+        val = finite[-1]
+        for i, c in enumerate(counts):
+            prev = cum
+            cum += c
+            if cum >= rank and c:
+                lo = finite[i - 1] if 0 < i <= len(finite) else 0.0
+                if i < len(finite):
+                    hi = finite[i]
+                    val = lo + (hi - lo) * (rank - prev) / c
+                else:  # +inf bucket: clamp to the last finite bound
+                    val = finite[-1]
+                break
+        out[f"p{int(q * 100)}"] = round(val, 6)
+    return out
+
+
 # -- summaries (cli `trace` command) ---------------------------------------
 
 def _fmt_dur(ns: Optional[float]) -> str:
@@ -166,12 +200,16 @@ def _render_span(sp: Dict[str, Any], depth: int, lines: List[str],
             _render_span(c, depth + 1, lines, max_depth)
 
 
-def summarize(dirpath: str, max_depth: int = 6) -> str:
+def summarize(dirpath: str, max_depth: int = 6,
+              doc: Optional[Dict[str, Any]] = None) -> str:
     """Human summary of a stored run's telemetry.json: the span tree
-    with durations, then non-zero counters and gauges."""
-    path = os.path.join(dirpath, TELEMETRY_FILE)
-    with open(path) as f:
-        doc = json.load(f)
+    with durations, then non-zero counters and gauges.  Pass an
+    already-parsed `doc` to skip the file read (the web handler loads
+    the json once for both its percentile table and this summary)."""
+    if doc is None:
+        path = os.path.join(dirpath, TELEMETRY_FILE)
+        with open(path) as f:
+            doc = json.load(f)
     lines: List[str] = [f"telemetry for {dirpath}", ""]
     for root in doc.get("spans", []):
         _render_span(root, 0, lines, max_depth)
@@ -195,6 +233,10 @@ def summarize(dirpath: str, max_depth: int = 6) -> str:
         if h.get("count"):
             lbl = ",".join(f"{k}={v}" for k, v in
                            sorted(h["labels"].items()))
+            quant = histogram_quantiles(h.get("buckets") or [],
+                                        h.get("counts") or [])
+            qs = " ".join(f"{k}={v:.4g}" for k, v in quant.items())
             lines.append(f"  {h['name']}{{{lbl}}} count={h['count']} "
-                         f"sum={h['sum']:.6g}")
+                         f"sum={h['sum']:.6g}"
+                         + (f" {qs}" if qs else ""))
     return "\n".join(lines)

@@ -63,6 +63,8 @@ _BADGE_CSS = """
 .b-deadline { background: #ffb347; border: 1px solid #c07a2d; }
 .b-degraded { background: #a8c8f0; border: 1px solid #5a82b4;
               font-size: 85%; margin-left: 4px; }
+.b-witness { background: #e6d5f5; border: 1px solid #9a6fc0;
+             font-size: 85%; margin-left: 4px; }
 .b-other { background: #ddd; }
 """
 
@@ -135,7 +137,11 @@ class _Handler(BaseHTTPRequestHandler):
             if path.startswith("/telemetry/"):
                 return self._telemetry(path[len("/telemetry/"):])
             if path.startswith("/run/"):
-                return self._run(path[len("/run/"):])
+                rel = path[len("/run/"):]
+                if rel.rstrip("/").endswith("/witness"):
+                    return self._witness(
+                        rel.rstrip("/")[:-len("/witness")])
+                return self._run(rel)
             if path in ("/campaigns", "/campaigns/"):
                 return self._campaigns()
             if path.startswith("/campaign/"):
@@ -197,6 +203,9 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
         tel = (f'&middot; <a href="/telemetry/{quote(rel)}">telemetry</a> '
                if os.path.exists(os.path.join(p, "telemetry.json"))
                else "")
+        wit = (f'&middot; <a href="/run/{quote(rel)}/witness">witness</a> '
+               if os.path.exists(os.path.join(p, "witness.json"))
+               else "")
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
 <title>{html.escape(rel)}</title><style>
 body {{ font-family: sans-serif; margin: 2em; }}
@@ -205,10 +214,79 @@ pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}
 <p><a href="/">&larr; runs</a></p>
 <h2>{html.escape(s["name"])} <small>{html.escape(s["timestamp"])}</small>
 {_verdict_badges(s["valid?"], s["error"], s["degraded"], s["deadline"])}</h2>
-<p><a href="/files/{quote(rel)}/">files</a> {tel}&middot;
+<p><a href="/files/{quote(rel)}/">files</a> {tel}{wit}&middot;
 <a href="/zip/{quote(rel)}">zip</a></p>
 <pre>{html.escape(results or "no results.json (run still in flight, "
                              "or it crashed before analysis)")}</pre>
+</body></html>"""
+        self._send(200, doc.encode())
+
+    def _witness(self, rel: str):
+        """Minimal-witness page (docs/MINIMIZE.md): the shrunk failing
+        history op by op, then each surviving anomaly's explained cycle
+        — every edge rendered with the Explainer's evidence (key,
+        values, the "why" sentence)."""
+        from .minimize import load_witness
+
+        rel = rel.rstrip("/")
+        p = self._safe_path(rel)
+        if p is None or not os.path.isdir(p):
+            return self._send(404, b"no such run", "text/plain")
+        w = load_witness(p)
+        if w is None:
+            return self._send(404, b"no witness for this run (run "
+                              b"`cli shrink <dir>` first)", "text/plain")
+        op_rows = []
+        for op in w["history"]:
+            err = op.error if op.error is not None else ""
+            op_rows.append(
+                f"<tr><td>{op.index}</td><td>{html.escape(str(op.process))}"
+                f"</td><td>{html.escape(str(op.type))}</td>"
+                f"<td>{html.escape(str(op.f))}</td>"
+                f"<td><code>{html.escape(json.dumps(op.value))}</code></td>"
+                f"<td>{html.escape(str(err))}</td></tr>")
+        anom_html = []
+        for name, entries in sorted((w.get("anomalies") or {}).items()):
+            anom_html.append(f"<h3><code>{html.escape(name)}</code></h3>")
+            for e in entries if isinstance(entries, list) else []:
+                cyc = e.get("cycle") if isinstance(e, dict) else None
+                if not cyc:
+                    anom_html.append(
+                        f"<pre>{html.escape(json.dumps(e, indent=1))}"
+                        "</pre>")
+                    continue
+                steps = []
+                for edge in cyc:
+                    why = edge.get("why") or json.dumps(
+                        {k: v for k, v in edge.items() if k != "rel"})
+                    steps.append(
+                        f"<li><b>{html.escape(str(edge.get('rel')))}"
+                        f"</b> — {html.escape(str(why))}</li>")
+                anom_html.append(f"<ol>{''.join(steps)}</ol>")
+        quant = " ".join(
+            f"{k.replace('_', ' ')}={w[k]}" for k in
+            ("probe_p50_s", "probe_p95_s") if w.get(k) is not None)
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>witness — {html.escape(rel)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 3px 8px; }}
+pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/run/{quote(rel)}">&larr; run</a></p>
+<h1>minimal witness
+{_verdict_badges(w.get("valid?"))}</h1>
+<p>{w.get("ops")} ops (shrunk from {w.get("source-ops")}) &middot;
+anomalies: <code>{html.escape(", ".join(w.get("anomaly-types") or ()))}
+</code> &middot; checker {html.escape(str(w.get("checker")))} &middot;
+{w.get("rounds")} rounds / {w.get("probes")} probes {html.escape(quant)}
+&middot; digest <code>{html.escape(str(w.get("digest")))}</code></p>
+<table><tr><th>#</th><th>process</th><th>type</th><th>f</th>
+<th>value</th><th>error</th></tr>{"".join(op_rows)}</table>
+<h2>explained cycle</h2>
+{"".join(anom_html) or "<p>(no cycle edges reported)</p>"}
+<p><a href="/files/{quote(rel)}/witness.json">witness.json</a> &middot;
+<a href="/files/{quote(rel)}/witness.jsonl">witness.jsonl</a></p>
 </body></html>"""
         self._send(200, doc.encode())
 
@@ -288,6 +366,14 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
                 if r.get("dir"):
                     badge = (f'<a href="/run/{quote(str(r["dir"]))}">'
                              f"{badge}</a>")
+                w = r.get("witness")
+                if isinstance(w, dict) and w.get("ops") and r.get("dir"):
+                    # the auto-shrink witness column: invalid cells
+                    # link straight to their minimal witness
+                    badge += (f' <a class="b b-witness" title="minimal '
+                              f'witness ({w["ops"]} ops)" '
+                              f'href="/run/{quote(str(r["dir"]))}/witness">'
+                              f'w:{w["ops"]}</a>')
                 tds.append(f'<td style="text-align:center">{badge}</td>')
             rows.append(f"<tr><td>{html.escape(wl)}</td>"
                         f"<td>{html.escape(fl)}</td>{''.join(tds)}</tr>")
@@ -335,21 +421,54 @@ a {{ text-decoration: none; }}
             return self._send(404, b"no telemetry for this run",
                               "text/plain")
         from .telemetry import export as tel_export
+        doc_j = None
         try:
-            summary = tel_export.summarize(p)
+            with open(os.path.join(p, "telemetry.json")) as f:
+                doc_j = json.load(f)
+            summary = tel_export.summarize(p, doc=doc_j)
         except Exception as e:  # noqa: BLE001 — corrupt file still 200s
             summary = f"telemetry.json unreadable: {e}"
+        # latency percentiles from the fixed-bucket histograms
+        # (ROADMAP telemetry open item: p50/p95/p99, not bucket dumps)
+        hist_rows = []
+        try:
+            for h in ((doc_j or {}).get("metrics") or {}).get(
+                    "histograms", []):
+                if not h.get("count"):
+                    continue
+                quant = tel_export.histogram_quantiles(
+                    h.get("buckets") or [], h.get("counts") or [])
+                lbl = ",".join(f"{k}={v}" for k, v in
+                               sorted((h.get("labels") or {}).items()))
+                hist_rows.append(
+                    f"<tr><td><code>{html.escape(h['name'])}"
+                    f"{{{html.escape(lbl)}}}</code></td>"
+                    f"<td>{h['count']}</td><td>{h['sum']:.6g}</td>"
+                    + "".join(f"<td>{quant.get(k, '')}</td>"
+                              for k in ("p50", "p95", "p99"))
+                    + "</tr>")
+        except Exception:  # noqa: BLE001 — percentiles are best-effort
+            hist_rows = []
+        hist_html = ""
+        if hist_rows:
+            hist_html = (
+                "<h2>latency percentiles</h2><table>"
+                "<tr><th>histogram</th><th>n</th><th>sum</th>"
+                "<th>p50</th><th>p95</th><th>p99</th></tr>"
+                + "".join(hist_rows) + "</table>")
         rel = rel.rstrip("/")
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
 <title>telemetry — {html.escape(rel)}</title>
 <style>body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 3px 8px; }}
 pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}</style>
 </head><body>
 <p><a href="/">&larr; runs</a> &middot;
 <a href="/files/{quote(rel)}/telemetry.json">telemetry.json</a> &middot;
 <a href="/files/{quote(rel)}/trace.json">trace.json</a>
 (open in <a href="https://ui.perfetto.dev">ui.perfetto.dev</a>)</p>
-<pre>{html.escape(summary)}</pre></body></html>"""
+{hist_html}<pre>{html.escape(summary)}</pre></body></html>"""
         self._send(200, doc.encode())
 
     def _files(self, rel: str):

@@ -62,6 +62,12 @@ class WrChecker(checker_api.Checker):
             viz.viz_for_test(res, test, history)
         return res
 
+    def name(self):
+        # the canonical checker name (like AppendChecker's
+        # "list-append"): span labels, error attribution, and the
+        # shrink probe pool's device classification all key on it
+        return "rw-register"
+
 
 def workload(*, key_count: int = 8, min_txn_length: int = 1,
              max_txn_length: int = 4,

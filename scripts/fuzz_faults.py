@@ -113,6 +113,50 @@ def run_one(seed: int, p: float, deadline_s: float) -> dict:
     except FaultInjected:
         row["exhausted"] = row.get("exhausted", 0) + 1
     row["injected"] += len(plan_b.injected)
+
+    # --- interpreter client-side chaos (ISSUE 4 satellite) -------------
+    # the "interpreter" fault site must be strictly opt-in (named in
+    # sites), inject stalls (latency) and info outcomes (crash kinds)
+    # without ever losing the history, and fire deterministically per
+    # (seed, worker op stream) — pinned with a single-worker run pair
+    from jepsen_tpu import core as jcore
+    from jepsen_tpu.generator import core as g
+    from jepsen_tpu.generator import interpreter
+    from jepsen_tpu.workloads.mem import MemClient
+
+    import random as _random
+
+    def interp_run(concurrency: int):
+        plan_i = FaultPlan(seed=seed + 4, p=0.3,
+                           kinds=("stall", "oom"), stall_s=0.001,
+                           sites=("interpreter",))
+        test = jcore.noop_test(
+            name="interp-chaos", concurrency=concurrency,
+            client=MemClient(),
+            generator=g.clients(g.limit(
+                30, synth.la_generator(
+                    n_keys=3, rng=_random.Random(seed + 4)))),
+            faults=plan_i)
+        return plan_i, interpreter.run(test)
+
+    p1, h1 = interp_run(1)
+    p2, h2 = interp_run(1)
+    assert p1.injected == p2.injected, \
+        "interpreter injections not deterministic (single worker)"
+
+    def shape(h):  # op times are wall-clock; compare everything else
+        return [(op.type, op.process, op.f, op.value, op.error)
+                for op in h]
+
+    assert shape(h1) == shape(h2), \
+        "interpreter chaos history not deterministic (single worker)"
+    p3, h3 = interp_run(3)
+    assert len(h3) > 0, "interpreter chaos lost the whole history"
+    crashed = [op for op in h3
+               if op.type == "info"
+               and str(op.error or "").startswith("fault-injected")]
+    row["injected"] += len(p1.injected) + len(p3.injected)
+    row["client-infos"] = row.get("client-infos", 0) + len(crashed)
     return row
 
 

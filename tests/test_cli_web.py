@@ -193,6 +193,94 @@ def test_drain_survives_transient_fails():
     assert d3.done
 
 
+def test_cli_shrink_smoke(tmp_path, capsys):
+    """`cli shrink <dir>` (ISSUE 4): shrink a stored invalid run to a
+    minimal witness, then serve its /run/<rel>/witness page."""
+    from jepsen_tpu.checkers.elle import oracle
+    from jepsen_tpu.workloads import synth
+
+    base = str(tmp_path / "s")
+    h = synth.la_history(n_txns=60, n_keys=5, concurrency=4, seed=7)
+    assert synth.inject_wr_cycle(h)
+    t = core.noop_test(name="shrink-smoke")
+    t["store-dir"] = base
+    t["history"] = h
+    store.save_0(t)
+    t["results"] = oracle.check(h, ["serializable"])
+    store.save_1(t)
+    d = store.test_dir(t)
+
+    rc = cli.run(cli.single_test_cmd(_test_fn),
+                 ["shrink", d, "--host-oracle", "--anomaly", "G1c"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "witness:" in out and "G1c" in out
+    assert os.path.exists(os.path.join(d, "witness.json"))
+    assert os.path.exists(os.path.join(d, "witness.jsonl"))
+    # cached second run reports [cached]
+    rc = cli.run(cli.single_test_cmd(_test_fn),
+                 ["shrink", d, "--host-oracle", "--anomaly", "G1c"])
+    assert rc == 0
+    assert "[cached]" in capsys.readouterr().out
+
+    srv = web.serve(port=0, base=base, background=True)
+    try:
+        port = srv.server_address[1]
+        rel = os.path.relpath(d, base)
+        status, _, body = _get(port, f"/run/{rel}/witness")
+        assert status == 200
+        assert b"minimal witness" in body and b"G1c" in body
+        # the run page links to it
+        status, _, body = _get(port, f"/run/{rel}")
+        assert status == 200 and b"/witness" in body
+        # a run without a witness 404s cleanly
+        import urllib.error
+        try:
+            status, _, _ = _get(port, "/run/nope/witness")
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 404
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_web_telemetry_percentile_table(tmp_path):
+    """The per-run telemetry page renders p50/p95/p99 computed from
+    the fixed-bucket histograms (ROADMAP telemetry open item) instead
+    of raw bucket dumps."""
+    import json as _json
+
+    from jepsen_tpu import telemetry
+
+    base = str(tmp_path / "s")
+    coll = telemetry.activate()
+    coll.registry.histogram("demo-latency-s",
+                            buckets=(0.01, 0.1, 1.0)).observe(0.05)
+    for v in (0.02, 0.03, 0.5, 2.0):
+        coll.registry.histogram("demo-latency-s",
+                                buckets=(0.01, 0.1, 1.0)).observe(v)
+    t = core.run(_test_fn({"store-dir": base}))
+    d = store.test_dir(t)
+    telemetry.deactivate(coll)
+    telemetry.write_run(d, coll)
+    status_doc = _json.load(open(os.path.join(d, "telemetry.json")))
+    assert status_doc["metrics"]["histograms"]
+
+    srv = web.serve(port=0, base=base, background=True)
+    try:
+        port = srv.server_address[1]
+        rel = os.path.relpath(d, base)
+        status, _, body = _get(port, f"/telemetry/{rel}")
+        assert status == 200
+        assert b"latency percentiles" in body
+        assert b"demo-latency-s" in body
+        assert b"p50" in body and b"p99" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_cli_demo_causal(tmp_path, capsys):
     from jepsen_tpu.__main__ import DEMOS
     rc = cli.run(cli.test_all_cmd(DEMOS),

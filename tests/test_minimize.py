@@ -1,0 +1,444 @@
+"""minimize/ — the ddmin shrinker + minimal-witness store (ISSUE 4).
+
+Covers: closure invariants (no orphan invoke/ok in any rebuilt
+candidate), ddmin determinism (same seed + history → identical
+witness), verdict preservation (the witness still fails with the same
+anomaly class — including under forced host-fallback degradation),
+instant no-op re-shrink via the source digest, the campaign auto-shrink
+hook, and the golden minimal witness for a seeded G1c history
+(tests/data/witness-g1c-golden.json).
+"""
+
+import json
+import os
+
+import pytest
+
+from jepsen_tpu import core as jcore
+from jepsen_tpu import minimize, store
+from jepsen_tpu.checkers.elle import oracle
+from jepsen_tpu.history.ops import History, INVOKE
+from jepsen_tpu.minimize import reduce as reduce_mod
+from jepsen_tpu.workloads import synth
+from jepsen_tpu.workloads.append import AppendChecker
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data",
+                      "witness-g1c-golden.json")
+
+
+def g1c_history(n_txns=250, seed=11):
+    """The seeded 500+-op invalid list-append history of the ISSUE's
+    acceptance criterion: strict-serializable sim + injected wr cycle."""
+    h = synth.la_history(n_txns=n_txns, n_keys=6, concurrency=5,
+                         seed=seed)
+    assert synth.inject_wr_cycle(h)
+    return h
+
+
+def save_run(tmp_path, h, name="inv"):
+    """Persist a history as a stored run with its (invalid) results."""
+    base = str(tmp_path / "s")
+    test = jcore.noop_test(name=name)
+    test["store-dir"] = base
+    test["history"] = h
+    store.save_0(test)
+    test["results"] = oracle.check(h, ["serializable"])
+    store.save_1(test)
+    return base, store.test_dir(test)
+
+
+# ---------------------------------------------------------------- units
+
+def test_units_pair_invoke_with_completion():
+    h = g1c_history(n_txns=30, seed=3)
+    units = reduce_mod.units_of(h)
+    # every 2-op unit is (invoke, completion) of one process
+    for u in units:
+        if len(u) == 2:
+            assert u.ops[0].type == INVOKE
+            assert u.ops[1].type != INVOKE
+            assert u.ops[0].process == u.ops[1].process
+    assert sum(len(u) for u in units) == len(h)
+
+
+def test_closure_no_orphans_on_any_subset():
+    h = g1c_history(n_txns=30, seed=4)
+    units = reduce_mod.units_of(h)
+    # arbitrary subsets re-close: every completion's invocation is
+    # present (History._build_pair_index would also raise on a double
+    # invoke, so constructing it is itself part of the assertion)
+    for lo, hi in ((0, 7), (3, 11), (5, len(units))):
+        sub = reduce_mod.build_history(units[lo:hi])
+        for op in sub:
+            if op.is_client_op() and not op.is_invoke():
+                if op.is_info():
+                    continue  # infos may be legitimately unpaired
+                inv = sub.invocation(op)
+                assert inv is not None, f"orphan completion {op}"
+                assert inv.process == op.process
+        # dense reindex
+        assert [op.index for op in sub] == list(range(len(sub)))
+
+
+def test_drop_key_projects_mops_and_drops_empty():
+    h = g1c_history(n_txns=30, seed=5)
+    units = reduce_mod.units_of(h)
+    keys = {k for u in units for k in reduce_mod.unit_keys(u)}
+    k = sorted(keys)[0]
+    out = reduce_mod.drop_key(units, k)
+    for u in out:
+        assert k not in reduce_mod.unit_keys(u)
+
+
+# ---------------------------------------------------------------- shrink
+
+def test_shrink_verdict_preserved_and_minimal(tmp_path):
+    h = g1c_history(n_txns=60, seed=7)
+    base, d = save_run(tmp_path, h)
+    s = minimize.shrink(d, host_oracle=True, anomalies="G1c")
+    assert s["valid?"] is False
+    assert "G1c" in s["anomaly-types"]
+    assert s["ops"] <= 12
+    assert s["source-ops"] == len(h)
+    # the confirm pass ran the device pipeline: the persisted cycle
+    # carries the Explainer's evidence on every dependency edge
+    w = json.load(open(s["paths"]["meta"]))
+    assert w["checker"] == "list-append"
+    cyc = w["anomalies"]["G1c"][0]["cycle"]
+    assert all(e.get("why") for e in cyc)
+    # witness.jsonl reloads as a closed history
+    loaded = minimize.load_witness(d)
+    assert len(loaded["history"]) == s["ops"]
+    assert loaded["digest"] == s["digest"]
+
+
+def test_shrink_deterministic(tmp_path):
+    h1 = g1c_history(n_txns=60, seed=9)
+    h2 = g1c_history(n_txns=60, seed=9)
+    base1, d1 = save_run(tmp_path, h1, name="a")
+    s1 = minimize.shrink(d1, host_oracle=True, workers=3)
+    # same seed + history in a fresh store dir, parallel probes on —
+    # the canonical-order selection must yield the identical witness
+    base2, d2 = save_run(tmp_path, h2, name="b")
+    s2 = minimize.shrink(d2, host_oracle=True, workers=1)
+    assert s1["digest"] == s2["digest"]
+    assert s1["ops"] == s2["ops"]
+    assert s1["anomaly-types"] == s2["anomaly-types"]
+
+
+def test_shrink_noop_reshrink_is_instant(tmp_path):
+    h = g1c_history(n_txns=40, seed=13)
+    base, d = save_run(tmp_path, h)
+    s1 = minimize.shrink(d, host_oracle=True)
+    assert not s1["cached"] and s1["probes"] > 0
+    s2 = minimize.shrink(d, host_oracle=True)
+    assert s2["cached"] is True
+    assert s2["probes"] == 0
+    assert s2["digest"] == s1["digest"]
+
+
+def test_shrink_valid_run_refuses(tmp_path):
+    h = synth.la_history(n_txns=20, n_keys=3, concurrency=3, seed=1)
+    base, d = save_run(tmp_path, h)
+    s = minimize.shrink(d, host_oracle=True)
+    assert s["error"] == "not-invalid"
+    assert minimize.load_witness(d) is None
+
+
+def test_shrink_target_absent(tmp_path):
+    h = g1c_history(n_txns=40, seed=15)
+    base, d = save_run(tmp_path, h)
+    s = minimize.shrink(d, host_oracle=True, anomalies=["G0-nonsense"])
+    assert s["error"] == "target-absent"
+
+
+def test_shrink_under_forced_host_fallback(tmp_path):
+    """Verdict preservation under degradation: with a persistent
+    device fault installed, every probe's device dispatch degrades to
+    the host oracle — the witness must still be invalid with the same
+    anomaly class (the resilience contract carried through triage)."""
+    from jepsen_tpu.resilience import FaultPlan, use
+
+    h = g1c_history(n_txns=40, seed=17)
+    base, d = save_run(tmp_path, h)
+    plan = FaultPlan(persistent=True, kinds=("device-lost",))
+    with use(plan):
+        s = minimize.shrink(d, host_oracle=False)  # device checker path
+    assert s["valid?"] is False
+    assert "G1c" in s["anomaly-types"]
+    assert s["ops"] <= 12
+    assert len(plan.injected) > 0  # the faults really fired
+    # the confirm result records the degradation it survived
+    w = json.load(open(s["paths"]["meta"]))
+    assert w["anomalies"], w
+
+
+def test_shrink_telemetry_round_spans(tmp_path):
+    from jepsen_tpu import telemetry
+
+    h = g1c_history(n_txns=40, seed=19)
+    base, d = save_run(tmp_path, h)
+    coll = telemetry.activate()
+    try:
+        minimize.shrink(d, host_oracle=True)
+    finally:
+        telemetry.deactivate(coll)
+    names = []
+
+    def walk(sp):
+        names.append(sp.name)
+        for c in sp.children:
+            walk(c)
+
+    for r in coll.roots:
+        walk(r)
+    assert "shrink" in names
+    assert "shrink.baseline" in names
+    assert "shrink.confirm" in names
+    rounds = [n for n in names if n == "shrink.round"]
+    assert len(rounds) >= 3
+    # round spans carry phase + probe latency attrs
+    shrink_root = next(r for r in coll.roots if r.name == "shrink")
+
+    def find_rounds(sp, out):
+        if sp.name == "shrink.round":
+            out.append(sp)
+        for c in sp.children:
+            find_rounds(c, out)
+
+    rs = []
+    find_rounds(shrink_root, rs)
+    assert any(sp.attrs.get("phase") == "ops" for sp in rs)
+    assert any("probe_p50_s" in sp.attrs for sp in rs)
+    assert any("ops_remaining" in sp.attrs for sp in rs)
+    # probe durations also landed in the fixed-bucket histogram the
+    # web percentile table reads
+    snap = coll.registry.snapshot()
+    hists = [x for x in snap["histograms"]
+             if x["name"] == "shrink-probe-duration-s"]
+    assert hists and hists[0]["count"] > 0
+
+
+def test_rw_register_probes_classified_device():
+    """Review regression: WrChecker must carry the canonical
+    "rw-register" name so shrink probes of rw runs serialize through
+    DeviceSlots like every other device pipeline."""
+    from jepsen_tpu.minimize.probe import is_device_checker
+    from jepsen_tpu.workloads.wr import WrChecker
+
+    assert WrChecker().name() == "rw-register"
+    assert is_device_checker(WrChecker())
+
+
+def test_probe_does_not_replay_run_fault_plan():
+    """Review regression: a chaos cell's own recorded fault plan must
+    not replay into its triage probes — the plan's shared call counter
+    advanced by parallel probes would make witnesses
+    scheduling-dependent (and a persistent plan would degrade every
+    probe).  Process-installed plans (the degradation drill,
+    test_shrink_under_forced_host_fallback) still apply."""
+    from jepsen_tpu.minimize.probe import ProbePool
+    from jepsen_tpu.resilience import FaultPlan
+
+    h = g1c_history(n_txns=20, seed=23)
+    plan = FaultPlan(persistent=True, kinds=("device-lost",))
+    pool = ProbePool({"faults": plan, "store-dir": "/nope"},
+                     AppendChecker(("serializable",)))
+    res = pool.check_history(h)
+    assert res["valid?"] is False
+    assert plan.injected == [], "the run's own plan fired in a probe"
+    assert not res.get("degraded")
+
+
+def test_cached_witness_honors_anomaly_pin(tmp_path):
+    """Review regression: the source-digest cache must not satisfy an
+    --anomaly pin the cached witness doesn't exhibit."""
+    h = g1c_history(n_txns=40, seed=26)  # baseline: G-single/G1c/G2-item
+    base, d = save_run(tmp_path, h)
+    baseline = set(oracle.check(h, ["serializable"])["anomaly-types"])
+    others = sorted(baseline - {"G1c"})
+    assert others, "seed 26 regressed to a single-class baseline"
+    s1 = minimize.shrink(d, host_oracle=True, anomalies="G1c")
+    assert "G1c" in s1["anomaly-types"]
+    s2 = minimize.shrink(d, host_oracle=True, anomalies=[others[0]])
+    assert others[0] in set(s2["anomaly-types"]), \
+        (others[0], s2["anomaly-types"], s2.get("cached"))
+    # and a pin the fresh witness DOES exhibit is a cache hit
+    s3 = minimize.shrink(d, host_oracle=True, anomalies=[others[0]])
+    assert s3["cached"] is True and s3["probes"] == 0
+
+
+def test_baseline_and_confirm_unbounded_by_probe_deadline(tmp_path):
+    """Review regression: the per-candidate probe deadline must not
+    bound the FULL-history baseline or the confirm pass — with an
+    instantly-expiring probe budget every candidate is refused, but
+    the shrink still terminates with a reproducing (unreduced)
+    witness instead of a bogus 'not-invalid'."""
+    h = g1c_history(n_txns=30, seed=27)
+    base, d = save_run(tmp_path, h)
+    s = minimize.shrink(d, host_oracle=True, probe_deadline_s=0.0)
+    assert s.get("error") is None
+    assert s["valid?"] is False
+    assert s["ops"] == len(h)  # no candidate survived its 0 s budget
+
+
+def test_broken_cached_witness_is_not_a_cache_hit(tmp_path):
+    """Review regression: a persisted witness whose confirm pass came
+    back non-false (expired deadline, flake) must not be served from
+    cache forever — the digest match alone is not enough."""
+    h = g1c_history(n_txns=40, seed=29)
+    base, d = save_run(tmp_path, h)
+    s1 = minimize.shrink(d, host_oracle=True)
+    meta_path = s1["paths"]["meta"]
+    w = json.load(open(meta_path))
+    w["valid?"] = "unknown"  # simulate a flaked confirm
+    with open(meta_path, "w") as f:
+        json.dump(w, f)
+    s2 = minimize.shrink(d, host_oracle=True)
+    assert s2["cached"] is False and s2["probes"] > 0
+    assert s2["valid?"] is False  # the re-shrink healed the witness
+    s3 = minimize.shrink(d, host_oracle=True)
+    assert s3["cached"] is True
+
+
+# ---------------------------------------------------------------- golden
+
+def test_golden_g1c_witness(tmp_path):
+    """The checked-in minimal witness for the canonical seeded G1c
+    history: shrinking it must reproduce the golden ops exactly
+    (regenerate with scripts/make_golden.py-style: see the file's
+    "generator" field)."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    h = g1c_history(n_txns=golden["generator"]["n_txns"],
+                    seed=golden["generator"]["seed"])
+    base, d = save_run(tmp_path, h)
+    s = minimize.shrink(d, host_oracle=True, anomalies="G1c")
+    assert s["digest"] == golden["digest"]
+    got = [[op.type, op.process, op.f, op.value]
+           for op in s["witness-history"]]
+    assert got == golden["ops"]
+    assert "G1c" in s["anomaly-types"]
+
+
+# ---------------------------------------------------------------- campaign
+
+class _StaleReadClient:
+    """A deliberately broken list-append client: reads return the
+    key's list REVERSED — incompatible-order from the second append
+    on, so every run is deterministically invalid."""
+
+    def open(self, test, node):
+        return self
+
+    def close(self, test):
+        pass
+
+    def setup(self, test):
+        pass
+
+    def teardown(self, test):
+        pass
+
+    def __init__(self):
+        import threading
+
+        self.lock = threading.Lock()
+        self.lists = {}
+
+    def invoke(self, test, op):
+        out = []
+        with self.lock:
+            for m in op["value"]:
+                kind, k = m[0], m[1]
+                if kind == "append":
+                    self.lists.setdefault(k, []).append(m[2])
+                    out.append(["append", k, m[2]])
+                else:
+                    out.append(["r", k, list(reversed(
+                        self.lists.get(k, [])))])
+        return dict(op, type="ok", value=out)
+
+
+def test_campaign_auto_shrink_cell(tmp_path):
+    """The opt-in `"shrink": true` spec key: an invalid cell's index
+    record gains a witness summary, and the witness artifacts land in
+    the run dir (the web grid renders them as the witness column)."""
+    from jepsen_tpu import campaign
+    from jepsen_tpu.campaign import plan as plan_mod
+    from jepsen_tpu.generator import core as g
+
+    def bad_append(opts):
+        import random
+
+        rng = random.Random(opts.get("seed", 0))
+        return {
+            "name": "bad-append",
+            "nodes": ["n1"],
+            "concurrency": 2,
+            "client": _StaleReadClient(),
+            "generator": g.clients(g.limit(
+                40, synth.la_generator(n_keys=2, read_frac=0.4,
+                                       rng=rng))),
+            "checker": AppendChecker(("serializable",)),
+        }
+
+    plan_mod.register_workload("bad-append-shrink", bad_append,
+                               device=True)
+    base = str(tmp_path / "s")
+    spec = {"name": "shrinky", "workloads": ["bad-append-shrink"],
+            "seeds": [0], "opts": {"shrink": True}}
+    summary = campaign.run_campaign(spec, base, workers=1)
+    row = summary["rows"][0]
+    assert row["valid?"] is False
+    w = row["witness"]
+    assert w and w.get("ops") and w["ops"] <= 12, row
+    assert w["anomaly-types"]
+    run_dir = os.path.join(base, row["dir"])
+    assert os.path.exists(os.path.join(run_dir, "witness.json"))
+    assert os.path.exists(os.path.join(run_dir, "witness.jsonl"))
+    # the witness summary is in the index ledger (what the web grid
+    # and regression queries read)
+    from jepsen_tpu.campaign.core import index_path
+
+    idx = campaign.Index(index_path("shrinky", base))
+    rec = idx.latest(row["run"])
+    assert rec["witness"]["digest"] == w["digest"]
+
+
+# ---------------------------------------------------------------- slow
+
+@pytest.mark.slow  # device-pipeline probes recompile per shape bucket
+def test_acceptance_device_probes_500_ops(tmp_path):
+    """ISSUE 4 acceptance: a seeded 500+-op invalid list-append
+    history shrinks to a ≤12-op witness that re-checks invalid with
+    the same anomaly class, deterministically, with probe rounds as
+    telemetry spans and DEVICE probes serialized through DeviceSlots
+    (the probe checker is the device pipeline here — no host twin)."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.minimize.probe import is_device_checker
+
+    h = g1c_history()  # 500 ops
+    assert len(h) >= 500
+    base, d = save_run(tmp_path, h)
+    assert is_device_checker(AppendChecker())
+    coll = telemetry.activate()
+    try:
+        s = minimize.shrink(d, anomalies="G1c", workers=2,
+                            device_slots=1)
+    finally:
+        telemetry.deactivate(coll)
+    assert s["valid?"] is False
+    assert s["ops"] <= 12
+    assert "G1c" in s["anomaly-types"]
+    assert s["probe-checker"] == "list-append"  # the device pipeline
+    names = []
+
+    def walk(sp):
+        names.append(sp.name)
+        for c in sp.children:
+            walk(c)
+
+    for r in coll.roots:
+        walk(r)
+    assert names.count("shrink.round") >= 3

@@ -476,3 +476,74 @@ def test_traffic_package_composes():
     nem.invoke(t, {"f": "fast", "value": None})
     assert t["net"].shaping is None
     assert combined.traffic_package({"faults": {"partition"}}) is None
+
+
+# ------------------------------------------------- interpreter fault site
+
+def _interp_test(concurrency, plan, seed=0, ops=24):
+    import random
+
+    from jepsen_tpu import core as jcore
+    from jepsen_tpu.generator import core as g
+    from jepsen_tpu.workloads.mem import MemClient
+
+    return jcore.noop_test(
+        name="interp-faults", concurrency=concurrency,
+        client=MemClient(),
+        generator=g.clients(g.limit(ops, synth.la_generator(
+            n_keys=3, rng=random.Random(seed)))),
+        faults=plan)
+
+
+def test_interpreter_fault_site_is_opt_in():
+    """A checker-chaos plan that does not NAME the interpreter site
+    must never touch the workload — even at p=1 (ISSUE 4 satellite:
+    client-side chaos is requested by naming the site)."""
+    from jepsen_tpu.generator import interpreter
+
+    plan = FaultPlan(p=1.0, kinds=("oom",))
+    assert not plan.targets_site(interpreter.FAULT_SITE)
+    h = interpreter.run(_interp_test(2, plan))
+    assert len(plan.injected) == 0
+    assert all(op.type != "info" for op in h), \
+        "opt-out plan crashed client ops"
+
+
+def test_interpreter_stalls_and_infos_deterministic():
+    """sites=("interpreter",): crash kinds complete ops as attributed
+    :info (process re-opened), stalls just add latency; a single-worker
+    run pair injects and completes identically (seeded determinism)."""
+    from jepsen_tpu.generator import interpreter
+
+    def run_once():
+        plan = FaultPlan(seed=5, p=0.4, kinds=("oom", "stall"),
+                         stall_s=0.001, sites=("interpreter",))
+        h = interpreter.run(_interp_test(1, plan, seed=5))
+        return plan, h
+
+    p1, h1 = run_once()
+    p2, h2 = run_once()
+    assert p1.injected == p2.injected and p1.injected, p1.injected
+    shape = lambda h: [(op.type, op.process, op.f, op.value, op.error)
+                       for op in h]
+    assert shape(h1) == shape(h2)
+    infos = [op for op in h1 if op.type == "info"]
+    assert infos, "no crash-kind faults landed (raise p or ops)"
+    assert all(str(op.error).startswith("fault-injected") for op in infos)
+    # crashed processes were re-opened on a fresh process id
+    # (concurrency=1: process 0 crashes -> next incarnation is 1)
+    assert any(isinstance(op.process, int) and op.process >= 1
+               for op in h1)
+
+
+def test_interpreter_fault_site_persistent_form():
+    """persistent=("interpreter",) also targets the site: EVERY op
+    info-completes, and the run still terminates with a history."""
+    from jepsen_tpu.generator import interpreter
+
+    plan = FaultPlan(persistent=("interpreter",), kinds=("oom",))
+    assert plan.targets_site(interpreter.FAULT_SITE)
+    h = interpreter.run(_interp_test(2, plan, ops=10))
+    infos = [op for op in h if op.type == "info"]
+    assert len(infos) == 10
+    assert len(plan.injected) == 10
