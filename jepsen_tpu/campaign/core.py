@@ -32,13 +32,23 @@ from jepsen_tpu.resilience import DEADLINE_ERROR
 logger = logging.getLogger("jepsen.campaign")
 
 __all__ = ["run_campaign", "status_campaign", "report_campaign",
-           "execute_run", "index_path", "result_flags", "summarize"]
+           "execute_run", "index_path", "live_path", "result_flags",
+           "summarize"]
 
 
 def index_path(name: str, base: Optional[str] = None) -> str:
     """The campaign's ledger path: ``<store>/campaigns/<name>.jsonl``."""
     return os.path.join(base or store.BASE, "campaigns",
                         store.sanitize(name) + ".jsonl")
+
+
+def live_path(name: str, base: Optional[str] = None) -> str:
+    """The campaign's heartbeat state file (atomically replaced by the
+    scheduler as workers pick up / finish runs): ``<store>/campaigns/
+    <name>.live.json`` — the data behind the ``/campaign/<name>/live``
+    dashboard."""
+    return os.path.join(base or store.BASE, "campaigns",
+                        store.sanitize(name) + ".live.json")
 
 
 def result_flags(results: Any) -> Dict[str, Any]:
@@ -247,11 +257,18 @@ def run_campaign(spec: Union[str, dict], base: Optional[str] = None, *,
                     rec.get("run"), rec.get("valid?"))
 
     t0 = time.monotonic()
+    from jepsen_tpu.telemetry import Heartbeat
+
+    hb = Heartbeat(live_path(spec["name"], base), campaign=spec["name"],
+                   total=len(specs), done=len(specs) - len(todo))
     sched = Scheduler(workers, device_slots=device_slots,
                       executor=executor, retry=retry,
-                      run_deadline_s=run_deadline_s)
+                      run_deadline_s=run_deadline_s, heartbeat=hb)
     sched.run(todo, lambda rs: execute_run(rs, base),
               on_result=on_result)
+    # normal completion only: an interrupted fleet must leave its
+    # in-flight worker state in live.json for the /live post-mortem
+    hb.close()
     return summarize(spec, base, executed=len(todo),
                      skipped=len(specs) - len(todo),
                      wall_s=time.monotonic() - t0, idx=idx)

@@ -155,6 +155,41 @@ class Index:
     def regressions(self) -> List[Dict[str, Any]]:
         return [f for f in self.flips() if f["regression"]]
 
+    def witness_diffs(self) -> List[Dict[str, Any]]:
+        """Per-key witness comparison across campaign generations
+        (ROADMAP open item): for every consecutive pair of auto-shrunk
+        records under the same ``workload|fault|seed`` key, the
+        op-count / digest / anomaly-set deltas.  A digest change with
+        an unchanged spec is the "the minimal repro MOVED" signal — a
+        different failure than last generation, even when the verdict
+        column still just says False."""
+        out: List[Dict[str, Any]] = []
+        by_key: Dict[str, List[Dict[str, Any]]] = {}
+        for r in self.records:
+            w = r.get("witness")
+            if isinstance(w, dict) and w.get("ops") and r.get("key"):
+                by_key.setdefault(r["key"], []).append(r)
+        for key, recs in sorted(by_key.items()):
+            for prev, cur in zip(recs[:-1], recs[1:]):
+                pw, cw = prev["witness"], cur["witness"]
+                pa = set(pw.get("anomaly-types") or ())
+                ca = set(cw.get("anomaly-types") or ())
+                p_ops, c_ops = pw.get("ops") or 0, cw.get("ops") or 0
+                out.append({
+                    "key": key,
+                    "from-gen": prev.get("gen"), "to-gen": cur.get("gen"),
+                    "from-ops": p_ops, "to-ops": c_ops,
+                    "ops-delta": c_ops - p_ops,
+                    "from-digest": pw.get("digest"),
+                    "to-digest": cw.get("digest"),
+                    "digest-changed": pw.get("digest") != cw.get("digest"),
+                    "anomalies-added": sorted(ca - pa),
+                    "anomalies-removed": sorted(pa - ca),
+                    "changed": (pw.get("digest") != cw.get("digest")
+                                or pa != ca or p_ops != c_ops),
+                })
+        return out
+
     # -- telemetry aggregates ----------------------------------------------
 
     def _span_values(self) -> Dict[str, List[float]]:

@@ -101,12 +101,17 @@ class Scheduler:
     def __init__(self, n_workers: int = 2, *, device_slots: int = 1,
                  executor: str = "thread",
                  retry: Optional[RetryPolicy] = None,
-                 run_deadline_s: Optional[float] = None):
+                 run_deadline_s: Optional[float] = None,
+                 heartbeat: Optional[Any] = None):
         if executor not in ("thread", "subprocess"):
             raise ValueError(f"unknown executor {executor!r}")
         self.n_workers = max(1, int(n_workers))
         self.slots = DeviceSlots(device_slots)
         self.executor = executor
+        #: optional telemetry.Heartbeat: per-worker in-flight state
+        #: published to the campaign ledger dir as runs start/finish —
+        #: the live fleet dashboard's data (docs/TELEMETRY.md)
+        self.heartbeat = heartbeat
         # campaign-level retries: ANY exception is retryable here (the
         # run may have died to an env flake, not a code bug); seeded
         # backoff keeps faulted campaigns replayable
@@ -166,6 +171,15 @@ class Scheduler:
                     q.put((i, rs))
                     time.sleep(0.02)
                     continue
+                # Heartbeat methods never raise (see its no-raise
+                # guarantee) — no defensive wrapping here
+                hb = self.heartbeat
+                wname = threading.current_thread().name
+                if hb is not None:
+                    hb.worker(wname, {
+                        "run": rs.run_id, "workload": rs.workload_label,
+                        "fault": rs.fault_label, "seed": rs.seed,
+                        "slot": slot})
                 try:
                     rec = self._run_one(rs, execute, slot)
                 finally:
@@ -173,6 +187,10 @@ class Scheduler:
                         self._tel_lock.release()
                     if slot is not None:
                         self.slots.release(slot)
+                    if hb is not None:
+                        hb.worker(wname, None)
+                if hb is not None:
+                    hb.record_done(rs.run_id, rec.get("valid?"))
                 with lock:
                     results[i] = rec
                     if on_result is not None:

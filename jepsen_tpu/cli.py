@@ -91,7 +91,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--telemetry", action="store_true",
                    help="collect span tracing + metrics; writes "
                         "telemetry.json and Chrome trace.json into the "
-                        "store dir (view with `trace <dir>` or Perfetto)")
+                        "store dir (view with `trace <dir>` or Perfetto), "
+                        "and streams events.jsonl live (follow with "
+                        "`tail <dir> -f` or the web /live page)")
+    p.add_argument("--profile-dir", dest="profile_dir", default=None,
+                   help="capture a JAX profiler trace into this dir; "
+                        "implies telemetry, and every telemetry span is "
+                        "bridged to a TraceAnnotation so host spans and "
+                        "XLA kernels share one Perfetto timeline")
 
 
 def opts_to_test_map(opts: argparse.Namespace) -> Dict[str, Any]:
@@ -109,6 +116,7 @@ def opts_to_test_map(opts: argparse.Namespace) -> Dict[str, Any]:
         "checker-time-limit": getattr(opts, "checker_time_limit", None),
         "leave-db-running": opts.leave_db_running,
         "store-dir": opts.store_dir,
+        "profile-dir": getattr(opts, "profile_dir", None),
     })
     return out
 
@@ -149,19 +157,82 @@ def serve_cmd(opts: argparse.Namespace) -> int:
 
 
 def trace_cmd(opts: argparse.Namespace) -> int:
-    """Summarize a stored run's telemetry (span tree + metrics)."""
+    """Summarize a stored run's telemetry (span tree + metrics); with
+    ``--top N``, append the slowest-spans-by-self-time table."""
+    import json
+
     from .telemetry import export as tel_export
     d = opts.dir
     if not os.path.isdir(d):
         print(f"trace: no such directory {d!r}", file=sys.stderr)
         return 2
     try:
-        print(tel_export.summarize(d))
+        with open(os.path.join(d, tel_export.TELEMETRY_FILE)) as f:
+            doc = json.load(f)
+        print(tel_export.summarize(d, doc=doc))
     except FileNotFoundError:
         print(f"trace: {d} has no telemetry.json (run the test with "
               "--telemetry or JEPSEN_TELEMETRY=1)", file=sys.stderr)
         return 2
+    top = getattr(opts, "top", None)
+    if top:
+        print(f"\ntop {top} spans by self time:")
+        print(tel_export.render_top_spans(tel_export.top_spans(doc, top)))
     return 0
+
+
+def tail_cmd(opts: argparse.Namespace) -> int:
+    """`tail <run-dir>` — render a run's streamed events.jsonl as
+    human-readable progress lines; ``-f`` follows a live run.  The
+    footer names the still-open span chain and the final counter
+    values — the post-mortem view for killed/wedged runs."""
+    import time as _time
+
+    from .telemetry import stream as tel_stream
+
+    path = opts.dir
+    if os.path.isdir(path):
+        path = (tel_stream.events_path(path)
+                or os.path.join(path, tel_stream.EVENTS_FILE))
+    if not os.path.exists(path):
+        print(f"tail: {opts.dir} has no events.jsonl (run with "
+              "--telemetry or JEPSEN_TELEMETRY=1 to stream)",
+              file=sys.stderr)
+        return 2
+    if not getattr(opts, "follow", False):
+        evs = tel_stream.read_events(path)
+        print(tel_stream.render_tail(evs, limit=opts.lines))
+        return 0
+    offset = 0
+    t0 = None
+    first = True
+    try:
+        while True:
+            # byte cursor, not a re-parse: a multi-hour soak's
+            # events.jsonl is unbounded and a full-file read per poll
+            # is O(n^2) over the run
+            evs, offset = tel_stream.read_events_incremental(path, offset)
+            if evs:
+                # "end" can be followed by a straggler (e.g. a sampler
+                # tick racing close) — scan the batch, not just its tail
+                ended = any(e.get("ev") == "end" for e in evs)
+                if t0 is None:
+                    t0 = evs[0].get("t")
+                if first and opts.lines is not None \
+                        and len(evs) > opts.lines:
+                    print(f"... ({len(evs) - opts.lines} earlier events)",
+                          flush=True)
+                    evs = evs[-opts.lines:] if opts.lines else []
+                first = False
+                for e in evs:
+                    if e.get("ev") == "start":
+                        t0 = e.get("t")  # new session replaced the file
+                    print(tel_stream.render_line(e, t0), flush=True)
+                if ended:
+                    return 0
+            _time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 0
 
 
 def campaign_cmd(opts: argparse.Namespace) -> int:
@@ -274,6 +345,19 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
     ptr = sub.add_parser("trace",
                          help="summarize a stored run's telemetry")
     ptr.add_argument("dir", help="store run directory")
+    ptr.add_argument("--top", type=int, default=None, metavar="N",
+                     help="also print the N slowest spans by self-time "
+                          "(name, count, total/p95) — span regressions "
+                          "quotable without opening Perfetto")
+
+    ptl = sub.add_parser("tail",
+                         help="render a run's streamed events.jsonl "
+                              "(the flight recorder; docs/TELEMETRY.md)")
+    ptl.add_argument("dir", help="store run directory (or events.jsonl)")
+    ptl.add_argument("-f", "--follow", action="store_true",
+                     help="poll for new events until the run ends")
+    ptl.add_argument("-n", "--lines", type=int, default=None,
+                     help="only show the last N event lines")
 
     psh = sub.add_parser("shrink",
                          help="delta-debug an invalid run to a minimal "
@@ -336,6 +420,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
             return analyze_cmd(opts, checker_fn)
         if opts.cmd == "trace":
             return trace_cmd(opts)
+        if opts.cmd == "tail":
+            return tail_cmd(opts)
         if opts.cmd == "shrink":
             return shrink_cmd(opts, checker_fn)
         if opts.cmd == "campaign":

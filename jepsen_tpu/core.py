@@ -37,6 +37,7 @@ from .control.core import Remote, Session
 from .generator import core as gen_core
 from .generator import interpreter
 from .history.ops import History
+from .utils import profiling
 
 logger = logging.getLogger("jepsen.core")
 
@@ -155,20 +156,41 @@ def run(test: dict) -> dict:
         test["start-time"] = time.time()
     # telemetry: a fresh collector per run when opted in (test map key,
     # telemetry.enable(), or JEPSEN_TELEMETRY); the NOOP singleton
-    # otherwise — every span below is then a shared no-op object
-    tel = (telemetry.activate() if telemetry.wanted_for(test)
+    # otherwise — every span below is then a shared no-op object.  A
+    # "profile-dir" run is implicitly telemetric: its spans bridge to
+    # the JAX profiler as TraceAnnotations of the same names
+    profile_dir = test.get("profile-dir")
+    tel = (telemetry.activate()
+           if telemetry.wanted_for(test) or profile_dir
            else telemetry.NOOP)
+    recorder = None
     if tel.enabled:
         test["telemetry-collector"] = tel
         # a full run always writes the unsuffixed artifacts, even for a
         # test map reloaded from a store dir that was later analyzed
         test.pop("telemetry-artifact-suffix", None)
+        tel.annotate = bool(profile_dir)
+        # the flight recorder: stream span/metric/resilience events to
+        # <run-dir>/events.jsonl as they happen, so a killed run still
+        # leaves a readable partial trace (docs/TELEMETRY.md)
+        try:
+            recorder = telemetry.attach_stream(
+                tel, store.test_dir(test),
+                meta={"name": test.get("name")},
+                interval_s=float(
+                    test.get("telemetry-sample-interval", 1.0)))
+        except Exception as e:  # noqa: BLE001 — never fail a run for it
+            logger.warning("flight recorder unavailable: %s", e)
     try:
-        with tel.span("run", name=test.get("name"),
-                      nodes=len(test.get("nodes") or ()),
-                      concurrency=test.get("concurrency")):
-            return _run_phases(test, tel)
+        with profiling.trace(profile_dir):
+            with tel.span("run", name=test.get("name"),
+                          nodes=len(test.get("nodes") or ()),
+                          concurrency=test.get("concurrency")):
+                return _run_phases(test, tel)
     finally:
+        if recorder is not None:
+            recorder.close(
+                valid=(test.get("results") or {}).get("valid?"))
         if tel.enabled:
             telemetry.deactivate(tel)
 

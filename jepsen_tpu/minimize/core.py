@@ -120,15 +120,28 @@ def shrink(run_or_dir: Union[str, dict], *,
             chk, device = host, False
 
     own_tel = None
+    recorder = None
     tel = telemetry.active()
     if not tel.enabled and telemetry.wanted_for(test):
         own_tel = tel = telemetry.activate()
+        # flight-record the shrink session itself (events-shrink.jsonl
+        # so the original run's stream is never appended to): round /
+        # probe progress is followable live via `cli tail`
+        try:
+            recorder = telemetry.attach_stream(
+                own_tel, run_dir, meta={"name": test.get("name"),
+                                        "shrink": True},
+                filename=telemetry.stream.SHRINK_EVENTS_FILE)
+        except Exception as e:  # noqa: BLE001 — never fail a shrink
+            logger.warning("shrink flight recorder unavailable: %s", e)
     try:
         summary = _shrink_run(test, hist, run_dir, chk, confirm_chk,
                               tel, source_digest, rounds,
                               probe_deadline_s, workers, device_slots,
                               device, anomalies)
     finally:
+        if recorder is not None:
+            recorder.close()
         if own_tel is not None:
             telemetry.deactivate(own_tel)
             try:
@@ -205,6 +218,11 @@ def _shrink_run(test, hist, run_dir, chk, confirm_chk, tel,
             if sp is not None:
                 sp.set_attr(ops_remaining=st.ops_remaining,
                             improved=st.improved)
+            # the span-close event has already streamed by the time
+            # these attrs land, so round progress gets its own event
+            telemetry.stream_event(
+                "shrink-round", phase=st.phase, candidates=st.candidates,
+                ops_remaining=st.ops_remaining, improved=st.improved)
 
         units = reduce_mod.units_of(hist)
         reducer = reduce_mod.Reducer(probe_batch=probe_batch,

@@ -32,6 +32,7 @@ from jepsen_tpu.checkers import api as checker_api
 from jepsen_tpu.history.ops import History
 
 from jepsen_tpu.minimize.reduce import Unit, build_history
+from jepsen_tpu.telemetry import export as tel_export
 
 __all__ = ["resolve_checker", "is_device_checker", "host_equivalent",
            "ProbePool"]
@@ -104,6 +105,23 @@ def host_equivalent(chk: checker_api.Checker
                                 deadline=(opts or {}).get("deadline"))
 
         return checker_api.FnChecker(fn, "list-append-host")
+    if _name(chk) == "rw-register":
+        # the rw host path (use_device=False) IS the oracle the fused
+        # device pipeline is differentially tested against — probing
+        # through it cannot change a verdict, only skip the per-shape
+        # jit compile every small ddmin candidate would otherwise pay
+        from jepsen_tpu.checkers.elle import rw_register
+
+        rw_models = tuple(getattr(chk, "models", ("snapshot-isolation",)))
+        rw_anoms = tuple(getattr(chk, "anomalies", ()))
+
+        def rw_fn(test, history, opts):
+            return rw_register.check(
+                history, consistency_models=rw_models,
+                anomalies=rw_anoms, use_device=False,
+                deadline=(opts or {}).get("deadline"))
+
+        return checker_api.FnChecker(rw_fn, "rw-register-host")
     return None
 
 
@@ -224,11 +242,11 @@ class ProbePool:
 
 
 def quantile(sorted_vals: List[float], p: float) -> float:
-    """THE quantile rule for probe durations (index-based, like the
-    campaign index's nearest-rank) — shared by the per-round span
-    attrs and the persisted witness meta so the two never disagree."""
-    return round(sorted_vals[min(len(sorted_vals) - 1,
-                                 int(p * (len(sorted_vals) - 1)))], 4)
+    """THE quantile rule for probe durations — delegates to the shared
+    telemetry formula (`export.quantile`, also behind `trace --top`'s
+    p95 column) so the per-round span attrs, the persisted witness
+    meta, and the trace tables can never disagree."""
+    return round(tel_export.quantile(sorted_vals, p), 4)
 
 
 def _name(chk: checker_api.Checker) -> str:

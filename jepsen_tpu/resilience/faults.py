@@ -233,6 +233,7 @@ class FaultPlan:
 
         telemetry.registry().counter("resilience-faults-injected",
                                      site=site, kind=kind).inc()
+        telemetry.stream_event("fault", site=site, kind=kind, index=index)
         if kind == "stall":
             import time
             time.sleep(self.stall_s)

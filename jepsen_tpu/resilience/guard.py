@@ -20,6 +20,7 @@ innermost open span, so a degraded run is diagnosable straight from
 from __future__ import annotations
 
 import logging
+import sys
 import time
 from typing import Any, Callable, Optional, Tuple
 
@@ -50,12 +51,52 @@ def _registry():
     return telemetry.registry()
 
 
+def _stream_event(ev: str, **fields: Any) -> None:
+    from jepsen_tpu import telemetry
+
+    telemetry.stream_event(ev, **fields)
+
+
 def _annotate(**attrs: Any) -> None:
     from jepsen_tpu import telemetry
 
     sp = telemetry.current()
     if sp is not None:
         sp.set_attr(**attrs)
+
+
+def _stamp_device_time(site: str, fn: Callable, args: tuple,
+                       kw: dict) -> Any:
+    """Run one device attempt, stamping its block-until-ready wall time
+    onto the enclosing telemetry span as ``device_time_ns`` (summed
+    across calls under that span) — the device-time attribution that
+    puts host spans and XLA work on one timeline.  Only reached when
+    telemetry is enabled; device failures surfacing at the sync point
+    propagate to the caller's retry/fallback classifier."""
+    from jepsen_tpu import telemetry
+
+    t0 = time.perf_counter_ns()
+    out = fn(*args, **kw)
+    jx = sys.modules.get("jax")
+    if jx is not None:
+        try:  # force completion so the delta covers the device work
+            jx.block_until_ready(out)
+        except (TypeError, AttributeError):  # non-blockable results
+            pass
+        # anything else (XlaRuntimeError, RESOURCE_EXHAUSTED, ...) is a
+        # REAL device failure surfacing at the sync point — let it reach
+        # device_call's retry/fallback classifier instead of returning
+        # the poisoned value as success
+    dt = time.perf_counter_ns() - t0
+    sp = telemetry.current()
+    if sp is not None and sp.attrs is not None:
+        try:
+            sp.attrs["device_time_ns"] = \
+                int(sp.attrs.get("device_time_ns", 0)) + dt
+        except Exception:  # noqa: BLE001 — noop-span attrs are shared
+            pass
+    telemetry.registry().counter("device-time-ns", site=site).inc(dt)
+    return out
 
 
 def device_call(site: str, fn: Callable, *args: Any,
@@ -87,6 +128,10 @@ def device_call(site: str, fn: Callable, *args: Any,
         try:
             if plan is not None:
                 plan.fire(site)
+            from jepsen_tpu import telemetry
+
+            if telemetry.enabled():
+                return _stamp_device_time(site, fn, args, kw)
             return fn(*args, **kw)
         except DeadlineExceeded:
             raise
@@ -99,6 +144,8 @@ def device_call(site: str, fn: Callable, *args: Any,
                 raise
             _registry().counter("resilience-retries", site=site,
                                 kind=type(e).__name__).inc()
+            _stream_event("retry", site=site, attempt=attempt,
+                          kind=type(e).__name__)
             _annotate(retries=attempt)
             logger.warning("transient device failure at %s (attempt "
                            "%d/%d), retrying in %.3fs: %s", site, attempt,
@@ -119,6 +166,7 @@ def degrade_to_host(site: str, host_fn: Callable[[], Any],
     run the host oracle, and stamp dict results with
     ``"degraded": "host-fallback"`` plus the device error."""
     _registry().counter("resilience-fallbacks", site=site).inc()
+    _stream_event("fallback", site=site, error=type(exc).__name__)
     _annotate(degraded=DEGRADED_HOST, device_error=type(exc).__name__)
     logger.warning("persistent device failure at %s; degrading to "
                    "host oracle: %s", site, exc)

@@ -111,6 +111,7 @@ def _count_deadline(what: str) -> None:
 
     telemetry.registry().counter("resilience-deadline-expired",
                                  site=what or "unspecified").inc()
+    telemetry.stream_event("deadline", site=what or "unspecified")
 
 
 # ---------------------------------------------------------------------------

@@ -35,9 +35,12 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
-from . import export, metrics, spans
+from . import export, metrics, spans, stream
 from .export import chrome_trace, snapshot, summarize, write_run
 from .metrics import Registry
+from .stream import Heartbeat, read_events
+from .stream import attach as attach_stream
+from .stream import event as stream_event
 from .spans import (
     NOOP,
     Collector,
@@ -59,7 +62,8 @@ __all__ = [
     "Registry", "activate", "active", "current", "deactivate",
     "enabled", "phases", "span", "traced", "registry", "snapshot",
     "chrome_trace", "write_run", "summarize", "enable", "disable",
-    "wanted_for", "export", "metrics", "spans",
+    "wanted_for", "export", "metrics", "spans", "stream",
+    "attach_stream", "stream_event", "read_events", "Heartbeat",
 ]
 
 def registry() -> Registry:

@@ -27,7 +27,8 @@ from . import metrics as _metrics
 from .spans import Collector, Span
 
 __all__ = ["span_to_dict", "snapshot", "chrome_trace", "write_run",
-           "summarize", "histogram_quantiles"]
+           "summarize", "histogram_quantiles", "top_spans",
+           "render_top_spans"]
 
 TELEMETRY_FILE = "telemetry.json"
 TRACE_FILE = "trace.json"
@@ -175,11 +176,61 @@ def histogram_quantiles(bounds: List[Any], counts: List[int],
     return out
 
 
+def quantile(sorted_vals: List[float], p: float) -> float:
+    """THE floor nearest-rank quantile rule, shared by every surface
+    that quotes span/probe percentiles (``trace --top``, the shrink
+    probe stats) — one formula, so two reports of the same samples
+    can't disagree."""
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(p * (len(sorted_vals) - 1)))]
+
+
+def top_spans(doc: Dict[str, Any], n: int = 10) -> List[Dict[str, Any]]:
+    """The slowest-spans table (``cli trace --top N``): per span name,
+    count / total self-time / p95 self-time, sorted by total self-time
+    descending.  Self-time = a span's duration minus its children's
+    (clamped at 0 — provisional closes can overlap), so a parent that
+    merely *waits* on an expensive child doesn't crowd it out.  Makes a
+    span regression quotable without opening Perfetto."""
+    agg: Dict[str, List[float]] = {}
+
+    def walk(sp: Dict[str, Any]) -> None:
+        dur = sp.get("dur_ns")
+        kids = sp.get("children") or []
+        if isinstance(dur, (int, float)):
+            child_ns = sum(c.get("dur_ns") or 0 for c in kids
+                           if isinstance(c.get("dur_ns"), (int, float)))
+            agg.setdefault(sp["name"], []).append(
+                max(0.0, float(dur) - child_ns))
+        for c in kids:
+            walk(c)
+
+    for r in doc.get("spans", []):
+        walk(r)
+    rows: List[Dict[str, Any]] = []
+    for name, selfs in agg.items():
+        s = sorted(selfs)
+        p95 = quantile(s, 0.95)
+        rows.append({"name": name, "count": len(s),
+                     "total_self_s": round(sum(s) / 1e9, 6),
+                     "p95_self_s": round(p95 / 1e9, 6)})
+    rows.sort(key=lambda r: -r["total_self_s"])
+    return rows[:max(1, int(n))]
+
+
+def render_top_spans(rows: List[Dict[str, Any]]) -> str:
+    lines = [f"{'span':<40} {'n':>6} {'total self':>12} {'p95 self':>12}"]
+    for r in rows:
+        lines.append(f"{r['name']:<40} {r['count']:>6} "
+                     f"{r['total_self_s']:>11.4f}s {r['p95_self_s']:>11.4f}s")
+    return "\n".join(lines)
+
+
 # -- summaries (cli `trace` command) ---------------------------------------
 
-def _fmt_dur(ns: Optional[float]) -> str:
-    if ns is None:
-        return "open"
+def _fmt_dur(ns: Optional[float], fallback: str = "open") -> str:
+    if not isinstance(ns, (int, float)):
+        return fallback
     if ns >= 1e9:
         return f"{ns / 1e9:.2f}s"
     if ns >= 1e6:

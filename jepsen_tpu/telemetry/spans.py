@@ -37,7 +37,7 @@ class Span:
     perf_counter_ns values; ``t1`` is None while the span is open."""
 
     __slots__ = ("name", "t0", "t1", "attrs", "children", "tid",
-                 "thread_name")
+                 "thread_name", "ann")
 
     def __init__(self, name: str, tid: int, thread_name: str,
                  attrs: Optional[Dict[str, Any]] = None):
@@ -48,6 +48,9 @@ class Span:
         self.children: List[Span] = []
         self.tid = tid
         self.thread_name = thread_name
+        # profiler-bridge annotation ctx (Collector.annotate runs):
+        # entered at push, exited at pop, same thread both times
+        self.ann: Optional[Any] = None
 
     @property
     def duration_ns(self) -> Optional[int]:
@@ -118,9 +121,19 @@ class Collector:
     Each collector owns a fresh metrics registry: while it is active,
     ``telemetry.registry()`` resolves to it, so a run's exported
     counters cover exactly that run (a second telemetric run in one
-    process does not inherit the first run's tallies)."""
+    process does not inherit the first run's tallies).
+
+    Streaming (ISSUE 5): ``stream`` is an attached flight-recorder
+    ``EventStream`` (see :func:`stream.attach`) — span opens/closes
+    are emitted as they happen so a killed run leaves a partial trace.
+    ``annotate=True`` bridges every span to the JAX profiler: the span
+    body runs inside a ``TraceAnnotation`` of the same name, so a
+    ``--profile-dir`` run interleaves host spans with XLA kernels on
+    one Perfetto timeline."""
 
     enabled = True
+    stream: Optional[Any] = None
+    annotate = False
 
     def __init__(self):
         from .metrics import Registry
@@ -148,6 +161,14 @@ class Collector:
     def _push(self, name: str, attrs: Optional[Dict[str, Any]]) -> Span:
         t = threading.current_thread()
         sp = Span(name, t.ident or 0, t.name, attrs)
+        if self.annotate:
+            try:
+                from jepsen_tpu.utils.profiling import annotate
+
+                sp.ann = annotate(name)
+                sp.ann.__enter__()
+            except Exception:  # noqa: BLE001 — bridging is best-effort
+                sp.ann = None
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = self._tls.stack = []
@@ -157,6 +178,8 @@ class Collector:
             with self._lock:
                 self.roots.append(sp)
         stack.append(sp)
+        if self.stream is not None:
+            self.stream.span_open(sp)
         return sp
 
     def _pop(self, sp: Optional[Span]) -> None:
@@ -168,10 +191,18 @@ class Collector:
         # children's __exit__): unwind to and including sp
         while stack:
             top = stack.pop()
-            if top is sp:
-                break
             if top.t1 is None:
                 top.t1 = sp.t1
+            ann, top.ann = top.ann, None
+            if ann is not None:
+                try:  # innermost-first pop order matches TraceAnnotation
+                    ann.__exit__(None, None, None)
+                except Exception:  # noqa: BLE001
+                    pass
+            if self.stream is not None:
+                self.stream.span_close(top)
+            if top is sp:
+                break
 
     # -- finalization ------------------------------------------------------
 
@@ -199,6 +230,8 @@ class NoopCollector:
     enabled = False
     roots: List[Span] = []
     registry = None  # telemetry.registry() falls back to the default
+    stream = None
+    annotate = False
 
     def span(self, name: str, /, **attrs: Any) -> _NoopSpan:
         return _NOOP_SPAN

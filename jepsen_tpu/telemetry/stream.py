@@ -1,0 +1,688 @@
+"""The flight recorder: streaming telemetry for in-flight runs (ISSUE 5).
+
+PR 1's telemetry is export-only — ``telemetry.json`` / ``trace.json``
+appear at ``store.save_1``, so the runs this framework exists to study
+(wedged checkers, crashed workers, deadline-killed campaign cells)
+leave *no* observability artifact at all.  This module makes the active
+collector *stream*: every span open/close, every metric delta, and
+every resilience event (fault injected, retry, host fallback, deadline
+expiry) is appended to an ``events.jsonl`` in the run dir **as it
+happens**, fsync'd per event, so a SIGKILLed run still yields a
+readable partial trace (tail-truncated at worst — the reader drops one
+torn trailing line, exactly like the campaign ledger).
+
+Pieces:
+
+- :class:`EventStream` — the append-only fsync'd jsonl writer.  Never
+  raises into the instrumented code: any IO failure marks the stream
+  broken and subsequent emits are dropped.
+- :class:`ResourceSampler` — a daemon thread sampling process RSS,
+  thread count, and device memory (``device.memory_stats()`` when the
+  jax backend is *already* initialized — the sampler must never be the
+  thing that dials a TPU) into gauges + ``sample`` events.
+- :func:`attach` — wire a stream + sampler onto a live
+  :class:`~.spans.Collector`; ``core.run`` does this for every
+  telemetric run, ``minimize.shrink`` for shrink sessions.
+- :func:`read_events` / :func:`replay` / :func:`render_tail` — the
+  torn-line-tolerant reader and the human renderer behind ``cli tail``
+  and the web ``/live`` views.
+- :class:`Heartbeat` — an atomically-replaced JSON state file for the
+  campaign scheduler's per-worker in-flight heartbeats
+  (``<store>/campaigns/<name>.live.json``), the data behind the live
+  fleet dashboard.
+
+Event shapes (one JSON object per line, ``t`` = epoch seconds)::
+
+    {"t": ..., "ev": "start", ...meta}
+    {"t": ..., "ev": "span-open", "name": "check:list-append", "tid": ...}
+    {"t": ..., "ev": "span", "name": ..., "dur_ns": ..., "attrs": {...}}
+    {"t": ..., "ev": "metrics", "counters": {"name{k=v}": value}, ...}
+    {"t": ..., "ev": "sample", "rss_bytes": ..., "threads": ...}
+    {"t": ..., "ev": "fault"|"retry"|"fallback"|"deadline", "site": ...}
+    {"t": ..., "ev": "end", ...}
+
+Metric events carry *changed instruments with their current values*
+(incremental updates, not raw increments): replaying every metrics
+event in order leaves the reader holding the final tallies, which is
+what ``cli tail``'s footer prints for a killed run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .export import _fmt_dur, _jsonable
+from .metrics import Registry
+
+__all__ = ["EventStream", "ResourceSampler", "Recorder", "Heartbeat",
+           "attach", "event", "read_events", "replay", "render_line",
+           "render_tail", "EVENTS_FILE", "SHRINK_EVENTS_FILE",
+           "events_path"]
+
+EVENTS_FILE = "events.jsonl"
+SHRINK_EVENTS_FILE = "events-shrink.jsonl"
+
+
+def events_path(dirpath: str) -> Optional[str]:
+    """The run dir's streamed-events file — whichever of the run's own
+    stream and the shrink session's was written to most recently, so
+    tailing a dir follows the LIVE activity (a `cli shrink` of an
+    already-ended telemetric run streams events-shrink.jsonl next to
+    the finished events.jsonl; preferring the run stream would replay
+    the ended run and exit instead of following the shrink).  Ties go
+    to the run's own stream.  THE lookup `cli tail` and the web
+    `/live` + link surfaces share, so they can't disagree about which
+    runs are followable."""
+    best: Optional[str] = None
+    best_mtime = float("-inf")
+    for fn in (EVENTS_FILE, SHRINK_EVENTS_FILE):
+        p = os.path.join(dirpath, fn)
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            continue
+        if mtime > best_mtime:
+            best, best_mtime = p, mtime
+    return best
+
+
+def _label_key(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    lbl = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{lbl}}}"
+
+
+class _MetricsDelta:
+    """Tracks last-streamed instrument values so each flush emits only
+    what changed since the previous one (with current values)."""
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        self._last: Dict[Tuple[str, str], Any] = {}
+
+    def changed(self) -> Optional[Dict[str, Dict[str, Any]]]:
+        snap = self.registry.snapshot()
+        out: Dict[str, Dict[str, Any]] = {}
+        for c in snap["counters"]:
+            k = ("c", _label_key(c["name"], c["labels"]))
+            if self._last.get(k) != c["value"]:
+                self._last[k] = c["value"]
+                out.setdefault("counters", {})[k[1]] = c["value"]
+        for g in snap["gauges"]:
+            if g["value"] is None:
+                continue
+            k = ("g", _label_key(g["name"], g["labels"]))
+            if self._last.get(k) != g["value"]:
+                self._last[k] = g["value"]
+                out.setdefault("gauges", {})[k[1]] = g["value"]
+        for h in snap["histograms"]:
+            k = ("h", _label_key(h["name"], h["labels"]))
+            cur = (h["count"], h["sum"])
+            if self._last.get(k) != cur:
+                self._last[k] = cur
+                out.setdefault("histograms", {})[k[1]] = {
+                    "count": h["count"], "sum": round(h["sum"], 6)}
+        return out or None
+
+
+class EventStream:
+    """Append-only fsync'd jsonl event sink.
+
+    Crash-safety contract: each event is one ``write()`` of a complete
+    line followed by ``fsync`` — a kill between the two leaves at most
+    one torn trailing line, which :func:`read_events` drops.  Emits
+    must NEVER raise into the instrumented run: any failure (disk full,
+    closed fd) marks the stream broken and later emits are no-ops."""
+
+    def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self.broken = False
+        self._metrics: Optional[_MetricsDelta] = None
+        try:
+            # one session per file: truncate any previous stream — a
+            # --force re-shrink appending after the old "end" event
+            # would make replay() render a killed re-run as ended,
+            # with counters mixed across sessions
+            self._f = open(path, "wb", buffering=0)
+        except OSError:
+            self._f = None
+            self.broken = True
+        self.emit("start", **{k: v for k, v in (meta or {}).items()
+                              if v is not None})
+
+    def bind_registry(self, registry: Registry) -> None:
+        """Attach the registry whose deltas :meth:`flush_metrics`
+        streams (the collector's own, for per-run isolation)."""
+        self._metrics = _MetricsDelta(registry)
+
+    def emit(self, ev: str, **fields: Any) -> None:
+        if self.broken:
+            return
+        rec: Dict[str, Any] = {"t": 0.0, "ev": ev}
+        rec.update(fields)
+        with self._lock:
+            if self.broken or self._f is None:
+                return
+            # stamp under the lock so file order and timestamps agree
+            rec["t"] = round(time.time(), 3)
+            try:
+                data = (json.dumps(_jsonable(rec), separators=(",", ":"))
+                        + "\n").encode()
+            except Exception:  # noqa: BLE001 — bad payload, stream fine
+                return
+            try:
+                self._f.write(data)
+                os.fsync(self._f.fileno())
+            except Exception:  # noqa: BLE001
+                self.broken = True
+
+    # -- collector-facing hooks (spans.Collector calls these) ---------------
+
+    def span_open(self, sp: Any) -> None:
+        self.emit("span-open", name=sp.name, tid=sp.tid,
+                  thread=sp.thread_name)
+
+    def span_close(self, sp: Any) -> None:
+        self.emit("span", name=sp.name, tid=sp.tid, dur_ns=sp.duration_ns,
+                  **({"attrs": _jsonable(sp.attrs)} if sp.attrs else {}))
+        # a span boundary is the natural metrics flush point: low-rate,
+        # and it lands the workload counters before the check phase — a
+        # run killed mid-check still shows its final op tallies
+        self.flush_metrics()
+
+    def flush_metrics(self) -> None:
+        if self._metrics is None or self.broken:
+            return
+        # compute-delta + emit must be one atomic step: two concurrent
+        # span closes could otherwise stream a stale snapshot AFTER a
+        # newer one, and replay() keeps the last value seen
+        with self._flush_lock:
+            try:
+                delta = self._metrics.changed()
+            except Exception:  # noqa: BLE001
+                return
+            if delta:
+                self.emit("metrics", **delta)
+
+    def close(self, **fields: Any) -> None:
+        self.emit("end", **fields)
+        with self._lock:
+            try:
+                if self._f is not None:
+                    self._f.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self.broken = True
+
+
+def event(ev: str, **fields: Any) -> None:
+    """Emit one event onto the ACTIVE collector's stream, if any — the
+    module-level hook resilience sites call (fault/retry/fallback/
+    deadline); a no-op for unstreamed/disabled telemetry."""
+    from . import spans
+
+    s = getattr(spans.active(), "stream", None)
+    if s is not None:
+        s.emit(ev, **fields)
+
+
+# ---------------------------------------------------------------------------
+# Resource sampler
+# ---------------------------------------------------------------------------
+
+def _rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001 — non-linux
+        try:
+            import resource
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss is kilobytes on Linux/BSD but BYTES on macOS
+            return rss if sys.platform == "darwin" else rss * 1024
+        except Exception:  # noqa: BLE001
+            return None
+
+
+def _device_memory() -> Dict[str, int]:
+    """Per-device bytes-in-use from ``device.memory_stats()``, with a
+    live-buffer-bytes fallback.  Only consulted when jax is imported
+    AND its backend is already initialized — ``jax.devices()`` on a
+    cold process would *dial* the backend (which can hang on a downed
+    TPU tunnel), and a sampler must never be the thing that does that."""
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return {}
+    try:
+        from jax._src import xla_bridge
+
+        if not getattr(xla_bridge, "_backends", None):
+            return {}
+    except Exception:  # noqa: BLE001 — unknown jax layout: stay safe
+        return {}
+    out: Dict[str, int] = {}
+    try:
+        for d in jx.devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:  # noqa: BLE001
+                ms = None
+            if ms and ms.get("bytes_in_use") is not None:
+                out[str(d)] = int(ms["bytes_in_use"])
+        if not out:
+            out["live-buffers"] = int(sum(
+                int(getattr(a, "nbytes", 0)) for a in jx.live_arrays()))
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+class ResourceSampler:
+    """Daemon thread sampling process/device resources into gauges +
+    ``sample`` events.  :meth:`start` samples once synchronously on the
+    caller's thread (so even an instant run records one, and a short
+    run never shares the GIL with a sampler tick — per-worker op-split
+    tests stay deterministic), then the thread waits a full interval
+    before its first tick; :meth:`stop` takes the final sample (the
+    state a post-mortem reads)."""
+
+    def __init__(self, stream: EventStream, registry: Registry,
+                 interval_s: float = 1.0):
+        self.stream = stream
+        self.registry = registry
+        self.interval_s = max(0.02, float(interval_s))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="telemetry-sampler")
+
+    def start(self) -> None:
+        try:
+            self.sample()
+        except Exception:  # noqa: BLE001 — sampling must never kill
+            pass
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            if self._stop.wait(self.interval_s):
+                return
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 — sampling must never kill
+                pass
+
+    def sample(self) -> None:
+        fields: Dict[str, Any] = {}
+        rss = _rss_bytes()
+        if rss is not None:
+            self.registry.gauge("process-rss-bytes").set(rss)
+            fields["rss_bytes"] = rss
+        n = threading.active_count()
+        self.registry.gauge("process-threads").set(n)
+        fields["threads"] = n
+        for dev, b in _device_memory().items():
+            self.registry.gauge("device-memory-bytes", device=dev).set(b)
+            fields.setdefault("device_bytes", {})[dev] = b
+        self.stream.emit("sample", **fields)
+        self.stream.flush_metrics()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.sample()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class Recorder:
+    """Handle returned by :func:`attach`: owns the stream + sampler
+    lifetime; ``close()`` detaches and finalizes (idempotent)."""
+
+    def __init__(self, collector: Any, stream: EventStream,
+                 sampler: Optional[ResourceSampler]):
+        self.collector = collector
+        self.stream = stream
+        self.sampler = sampler
+        self._closed = False
+
+    def close(self, **fields: Any) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.sampler is not None:
+            self.sampler.stop()
+        if getattr(self.collector, "stream", None) is self.stream:
+            self.collector.stream = None
+        self.stream.flush_metrics()
+        self.stream.close(**fields)
+
+
+def attach(collector: Any, dirpath: str, *,
+           meta: Optional[Dict[str, Any]] = None,
+           interval_s: float = 1.0,
+           filename: str = EVENTS_FILE,
+           sampler: bool = True) -> Recorder:
+    """Attach a flight-recorder stream (and resource sampler) to a live
+    collector; events land in ``<dirpath>/<filename>``.  Returns the
+    :class:`Recorder` whose ``close()`` the activator must call."""
+    s = EventStream(os.path.join(dirpath, filename), meta=meta)
+    reg = getattr(collector, "registry", None)
+    if reg is not None:
+        s.bind_registry(reg)
+    smp = None
+    if sampler and reg is not None:
+        smp = ResourceSampler(s, reg, interval_s)
+        smp.start()
+    collector.stream = s
+    return Recorder(collector, s, smp)
+
+
+# ---------------------------------------------------------------------------
+# Reading + rendering (cli tail, web /live)
+# ---------------------------------------------------------------------------
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse an events.jsonl, dropping a torn trailing line (crash
+    mid-append) and everything after the first unparsable record — the
+    same tolerance contract as the campaign ledger reader."""
+    out: List[Dict[str, Any]] = []
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return out
+    with f:
+        for line in f:
+            if not line.endswith(b"\n"):
+                break  # torn tail: a kill raced the write
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def read_events_incremental(
+        path: str, offset: int = 0) -> "tuple[List[Dict[str, Any]], int]":
+    """Parse complete event lines starting at byte ``offset``; returns
+    ``(events, new_offset)`` with ``new_offset`` just past the last line
+    consumed — the O(appended-bytes) cursor for following a live stream
+    (``read_events`` re-parses the whole file each call).  A torn
+    (unterminated) tail line is left unconsumed so the next poll retries
+    it once the writer finishes the append; a shrunken file means a new
+    session truncated the stream, so the cursor resets to 0 rather than
+    seeking past EOF forever; a complete-but-corrupt line is skipped —
+    it will never heal, and a follower must stay live past it."""
+    out: List[Dict[str, Any]] = []
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return out, offset
+    with f:
+        f.seek(0, os.SEEK_END)
+        if f.tell() < offset:
+            offset = 0
+        f.seek(offset)
+        for line in f:
+            if not line.endswith(b"\n"):
+                break  # torn tail: an append is in flight
+            try:
+                rec = json.loads(line) if line.strip() else None
+            except ValueError:
+                rec = None
+            offset += len(line)
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out, offset
+
+
+def replay(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold an event sequence into its end state: which spans are still
+    open (in open order), the final metric values, the last resource
+    sample, and resilience tallies.  This is what a post-mortem of a
+    killed run reads — and what the acceptance contract renders."""
+    state: Dict[str, Any] = {
+        "meta": {}, "open": [], "ended": False, "t0": None, "t_last": None,
+        "counters": {}, "gauges": {}, "histograms": {}, "sample": {},
+        "spans_closed": 0, "events": 0,
+        "faults": 0, "retries": 0, "fallbacks": 0, "deadlines": 0,
+    }
+    open_spans: List[Dict[str, Any]] = []
+    for e in events:
+        state["events"] += 1
+        t = e.get("t")
+        if t is not None:
+            if state["t0"] is None:
+                state["t0"] = t
+            state["t_last"] = t
+        ev = e.get("ev")
+        if ev == "start":
+            state["meta"] = {k: v for k, v in e.items()
+                             if k not in ("t", "ev")}
+        elif ev == "span-open":
+            open_spans.append({"name": e.get("name"), "tid": e.get("tid"),
+                               "t": t})
+        elif ev == "span":
+            state["spans_closed"] += 1
+            for i in range(len(open_spans) - 1, -1, -1):
+                if open_spans[i]["name"] == e.get("name") and \
+                        open_spans[i]["tid"] == e.get("tid"):
+                    del open_spans[i]
+                    break
+        elif ev == "metrics":
+            for sect in ("counters", "gauges", "histograms"):
+                state[sect].update(e.get(sect) or {})
+        elif ev == "sample":
+            state["sample"] = {k: v for k, v in e.items()
+                               if k not in ("t", "ev")}
+        elif ev in ("fault", "retry", "fallback", "deadline"):
+            key = "retries" if ev == "retry" else ev + "s"
+            state[key] += 1
+        elif ev == "end":
+            state["ended"] = True
+    state["open"] = open_spans
+    return state
+
+
+def _fmt_bytes(n: Any) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def _fmt_dur_ns(ns: Any) -> str:
+    return _fmt_dur(ns, fallback="?")
+
+
+def render_line(e: Dict[str, Any], t0: Optional[float] = None) -> str:
+    """One human-readable progress line per event."""
+    off = ""
+    if t0 is not None and isinstance(e.get("t"), (int, float)):
+        off = f"+{e['t'] - t0:8.3f}s "
+    ev = e.get("ev", "?")
+    if ev == "span-open":
+        return f"{off}open  {e.get('name')}"
+    if ev == "span":
+        attrs = e.get("attrs") or {}
+        extra = "".join(f" {k}={v}" for k, v in sorted(attrs.items())
+                        if k not in ("open",))
+        return (f"{off}span  {e.get('name')} "
+                f"{_fmt_dur_ns(e.get('dur_ns'))}{extra}")
+    if ev == "metrics":
+        parts = []
+        for sect in ("counters", "gauges"):
+            for k, v in sorted((e.get(sect) or {}).items()):
+                parts.append(f"{k}={v}")
+        for k, v in sorted((e.get("histograms") or {}).items()):
+            parts.append(f"{k}.count={v.get('count')}")
+        return f"{off}metrics {' '.join(parts[:8])}" + \
+            (" ..." if len(parts) > 8 else "")
+    if ev == "sample":
+        bits = []
+        if "rss_bytes" in e:
+            bits.append(f"rss={_fmt_bytes(e['rss_bytes'])}")
+        if "threads" in e:
+            bits.append(f"threads={e['threads']}")
+        for dev, b in sorted((e.get("device_bytes") or {}).items()):
+            bits.append(f"{dev}={_fmt_bytes(b)}")
+        return f"{off}sample {' '.join(bits)}"
+    if ev in ("fault", "retry", "fallback", "deadline"):
+        extra = " ".join(f"{k}={v}" for k, v in sorted(e.items())
+                         if k not in ("t", "ev"))
+        return f"{off}{ev:<6}{extra}"
+    extra = " ".join(f"{k}={v}" for k, v in sorted(e.items())
+                     if k not in ("t", "ev"))
+    return f"{off}{ev:<6}{extra}".rstrip()
+
+
+def render_tail(events: List[Dict[str, Any]],
+                limit: Optional[int] = None) -> str:
+    """The full ``cli tail`` rendering: recent event lines, then the
+    replayed end state — the still-open span chain (a killed run's
+    "where was it?") and the final counter/gauge values."""
+    st = replay(events)
+    t0 = st["t0"]
+    # limit=0 means "footer only" — lst[-0:] would be the whole list
+    shown = (events if limit is None
+             else events[-limit:] if limit > 0 else [])
+    lines = [render_line(e, t0) for e in shown]
+    if limit is not None and len(events) > limit:
+        lines.insert(0, f"... ({len(events) - limit} earlier events)")
+    lines.append("")
+    if st["ended"]:
+        lines.append("run ended cleanly")
+    elif st["open"]:
+        chain = " > ".join(str(s["name"]) for s in st["open"])
+        lines.append(f"open spans: {chain}")
+        last = st["open"][-1]
+        age = ""
+        if isinstance(st["t_last"], (int, float)) and \
+                isinstance(last.get("t"), (int, float)):
+            age = f" (open {st['t_last'] - last['t']:.1f}s at last event)"
+        lines.append(f"last open span: {last['name']}{age}")
+    else:
+        lines.append("no open spans (stream truncated before close?)")
+    if st["faults"] or st["retries"] or st["fallbacks"] or st["deadlines"]:
+        lines.append(f"resilience: {st['faults']} faults, "
+                     f"{st['retries']} retries, {st['fallbacks']} "
+                     f"fallbacks, {st['deadlines']} deadline expiries")
+    if st["counters"]:
+        lines.append("counters:")
+        for k, v in sorted(st["counters"].items()):
+            lines.append(f"  {k} = {v}")
+    if st["gauges"]:
+        lines.append("gauges:")
+        for k, v in sorted(st["gauges"].items()):
+            lines.append(f"  {k} = {v}")
+    if st["histograms"]:
+        lines.append("histograms:")
+        for k, v in sorted(st["histograms"].items()):
+            lines.append(f"  {k} count={v.get('count')} sum={v.get('sum')}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat: atomic JSON state for live fleet dashboards
+# ---------------------------------------------------------------------------
+
+class Heartbeat:
+    """Atomically-replaced JSON state file (`tmp` + ``os.replace``) the
+    campaign scheduler updates as workers pick up / finish runs — the
+    in-flight counterpart of the append-only ledger.  Readers (the web
+    ``/campaign/<name>/live`` view, ``campaign status``) always see a
+    complete document; a killed campaign leaves its last state behind,
+    naming exactly the cells that were in flight.
+
+    Writes are throttled to one per ``min_interval_s`` except when
+    forced (close, and every worker-slot transition forces — those are
+    the edges a dashboard cares about).
+
+    No-raise guarantee: heartbeats are best-effort observability — the
+    ledger is the record — so no public method ever raises; callers
+    (the campaign scheduler's worker loop) rely on this and do not
+    wrap their calls."""
+
+    def __init__(self, path: str, *, campaign: Optional[str] = None,
+                 total: int = 0, done: int = 0,
+                 min_interval_s: float = 0.5):
+        self.path = path
+        self._lock = threading.Lock()
+        self._last_write = 0.0
+        self.min_interval_s = float(min_interval_s)
+        self.state: Dict[str, Any] = {
+            "campaign": campaign, "total": int(total), "done": int(done),
+            "workers": {}, "updated": None, "finished": False,
+        }
+        self.write(force=True)
+
+    def worker(self, worker_id: str,
+               state: Optional[Dict[str, Any]]) -> None:
+        """Set (or clear, with None) one worker's in-flight state."""
+        with self._lock:
+            if state is None:
+                self.state["workers"].pop(str(worker_id), None)
+            else:
+                self.state["workers"][str(worker_id)] = dict(
+                    state, since=state.get("since", round(time.time(), 3)))
+        self.write(force=True)
+
+    def record_done(self, run_id: str, valid: Any = None) -> None:
+        with self._lock:
+            self.state["done"] = int(self.state.get("done", 0)) + 1
+            self.state["last"] = {"run": run_id, "valid?": valid}
+        self.write()
+
+    def write(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_write < self.min_interval_s:
+                return
+            self._last_write = now
+            self.state["updated"] = round(time.time(), 3)
+            # tmp write + replace stay under the lock: the tmp path is
+            # shared, so an unlocked writer pair could publish the
+            # other's half-written inode via os.replace
+            tmp = self.path + ".tmp"
+            try:
+                doc = json.dumps(_jsonable(self.state), indent=1)
+                os.makedirs(os.path.dirname(self.path) or ".",
+                            exist_ok=True)
+                with open(tmp, "w") as f:
+                    f.write(doc)
+                os.replace(tmp, self.path)
+            except Exception:  # noqa: BLE001 — see no-raise guarantee
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            self.state["workers"] = {}
+            self.state["finished"] = True
+        self.write(force=True)
+
+    @staticmethod
+    def load(path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None

@@ -14,6 +14,7 @@ import json
 import logging
 import os
 import threading
+import time
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
@@ -55,6 +56,11 @@ def _run_summary(d: str) -> Dict[str, Any]:
 
 
 #: shared badge CSS — every page that renders verdict cells embeds it
+# /live pages stop auto-refreshing after this much write silence —
+# crashed runs never emit "end", and the refresh re-parses the whole
+# stream server-side each time
+_LIVE_STALE_S = 300.0
+
 _BADGE_CSS = """
 .b { padding: 1px 7px; border-radius: 3px; white-space: nowrap; }
 .b-true { background: #9ce29c; }
@@ -136,6 +142,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._zip(path[len("/zip/"):])
             if path.startswith("/telemetry/"):
                 return self._telemetry(path[len("/telemetry/"):])
+            if path.startswith("/live/"):
+                return self._live(path[len("/live/"):])
             if path.startswith("/run/"):
                 rel = path[len("/run/"):]
                 if rel.rstrip("/").endswith("/witness"):
@@ -145,7 +153,12 @@ class _Handler(BaseHTTPRequestHandler):
             if path in ("/campaigns", "/campaigns/"):
                 return self._campaigns()
             if path.startswith("/campaign/"):
-                return self._campaign(path[len("/campaign/"):])
+                rel = path[len("/campaign/"):].rstrip("/")
+                if rel.endswith("/live"):
+                    return self._campaign_live(rel[:-len("/live")])
+                if rel.endswith("/witness-diff"):
+                    return self._witness_diff(rel[:-len("/witness-diff")])
+                return self._campaign(rel)
             self._send(404, b"not found", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
             pass
@@ -154,13 +167,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(500, f"error: {e}".encode(), "text/plain")
 
     def _index(self):
+        from .telemetry import stream as tel_stream
+
         rows = []
         for d in store.tests(base=self.base):
             s = _run_summary(d)
             rel = os.path.relpath(d, self.base)
-            tel = (f'<td><a href="/telemetry/{quote(rel)}">trace</a></td>'
-                   if os.path.exists(os.path.join(d, "telemetry.json"))
-                   else "<td></td>")
+            links = []
+            if os.path.exists(os.path.join(d, "telemetry.json")):
+                links.append(f'<a href="/telemetry/{quote(rel)}">trace</a>')
+            if tel_stream.events_path(d):
+                # in-flight (or killed) streaming runs have events but
+                # possibly no exported telemetry yet — the live view is
+                # how those are inspected at all
+                links.append(f'<a href="/live/{quote(rel)}">live</a>')
+            tel = f"<td>{' '.join(links)}</td>"
             rows.append(
                 "<tr>"
                 f'<td><a href="/run/{quote(rel)}">{html.escape(s["name"])}</a></td>'
@@ -203,6 +224,9 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
         tel = (f'&middot; <a href="/telemetry/{quote(rel)}">telemetry</a> '
                if os.path.exists(os.path.join(p, "telemetry.json"))
                else "")
+        from .telemetry import stream as tel_stream
+        live = (f'&middot; <a href="/live/{quote(rel)}">live</a> '
+                if tel_stream.events_path(p) else "")
         wit = (f'&middot; <a href="/run/{quote(rel)}/witness">witness</a> '
                if os.path.exists(os.path.join(p, "witness.json"))
                else "")
@@ -214,7 +238,7 @@ pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}
 <p><a href="/">&larr; runs</a></p>
 <h2>{html.escape(s["name"])} <small>{html.escape(s["timestamp"])}</small>
 {_verdict_badges(s["valid?"], s["error"], s["degraded"], s["deadline"])}</h2>
-<p><a href="/files/{quote(rel)}/">files</a> {tel}{wit}&middot;
+<p><a href="/files/{quote(rel)}/">files</a> {tel}{live}{wit}&middot;
 <a href="/zip/{quote(rel)}">zip</a></p>
 <pre>{html.escape(results or "no results.json (run still in flight, "
                              "or it crashed before analysis)")}</pre>
@@ -405,7 +429,9 @@ table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
 td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 a {{ text-decoration: none; }}
 {_BADGE_CSS}</style></head><body>
-<p><a href="/campaigns">&larr; campaigns</a></p>
+<p><a href="/campaigns">&larr; campaigns</a> &middot;
+<a href="/campaign/{quote(name)}/live">live</a> &middot;
+<a href="/campaign/{quote(name)}/witness-diff">witness diff</a></p>
 <h1>campaign {html.escape(name)}</h1>
 <table><tr><th>workload</th><th>fault</th>{head}</tr>
 {"".join(rows)}</table>
@@ -469,6 +495,206 @@ pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}</style>
 <a href="/files/{quote(rel)}/trace.json">trace.json</a>
 (open in <a href="https://ui.perfetto.dev">ui.perfetto.dev</a>)</p>
 {hist_html}<pre>{html.escape(summary)}</pre></body></html>"""
+        self._send(200, doc.encode())
+
+    def _live(self, rel: str):
+        """Live run view (the flight recorder, docs/TELEMETRY.md): the
+        streamed events.jsonl rendered as progress lines + the replayed
+        end state (open-span chain, resource gauges, counters).  Auto-
+        refreshes while the run is in flight; a crashed/killed run
+        shows its partial trace — this page exists precisely for runs
+        that never reached store.save_1."""
+        from .telemetry import stream as tel_stream
+
+        rel = rel.rstrip("/")
+        p = self._safe_path(rel)
+        if p is None or not os.path.isdir(p):
+            return self._send(404, b"no such run", "text/plain")
+        path = tel_stream.events_path(p)
+        if path is None:
+            return self._send(404, b"no events.jsonl for this run (run "
+                              b"with --telemetry to stream)", "text/plain")
+        evs = tel_stream.read_events(path)
+        st = tel_stream.replay(evs)
+        t0 = st["t0"]
+        lines = [tel_stream.render_line(e, t0) for e in evs[-60:]]
+        # stop auto-refreshing once the stream goes quiet: a crashed
+        # run never emits "end", and a forgotten tab re-parsing an
+        # unbounded events.jsonl every 2 s forever is pure waste
+        try:
+            stale = time.time() - os.path.getmtime(path) > _LIVE_STALE_S
+        except OSError:
+            stale = True
+        refresh = ("" if st["ended"] or stale else
+                   '<meta http-equiv="refresh" content="2">')
+        if st["ended"]:
+            status = '<span class="b b-true">ended</span>'
+        elif st["open"]:
+            chain = " &gt; ".join(html.escape(str(s["name"]))
+                                  for s in st["open"])
+            badge = ('<span class="b b-other">stream idle</span>'
+                     if stale else
+                     '<span class="b b-unknown">in flight</span>')
+            status = f"{badge} open: <code>{chain}</code>"
+        else:
+            status = '<span class="b b-other">stream truncated</span>'
+        if not st["ended"] and stale:
+            status += (f" &middot; no events for &gt;{_LIVE_STALE_S:.0f}s"
+                       " — auto-refresh off (reload to re-check)")
+        counters = "".join(
+            f"<tr><td><code>{html.escape(k)}</code></td><td>{v}</td></tr>"
+            for k, v in sorted(st["counters"].items()))
+        gauges = "".join(
+            f"<tr><td><code>{html.escape(k)}</code></td><td>{v}</td></tr>"
+            for k, v in sorted(st["gauges"].items()))
+        metric_html = ""
+        if counters or gauges:
+            metric_html = (
+                "<h2>metrics (latest streamed values)</h2>"
+                "<table><tr><th>instrument</th><th>value</th></tr>"
+                + counters + gauges + "</table>")
+        res = ""
+        if st["faults"] or st["retries"] or st["fallbacks"] or \
+                st["deadlines"]:
+            res = (f"<p>resilience: {st['faults']} faults, "
+                   f"{st['retries']} retries, {st['fallbacks']} "
+                   f"fallbacks, {st['deadlines']} deadline expiries</p>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+{refresh}<title>live — {html.escape(rel)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 3px 8px; }}
+pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/run/{quote(rel)}">&larr; run</a> &middot;
+<a href="/files/{quote(rel)}/">files</a></p>
+<h1>live — {html.escape(st["meta"].get("name") or rel)}</h1>
+<p>{status} &middot; {st["events"]} events, {st["spans_closed"]} spans
+closed</p>{res}{metric_html}
+<h2>event tail</h2>
+<pre>{html.escape(chr(10).join(lines))}</pre>
+</body></html>"""
+        self._send(200, doc.encode())
+
+    def _campaign_live(self, name: str):
+        """Live fleet dashboard: the scheduler's heartbeat state file —
+        which runs each worker holds right now, done/total progress —
+        next to the latest indexed verdicts.  Auto-refreshes until the
+        campaign's heartbeat says finished."""
+        from .campaign.core import live_path
+        from .telemetry import Heartbeat
+
+        name = unquote(name).rstrip("/")
+        path = self._safe_path(os.path.relpath(
+            live_path(name, self.base), self.base))
+        hb = Heartbeat.load(path) if path else None
+        if hb is None:
+            return self._send(404, b"no live state for this campaign "
+                              b"(never run, or pre-flight-recorder)",
+                              "text/plain")
+        # same stale guard as /live/<rel>: a killed scheduler never
+        # writes finished=True, and its dashboard must not refresh
+        # forever
+        upd = hb.get("updated")
+        stale = (not isinstance(upd, (int, float))
+                 or time.time() - upd > _LIVE_STALE_S)
+        refresh = ("" if hb.get("finished") or stale else
+                   '<meta http-equiv="refresh" content="2">')
+        total = hb.get("total") or 0
+        done = hb.get("done") or 0
+        pct = f" ({100.0 * done / total:.0f}%)" if total else ""
+        wrows = []
+        now = time.time()
+        for wid, w in sorted((hb.get("workers") or {}).items()):
+            age = (f"{now - w['since']:.1f}s"
+                   if isinstance(w.get("since"), (int, float)) else "?")
+            wrows.append(
+                f"<tr><td>{html.escape(wid)}</td>"
+                f"<td><code>{html.escape(str(w.get('run')))}</code></td>"
+                f"<td>{html.escape(str(w.get('workload')))}</td>"
+                f"<td>{html.escape(str(w.get('fault')))}</td>"
+                f"<td>{html.escape(str(w.get('seed')))}</td>"
+                f"<td>{html.escape(str(w.get('slot')))}</td>"
+                f"<td>{age}</td></tr>")
+        workers = ("<table><tr><th>worker</th><th>run</th><th>workload</th>"
+                   "<th>fault</th><th>seed</th><th>slot</th><th>running "
+                   "for</th></tr>" + "".join(wrows) + "</table>"
+                   if wrows else "<p>(no runs in flight)</p>")
+        last = hb.get("last") or {}
+        last_html = ""
+        if last.get("run"):
+            last_html = (f"<p>last finished: <code>"
+                         f"{html.escape(str(last['run']))}</code> "
+                         f"{_verdict_badges(last.get('valid?'))}</p>")
+        state = ("finished" if hb.get("finished")
+                 else "stalled? (heartbeat idle — auto-refresh off)"
+                 if stale else "running")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+{refresh}<title>live — campaign {html.escape(name)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/campaign/{quote(name)}">&larr; campaign</a></p>
+<h1>campaign {html.escape(name)} — live</h1>
+<p>{state}: {done}/{total} runs done{pct}</p>
+{last_html}<h2>in flight</h2>{workers}
+</body></html>"""
+        self._send(200, doc.encode())
+
+    def _witness_diff(self, name: str):
+        """Witness drift across campaign generations (ROADMAP open
+        item): per regression key, how the auto-shrunk minimal witness
+        changed between consecutive generations — op count, digest, and
+        anomaly-set deltas.  A changed digest under an unchanged spec
+        means the minimal repro MOVED: a different failure, even when
+        the verdict grid still just shows False."""
+        from .campaign.index import Index
+
+        name = unquote(name).rstrip("/")
+        path = self._safe_path(os.path.join("campaigns", name + ".jsonl"))
+        if path is None or not os.path.exists(path):
+            return self._send(404, b"no such campaign", "text/plain")
+        diffs = Index(path).witness_diffs()
+        rows = []
+        for d in diffs:
+            digest = ("changed" if d["digest-changed"] else "same")
+            style = ' style="background:#ffe9c9"' if d["changed"] else ""
+            anoms = []
+            for a in d["anomalies-added"]:
+                anoms.append(f"+{a}")
+            for a in d["anomalies-removed"]:
+                anoms.append(f"&minus;{a}")
+            rows.append(
+                f"<tr{style}><td><code>{html.escape(str(d['key']))}"
+                f"</code></td>"
+                f"<td>{html.escape(str(d['from-gen']))} &rarr; "
+                f"{html.escape(str(d['to-gen']))}</td>"
+                f"<td>{d['from-ops']} &rarr; {d['to-ops']} "
+                f"({d['ops-delta']:+d})</td>"
+                f"<td>{digest}</td>"
+                f"<td>{html.escape(' '.join(anoms)) or '-'}</td>"
+                f"<td><code>{html.escape(str(d['from-digest'])[:12])} "
+                f"&rarr; {html.escape(str(d['to-digest'])[:12])}"
+                f"</code></td></tr>")
+        body = ("<table><tr><th>key</th><th>generations</th><th>ops</th>"
+                "<th>digest</th><th>anomaly deltas</th><th>digests</th>"
+                "</tr>" + "".join(rows) + "</table>" if rows else
+                "<p>no witness pairs yet — witness diffs need the same "
+                "key auto-shrunk (<code>\"shrink\": true</code>) in at "
+                "least two campaign generations (<code>--rerun</code>)."
+                "</p>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>witness diff — {html.escape(name)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/campaign/{quote(name)}">&larr; campaign</a></p>
+<h1>witness diff — {html.escape(name)}</h1>
+<p>how each key's minimal witness moved between consecutive
+generations (highlighted rows changed)</p>
+{body}</body></html>"""
         self._send(200, doc.encode())
 
     def _files(self, rel: str):

@@ -157,6 +157,47 @@ def run_one(seed: int, p: float, deadline_s: float) -> dict:
                and str(op.error or "").startswith("fault-injected")]
     row["injected"] += len(p1.injected) + len(p3.injected)
     row["client-infos"] = row.get("client-infos", 0) + len(crashed)
+
+    # --- flight recorder under chaos (ISSUE 5 satellite) ---------------
+    # every faulted / deadline-killed TELEMETRIC run must still leave a
+    # well-formed (tail-truncated at worst) events.jsonl: parseable,
+    # replayable, with a fault event for every injection the plan made
+    import tempfile
+
+    from jepsen_tpu import store
+    from jepsen_tpu.telemetry import stream as tel_stream
+    from jepsen_tpu.workloads.append import AppendChecker
+
+    base = tempfile.mkdtemp(prefix="fuzz-recorder-")
+    plan_r = FaultPlan(seed=seed + 5, p=0.4,
+                       kinds=("oom", "xla", "stall"), stall_s=0.001)
+    test = jcore.noop_test(
+        name="recorder-chaos", concurrency=2, client=MemClient(),
+        generator=g.clients(g.limit(
+            24, synth.la_generator(n_keys=3,
+                                   rng=_random.Random(seed + 5)))),
+        checker=AppendChecker(), telemetry=True, faults=plan_r)
+    test["store-dir"] = base
+    if seed % 3 == 0:
+        # some rounds are deadline-killed mid-analysis on purpose
+        test["checker-time-limit"] = 0.0
+    done = jcore.run(test)
+    assert "valid?" in (done.get("results") or {}), \
+        "recorder-chaos run lost its verdict"
+    d = store.test_dir(done)
+    evs = tel_stream.read_events(os.path.join(d, "events.jsonl"))
+    assert evs and evs[0]["ev"] == "start", "events.jsonl unreadable"
+    st = tel_stream.replay(evs)
+    assert st["ended"], "completed run must close its event stream"
+    assert st["faults"] == len(plan_r.injected), \
+        f"streamed {st['faults']} fault events, plan injected " \
+        f"{len(plan_r.injected)}"
+    if seed % 3 == 0:
+        assert st["deadlines"] >= 1 or \
+            done["results"].get("error") is None, \
+            "deadline-killed run streamed no deadline event"
+    tel_stream.render_tail(evs)  # renders without crashing
+    row["events"] = st["events"]
     return row
 
 

@@ -423,6 +423,26 @@ def test_campaign_kill_and_resume(tmp_path):
         {r.run_id for r in expand(spec)}
 
 
+def test_campaign_interrupt_preserves_live_state(tmp_path, monkeypatch):
+    """An interrupted run_campaign (Ctrl-C mid-fleet) must NOT mark the
+    heartbeat finished — a killed campaign's live.json is the
+    post-mortem naming exactly the cells that were in flight."""
+    base = str(tmp_path)
+
+    def interrupted(self, *a, **kw):
+        self.heartbeat.worker("campaign-worker-0",
+                              {"run": "r-inflight", "slot": 0})
+        raise KeyboardInterrupt
+
+    monkeypatch.setattr(Scheduler, "run", interrupted)
+    with pytest.raises(KeyboardInterrupt):
+        campaign.run_campaign(dict(SPEC, name="intr", seeds=[0]), base)
+    doc = json.load(open(ccore.live_path("intr", base)))
+    assert doc["finished"] is False
+    assert "campaign-worker-0" in doc["workers"]
+    assert doc["workers"]["campaign-worker-0"]["run"] == "r-inflight"
+
+
 def test_campaign_status(campaign_store, capsys):
     base, spec_path, _ = campaign_store
     rc = cli.run(cli.single_test_cmd(lambda o: o),

@@ -2,6 +2,7 @@
 
 import json
 import os
+import time
 import urllib.request
 import zipfile
 
@@ -276,6 +277,169 @@ def test_web_telemetry_percentile_table(tmp_path):
         assert b"latency percentiles" in body
         assert b"demo-latency-s" in body
         assert b"p50" in body and b"p99" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_cli_tail_smoke(tmp_path, capsys):
+    """`cli tail <run-dir>` (ISSUE 5): renders the streamed
+    events.jsonl with the open-span / final-counter footer."""
+    base = str(tmp_path / "s")
+    t = core.run(_test_fn({"store-dir": base, "telemetry": True}))
+    d = store.test_dir(t)
+    assert os.path.exists(os.path.join(d, "events.jsonl"))
+    rc = cli.run(cli.single_test_cmd(_test_fn), ["tail", d])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "run ended cleanly" in out
+    assert "workload" in out and "interpreter-ops" in out
+    # -n limits the event lines
+    rc = cli.run(cli.single_test_cmd(_test_fn), ["tail", d, "-n", "2"])
+    assert rc == 0
+    assert "earlier events" in capsys.readouterr().out
+    # -n 0 is footer-only, not everything (lst[-0:] is the whole list)
+    rc = cli.run(cli.single_test_cmd(_test_fn), ["tail", d, "-n", "0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "run ended cleanly" in out and "open  " not in out
+    # an unstreamed run dir gets a clean error, not a stack trace
+    t2 = core.run(_test_fn({"store-dir": str(tmp_path / "s2")}))
+    rc = cli.run(cli.single_test_cmd(_test_fn),
+                 ["tail", store.test_dir(t2)])
+    assert rc == 2
+    assert "events.jsonl" in capsys.readouterr().err
+
+
+def test_cli_tail_follow_exits_on_end_mid_batch(tmp_path, capsys):
+    """`tail -f` must exit when "end" is not the poll batch's LAST
+    event — a sampler tick racing the recorder's close can append one
+    straggler line after it."""
+    import threading
+
+    from jepsen_tpu.telemetry import stream as tel_stream
+
+    d = str(tmp_path / "r")
+    os.makedirs(d)
+    s = tel_stream.EventStream(os.path.join(d, "events.jsonl"))
+    s.emit("span-open", name="run", tid=1)
+    s.emit("end", valid=True)
+    s.emit("sample", gauges={"process-rss-bytes": 1})  # straggler
+    rc = {}
+    th = threading.Thread(
+        target=lambda: rc.setdefault("rc", cli.run(
+            cli.single_test_cmd(_test_fn), ["tail", d, "-f"])),
+        daemon=True)
+    th.start()
+    th.join(timeout=15)
+    assert not th.is_alive(), "tail -f never saw the mid-batch end"
+    assert rc["rc"] == 0
+
+
+def test_web_live_run_page(tmp_path):
+    """/live/<rel> (ISSUE 5): the auto-refreshing in-flight view —
+    ended runs render statically, missing streams 404."""
+    import urllib.error
+
+    base = str(tmp_path / "s")
+    t = core.run(_test_fn({"store-dir": base, "telemetry": True}))
+    srv = web.serve(port=0, base=base, background=True)
+    try:
+        port = srv.server_address[1]
+        rel = os.path.relpath(store.test_dir(t), base)
+        status, _, body = _get(port, f"/live/{rel}")
+        assert status == 200
+        assert b"ended" in body and b"event tail" in body
+        assert b"http-equiv" not in body  # finished: no auto-refresh
+        # the index and run pages link to it
+        status, _, body = _get(port, "/")
+        assert status == 200 and b"/live/" in body
+        status, _, body = _get(port, f"/run/{rel}")
+        assert status == 200 and b"/live/" in body
+        # an in-flight (still-open) stream auto-refreshes and names
+        # the open span chain
+        d2 = os.path.join(base, "cli-test", "20990101T000000.000Z")
+        os.makedirs(d2)
+        from jepsen_tpu.telemetry import stream as tel_stream
+
+        s = tel_stream.EventStream(os.path.join(d2, "events.jsonl"))
+        s.emit("span-open", name="run", tid=1)
+        s.emit("span-open", name="check:wedged", tid=1)
+        rel2 = os.path.relpath(d2, base)
+        status, _, body = _get(port, f"/live/{rel2}")
+        assert status == 200
+        assert b"http-equiv" in body  # refreshing
+        assert b"check:wedged" in body and b"in flight" in body
+        # a long-quiet stream (crashed run that never emits "end")
+        # stops auto-refreshing but keeps the open-span post-mortem
+        old = time.time() - 3600
+        os.utime(os.path.join(d2, "events.jsonl"), (old, old))
+        status, _, body = _get(port, f"/live/{rel2}")
+        assert status == 200
+        assert b"http-equiv" not in body
+        assert b"stream idle" in body and b"check:wedged" in body
+        try:
+            status, _, _ = _get(port, "/live/nope")
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 404
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_web_campaign_live_and_witness_diff(tmp_path):
+    """/campaign/<name>/live + /campaign/<name>/witness-diff (ISSUE 5):
+    the fleet heartbeat dashboard and the cross-generation witness
+    comparison."""
+    import urllib.error
+
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.campaign.core import live_path
+    from jepsen_tpu.campaign.index import Index
+
+    base = str(tmp_path / "s")
+    os.makedirs(os.path.join(base, "campaigns"))
+    hb = telemetry.Heartbeat(live_path("demo", base), campaign="demo",
+                             total=4, done=1, min_interval_s=0.0)
+    hb.worker("campaign-worker-0", {"run": "run-abc", "workload":
+                                    "append", "fault": "nofault",
+                                    "seed": 3, "slot": 0})
+    idx = Index(os.path.join(base, "campaigns", "demo.jsonl"))
+    for gen, ops, dig in (("g1", 6, "aaa"), ("g2", 4, "bbb")):
+        idx.append({"run": "r1", "key": "append|f|0", "valid?": False,
+                    "gen": gen, "witness": {"ops": ops, "digest": dig,
+                                            "anomaly-types": ["G1c"]}})
+    srv = web.serve(port=0, base=base, background=True)
+    try:
+        port = srv.server_address[1]
+        status, _, body = _get(port, "/campaign/demo/live")
+        assert status == 200
+        assert b"run-abc" in body and b"1/4" in body
+        assert b"http-equiv" in body  # not finished: refreshing
+        # a killed scheduler never writes finished=True: once the
+        # heartbeat goes stale the dashboard stops auto-refreshing
+        hb.state["updated"] = time.time() - 3600
+        doc = json.dumps(hb.state)
+        with open(live_path("demo", base), "w") as f:
+            f.write(doc)
+        status, _, body = _get(port, "/campaign/demo/live")
+        assert status == 200
+        assert b"http-equiv" not in body and b"stalled?" in body
+        status, _, body = _get(port, "/campaign/demo/witness-diff")
+        assert status == 200
+        assert b"append|f|0" in body
+        assert b"6 &rarr; 4" in body and b"changed" in body
+        # the campaign page links to both
+        status, _, body = _get(port, "/campaign/demo")
+        assert status == 200
+        assert b"/campaign/demo/live" in body
+        assert b"/campaign/demo/witness-diff" in body
+        try:
+            status, _, _ = _get(port, "/campaign/nope/live")
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 404
     finally:
         srv.shutdown()
         srv.server_close()

@@ -219,6 +219,71 @@ def test_shrink_telemetry_round_spans(tmp_path):
     assert hists and hists[0]["count"] > 0
 
 
+def _rw_txn(p, inv, ok):
+    from jepsen_tpu.history.ops import Op
+
+    return [Op(type="invoke", process=p, f="txn", value=inv),
+            Op(type="ok", process=p, f="txn", value=ok)]
+
+
+def rw_g1c_history():
+    """A tiny invalid rw-register history: a pure wr-edge cycle (G1c)
+    between two txns, plus droppable filler."""
+    from jepsen_tpu.history.ops import history
+
+    ops = []
+    for i, p in enumerate((2, 3, 4)):
+        v = 500 + i
+        ops += _rw_txn(p, [["w", 2 + (i % 2), v]],
+                       [["w", 2 + (i % 2), v]])
+    ops += _rw_txn(0, [["w", 0, 100], ["r", 1, None]],
+                   [["w", 0, 100], ["r", 1, 200]])
+    ops += _rw_txn(1, [["w", 1, 200], ["r", 0, None]],
+                   [["w", 1, 200], ["r", 0, 100]])
+    return history(ops)
+
+
+def test_rw_host_equivalent_twin_matches_device():
+    """ISSUE 5 satellite (ROADMAP open item): rw-register now has a
+    host probe twin — `use_device=False` through the same exact host
+    inference, so many-small shrink probes skip the per-shape jit."""
+    from jepsen_tpu.minimize import probe as probe_mod
+    from jepsen_tpu.workloads.wr import WrChecker
+
+    chk = WrChecker()
+    twin = probe_mod.host_equivalent(chk)
+    assert twin is not None
+    assert twin.name() == "rw-register-host"
+    for h in (synth.rw_history(n_txns=30, seed=2), rw_g1c_history()):
+        dev = chk.check({}, h, {})
+        host = twin.check({}, h, {})
+        assert host["valid?"] == dev["valid?"]
+        assert sorted(host.get("anomaly-types") or []) == \
+            sorted(dev.get("anomaly-types") or [])
+
+
+def test_shrink_rw_with_host_oracle_uses_twin(tmp_path):
+    from jepsen_tpu.checkers.elle import rw_register
+    from jepsen_tpu.workloads.wr import WrChecker
+
+    h = rw_g1c_history()
+    base = str(tmp_path / "s")
+    test = jcore.noop_test(name="rw-inv")
+    test["store-dir"] = base
+    test["history"] = h
+    store.save_0(test)
+    test["results"] = rw_register.check(h)
+    store.save_1(test)
+    d = store.test_dir(test)
+
+    s = minimize.shrink(d, checker=WrChecker(), host_oracle=True)
+    assert s["valid?"] is False
+    assert s["probe-checker"] == "rw-register-host"
+    assert s["checker"] == "rw-register"  # confirm ran the original
+    assert "G1c" in s["anomaly-types"]
+    assert s["ops"] == 4  # exactly the two wr-cycle txns survive
+
+
 def test_rw_register_probes_classified_device():
     """Review regression: WrChecker must carry the canonical
     "rw-register" name so shrink probes of rw runs serialize through

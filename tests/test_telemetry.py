@@ -260,9 +260,12 @@ def test_run_span_tree_matches_phases(tmp_path):
     counters = {(c["name"], c["labels"].get("worker"),
                  c["labels"].get("type")): c["value"]
                 for c in doc["metrics"]["counters"]}
-    assert counters[("interpreter-ops", "0", "invoke")] == 6
-    assert counters[("interpreter-ops", "0", "ok")] == 6
-    assert counters[("interpreter-ops", "1", "ok")] == 6
+    # ops are handed to whichever worker asks first, so the per-worker
+    # split is scheduling-dependent — assert the labeled totals instead
+    op_keys = [k for k in counters if k[0] == "interpreter-ops"]
+    assert all(w in ("0", "1") for _, w, _ in op_keys)
+    assert sum(counters[k] for k in op_keys if k[2] == "invoke") == 12
+    assert sum(counters[k] for k in op_keys if k[2] == "ok") == 12
     assert ("generator-stall-ns", None, None) in counters
     gauges = {c["name"]: c["value"] for c in doc["metrics"]["gauges"]}
     assert gauges["interpreter-concurrency"] == 2
