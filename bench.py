@@ -73,7 +73,11 @@ def _init_backend():
     """Initialize a jax backend: probe the default (TPU via axon),
     re-probing across a retry window (BENCH_TPU_RETRY_S — the tunnel
     flaps on the scale of minutes, r01-r03 evidence), then fall back to
-    CPU.  Returns (platform, error_or_None)."""
+    CPU.  Returns (platform, anomaly_or_None) where the anomaly is a
+    STRUCTURED dict ({error, probes, wait_s}) — r05 recorded a 544 s
+    backend-init hang only as a free-text field; the structured form
+    feeds the resilience-env-anomalies counter, the warehouse, and
+    /metrics (ISSUE 6 satellite)."""
     if os.environ.get("BENCH_FORCE_CPU"):
         _force_cpu_backend()
         import jax
@@ -96,7 +100,13 @@ def _init_backend():
             # by the deadline watchdog in main()
             import jax
 
-            return jax.devices()[0].platform, None
+            anomaly = None
+            if n_probes > 1:  # recovered, but only after failed probes
+                anomaly = {"error": "recovered after failed probes",
+                           "probes": n_probes,
+                           "wait_s": round(time.monotonic() - t_start, 1),
+                           "recovered": True}
+            return jax.devices()[0].platform, anomaly
         elapsed = time.monotonic() - t_start
         if elapsed >= retry_window:
             break
@@ -106,8 +116,9 @@ def _init_backend():
     _force_cpu_backend()
     import jax
 
-    return jax.devices()[0].platform, f"{last_err} ({n_probes} probes " \
-        f"over {time.monotonic() - t_start:.0f}s)"
+    return jax.devices()[0].platform, {
+        "error": last_err, "probes": n_probes,
+        "wait_s": round(time.monotonic() - t_start, 1)}
 
 
 _BEST = [None]  # best completed rung payload; single-slot atomic rebind
@@ -258,6 +269,31 @@ def _run_size(n_txns: int, repeats: int):
     }
 
 
+def _ingest_warehouse(payload):
+    """Best-effort: land the completed bench payload in the store's
+    sqlite warehouse (ISSUE 6) so the throughput trajectory is a
+    queryable series, not loose BENCH_*.json files.  Target:
+    BENCH_WAREHOUSE (explicit opt-in), else <cwd>/store/
+    warehouse.sqlite ONLY when a store/ dir already exists — the
+    bench's documented contract is one JSON line on stdout, so it
+    never grows a new filesystem footprint by itself.  Never fails
+    the bench."""
+    try:
+        path = os.environ.get("BENCH_WAREHOUSE")
+        if path is None:
+            if not os.path.isdir("store"):
+                return
+            path = os.path.join("store", "warehouse.sqlite")
+        if not path:
+            return
+        from jepsen_tpu.telemetry.warehouse import Warehouse
+
+        tag = "bench@" + time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        Warehouse(path).ingest_bench(payload, source=tag)
+    except Exception:  # noqa: BLE001 — the JSON line is the contract
+        pass
+
+
 def emit_campaign_spec(path, sizes=None, seeds=(0,)):
     """Write the bench ladder as a `jepsen_tpu.campaign` spec, so BENCH
     trajectories and soak runs drive the same fleet engine (`cli
@@ -320,6 +356,16 @@ def main():
         repeats = int(os.environ.get("BENCH_REPEATS", 3))
 
         platform, backend_err = _init_backend()
+        if backend_err:
+            # structured resilience signal, not just a free-text field:
+            # the counter lands in the telemetry registry (and /metrics)
+            # and the dict rides in the payload + warehouse
+            from jepsen_tpu.resilience import env_anomaly
+
+            env_anomaly("backend-init",
+                        kind=("retried" if backend_err.get("recovered")
+                              else "fallback"),
+                        **backend_err)
 
         # Persistent compilation cache: driver reruns (and repeated
         # rungs at the same padded shapes) skip XLA compile — round 2's
@@ -343,7 +389,13 @@ def main():
             payload = _run_size(n_txns, repeats)
             payload["backend"] = platform
             if backend_err:
-                payload["backend_init_retried"] = backend_err
+                # compat free-text field + the structured anomaly list
+                payload["backend_init_retried"] = (
+                    f"{backend_err.get('error')} "
+                    f"({backend_err.get('probes')} probes over "
+                    f"{backend_err.get('wait_s')}s)")
+                payload["env_anomalies"] = [
+                    {"site": "backend-init", **backend_err}]
             if _BEST[0] is None or payload["n_txns"] > _BEST[0]["n_txns"]:
                 _BEST[0] = payload  # atomic rebind, watchdog-safe
         except Exception as e:
@@ -356,6 +408,7 @@ def main():
         payload = dict(_BEST[0])
         if last_err:
             payload["larger_size_error"] = last_err
+        _ingest_warehouse(payload)
         _emit(payload)
         return 0
     _emit({"metric": "elle-list-append-check-throughput", "value": 0,

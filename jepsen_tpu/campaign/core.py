@@ -269,6 +269,18 @@ def run_campaign(spec: Union[str, dict], base: Optional[str] = None, *,
     # normal completion only: an interrupted fleet must leave its
     # in-flight worker state in live.json for the /live post-mortem
     hb.close()
+    # keep an existing warehouse warm: ingest the records this fleet
+    # just appended (cursor-incremental, cheap), so summarize() and the
+    # next dashboard render take the SQL fast path.  No warehouse on
+    # this store -> nothing to do (cli obs ingest builds one).
+    try:
+        from jepsen_tpu.telemetry import warehouse as wmod
+
+        wh = wmod.open_if_exists(base)
+        if wh is not None:
+            wh.ingest_ledger(idx.path, base)
+    except Exception as e:  # noqa: BLE001 — derived index only
+        logger.warning("warehouse ingest after campaign failed: %s", e)
     return summarize(spec, base, executed=len(todo),
                      skipped=len(specs) - len(todo),
                      wall_s=time.monotonic() - t0, idx=idx)

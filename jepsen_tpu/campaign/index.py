@@ -25,10 +25,11 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-__all__ = ["Index"]
+__all__ = ["Index", "witness_pair_diffs", "verdict_counts_over"]
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -36,6 +37,55 @@ def _percentile(xs: List[float], q: float) -> float:
     s = sorted(xs)
     i = max(0, min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1)))))
     return s[i]
+
+
+def verdict_counts_over(latest: Iterable[Dict[str, Any]]
+                        ) -> Dict[str, int]:
+    """The verdict histogram over latest-per-run records — ONE
+    counting rule shared by the jsonl scan and the warehouse fast path
+    (and its /metrics rollups), so the classification can't drift
+    between backends."""
+    counts = {"true": 0, "false": 0, "unknown": 0,
+              "degraded": 0, "deadline": 0}
+    for r in latest:
+        v = r.get("valid?")
+        counts["true" if v is True else
+               "false" if v is False else "unknown"] += 1
+        if r.get("degraded"):
+            counts["degraded"] += 1
+        if r.get("deadline"):
+            counts["deadline"] += 1
+    return counts
+
+
+def witness_pair_diffs(by_key: Dict[str, List[Dict[str, Any]]]
+                       ) -> List[Dict[str, Any]]:
+    """The witness-drift diff over consecutive witness-bearing records
+    per key.  Input: key → records (each holding ``gen`` + a
+    ``witness`` dict), in append order.  ONE implementation shared by
+    the jsonl scan and the warehouse fast path, so the two backends
+    can't drift."""
+    out: List[Dict[str, Any]] = []
+    for key, recs in sorted(by_key.items()):
+        for prev, cur in zip(recs[:-1], recs[1:]):
+            pw, cw = prev["witness"], cur["witness"]
+            pa = set(pw.get("anomaly-types") or ())
+            ca = set(cw.get("anomaly-types") or ())
+            p_ops, c_ops = pw.get("ops") or 0, cw.get("ops") or 0
+            out.append({
+                "key": key,
+                "from-gen": prev.get("gen"), "to-gen": cur.get("gen"),
+                "from-ops": p_ops, "to-ops": c_ops,
+                "ops-delta": c_ops - p_ops,
+                "from-digest": pw.get("digest"),
+                "to-digest": cw.get("digest"),
+                "digest-changed": pw.get("digest") != cw.get("digest"),
+                "anomalies-added": sorted(ca - pa),
+                "anomalies-removed": sorted(pa - ca),
+                "changed": (pw.get("digest") != cw.get("digest")
+                            or pa != ca or p_ops != c_ops),
+            })
+    return out
 
 
 class Index:
@@ -48,24 +98,64 @@ class Index:
     lazily on the next :meth:`append` — read-only consumers (the web
     dashboard, `campaign status`) must never truncate, because their
     "torn line" may just be a live writer's append in flight.
+
+    Loading is LAZY, because the regression/trend queries have a
+    warehouse fast path (docs/TELEMETRY.md): when ``<store>/
+    warehouse.sqlite`` exists and fully covers this ledger (ingest
+    cursor == file size), ``flips``/``regressions``/``span_stats``/
+    ``span_trend``/``witness_diffs``/``verdict_counts``/
+    ``latest_by_run`` answer from indexed SQL without parsing the
+    jsonl at all.  A stale or absent warehouse falls back to the scan
+    — the ledger stays the source of truth either way.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, use_warehouse: bool = True):
         self.path = path
-        self.records: List[Dict[str, Any]] = []
+        self.use_warehouse = use_warehouse
+        self._records: Optional[List[Dict[str, Any]]] = None
+        self._load_lock = threading.Lock()
+        self._wh: Optional[tuple] = None  # cached (warehouse, rel)
+        self._wh_resolved = False
         #: byte offset of the last durable record seen at load; a
         #: resuming WRITER truncates to it before its first append
         self._good_bytes: Optional[int] = None
-        self._load()
+
+    @property
+    def records(self) -> List[Dict[str, Any]]:
+        if self._records is None:
+            with self._load_lock:
+                if self._records is None:
+                    self._load()
+        return self._records
+
+    def _warehouse(self):
+        """(warehouse, ledger-rel) when the SQL fast path may answer
+        for this ledger, else None.  Resolved (freshness-checked) once
+        per Index and cached — the same point-in-time semantics as the
+        one-shot jsonl load — and invalidated by :meth:`append`, which
+        makes the warehouse stale by definition."""
+        if not self.use_warehouse:
+            return None
+        if self._wh_resolved:
+            return self._wh
+        try:
+            from jepsen_tpu.telemetry import warehouse as wmod
+
+            self._wh = wmod.for_ledger(self.path)
+        except Exception:  # noqa: BLE001 — fast path only, never fail
+            self._wh = None
+        self._wh_resolved = True
+        return self._wh
 
     # -- persistence --------------------------------------------------------
 
     def _load(self) -> None:
+        recs: List[Dict[str, Any]] = []
         if not os.path.exists(self.path):
+            self._records = recs
             return
         good_bytes = 0
         torn = False
-        recs: List[Dict[str, Any]] = []
         with open(self.path, "rb") as f:
             for line in f:
                 if not line.strip():
@@ -86,7 +176,7 @@ class Index:
         # writer's complete record, which truncation would destroy)
         if torn:
             self._good_bytes = good_bytes
-        self.records = recs
+        self._records = recs
 
     def append(self, rec: Dict[str, Any]) -> Dict[str, Any]:
         """Durably append one record (fsync'd) and index it.  If the
@@ -95,6 +185,7 @@ class Index:
         rec = dict(rec)
         rec.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime()))
+        recs = self.records  # force the load: the heal check below
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         if self._good_bytes is not None:
             with open(self.path, "r+b") as f:
@@ -104,7 +195,10 @@ class Index:
             f.write(json.dumps(rec) + "\n")
             f.flush()
             os.fsync(f.fileno())
-        self.records.append(rec)
+        recs.append(rec)
+        # the append outdated any warehouse coverage of this ledger:
+        # re-resolve (and re-check freshness) on the next query
+        self._wh, self._wh_resolved = None, False
         return rec
 
     # -- resume -------------------------------------------------------------
@@ -137,6 +231,9 @@ class Index:
         ``regression`` marks the bad direction (away from True) — the
         "which (workload, seed) flipped valid? since the last campaign"
         query."""
+        wh = self._warehouse()
+        if wh is not None:
+            return wh[0].flips(wh[1])
         out: List[Dict[str, Any]] = []
         for key, recs in sorted(self.by_key().items()):
             for prev, cur in zip(recs[:-1], recs[1:]):
@@ -163,32 +260,15 @@ class Index:
         an unchanged spec is the "the minimal repro MOVED" signal — a
         different failure than last generation, even when the verdict
         column still just says False."""
-        out: List[Dict[str, Any]] = []
+        wh = self._warehouse()
+        if wh is not None:
+            return witness_pair_diffs(wh[0].witness_records(wh[1]))
         by_key: Dict[str, List[Dict[str, Any]]] = {}
         for r in self.records:
             w = r.get("witness")
             if isinstance(w, dict) and w.get("ops") and r.get("key"):
                 by_key.setdefault(r["key"], []).append(r)
-        for key, recs in sorted(by_key.items()):
-            for prev, cur in zip(recs[:-1], recs[1:]):
-                pw, cw = prev["witness"], cur["witness"]
-                pa = set(pw.get("anomaly-types") or ())
-                ca = set(cw.get("anomaly-types") or ())
-                p_ops, c_ops = pw.get("ops") or 0, cw.get("ops") or 0
-                out.append({
-                    "key": key,
-                    "from-gen": prev.get("gen"), "to-gen": cur.get("gen"),
-                    "from-ops": p_ops, "to-ops": c_ops,
-                    "ops-delta": c_ops - p_ops,
-                    "from-digest": pw.get("digest"),
-                    "to-digest": cw.get("digest"),
-                    "digest-changed": pw.get("digest") != cw.get("digest"),
-                    "anomalies-added": sorted(ca - pa),
-                    "anomalies-removed": sorted(pa - ca),
-                    "changed": (pw.get("digest") != cw.get("digest")
-                                or pa != ca or p_ops != c_ops),
-                })
-        return out
+        return witness_pair_diffs(by_key)
 
     # -- telemetry aggregates ----------------------------------------------
 
@@ -203,6 +283,9 @@ class Index:
     def span_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-span duration aggregates across every indexed run:
         count / min / p50 / p95 / max (seconds)."""
+        wh = self._warehouse()
+        if wh is not None:
+            return wh[0].span_stats(wh[1])
         return {
             name: {
                 "count": len(vals),
@@ -214,41 +297,63 @@ class Index:
             for name, vals in sorted(self._span_values().items())
         }
 
-    def span_trend(self, name: str) -> List[Tuple[str, float]]:
-        """p95 of one span per campaign generation, in first-seen gen
-        order — the "checker p95 span duration trend" query."""
-        by_gen: Dict[str, List[float]] = {}
-        order: List[str] = []
+    def span_samples(self, name: str
+                     ) -> List[Tuple[Optional[str], float]]:
+        """(gen, duration) samples for one span name, in append order —
+        the material for :meth:`span_trend` and the ``cli obs gate``
+        regression gate."""
+        wh = self._warehouse()
+        if wh is not None:
+            return wh[0].span_samples(wh[1], name)
+        out: List[Tuple[Optional[str], float]] = []
         for r in self.records:
             dur = (r.get("spans") or {}).get(name)
-            if not isinstance(dur, (int, float)):
-                continue
-            gen = str(r.get("gen") or "?")
-            if gen not in by_gen:
-                order.append(gen)
-            by_gen.setdefault(gen, []).append(float(dur))
+            if isinstance(dur, (int, float)):
+                out.append((r.get("gen"), float(dur)))
+        return out
+
+    def span_trend(self, name: str) -> List[Tuple[str, float]]:
+        """p95 of one span per campaign generation, in first-seen gen
+        order — the "checker p95 span duration trend" query.  The
+        warehouse answers from its materialized per-generation rollup;
+        the jsonl path recomputes from the raw samples."""
+        wh = self._warehouse()
+        if wh is not None:
+            return wh[0].span_trend(wh[1], name)
+        by_gen: Dict[str, List[float]] = {}
+        order: List[str] = []
+        for gen, dur in self.span_samples(name):
+            g = str(gen or "?")
+            if g not in by_gen:
+                order.append(g)
+            by_gen.setdefault(g, []).append(dur)
         return [(g, round(_percentile(by_gen[g], 95), 6)) for g in order]
 
     # -- rollups ------------------------------------------------------------
 
-    def verdict_counts(self, runs: Optional[Iterable[str]] = None
-                       ) -> Dict[str, int]:
-        """Verdict histogram over the LATEST record per run id."""
+    def latest_by_run(self) -> Dict[str, Dict[str, Any]]:
+        """The LATEST verdict-bearing record per run id — what the web
+        campaign grid renders.  Warehouse-backed when fresh; NOTE the
+        warehouse path reconstructs the grid PROJECTION (run/key/
+        workload/fault/seed/valid?/error/degraded/deadline/dir/ops/
+        wall_s/gen/ts/witness) — per-span durations stay in
+        :meth:`span_stats`/:meth:`span_samples`, not here."""
+        wh = self._warehouse()
+        if wh is not None:
+            return wh[0].latest_by_run(wh[1])
         latest: Dict[str, Dict[str, Any]] = {}
         for r in self.records:
-            if "valid?" in r:
+            if "valid?" in r and r.get("run"):
                 latest[r["run"]] = r
+        return latest
+
+    def verdict_counts(self, runs: Optional[Iterable[str]] = None
+                       ) -> Dict[str, int]:
+        """Verdict histogram over the LATEST record per run id.  Built
+        on :meth:`latest_by_run` so both backends share ONE
+        record-selection rule (verdict-bearing, truthy run id)."""
+        latest = dict(self.latest_by_run())
         if runs is not None:
             wanted = set(runs)
             latest = {k: v for k, v in latest.items() if k in wanted}
-        counts = {"true": 0, "false": 0, "unknown": 0,
-                  "degraded": 0, "deadline": 0}
-        for r in latest.values():
-            v = r.get("valid?")
-            counts["true" if v is True else
-                   "false" if v is False else "unknown"] += 1
-            if r.get("degraded"):
-                counts["degraded"] += 1
-            if r.get("deadline"):
-                counts["deadline"] += 1
-        return counts
+        return verdict_counts_over(latest.values())

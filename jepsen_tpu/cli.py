@@ -181,15 +181,69 @@ def trace_cmd(opts: argparse.Namespace) -> int:
     return 0
 
 
+def parse_since(spec: str, now: Optional[float] = None) -> float:
+    """``--since`` argument → epoch seconds: a duration back from now
+    (``90s``, ``5m``, ``2h``, ``1d``, bare seconds), a large bare
+    number taken as an epoch timestamp, or a UTC ISO timestamp."""
+    import time as _time
+
+    s = str(spec).strip()
+    now = _time.time() if now is None else now
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)([smhd]?)", s)
+    if m:
+        mult = {"": 1.0, "s": 1.0, "m": 60.0, "h": 3600.0,
+                "d": 86400.0}[m.group(2)]
+        v = float(m.group(1)) * mult
+        if m.group(2) == "" and v > 1e9:
+            return v  # an epoch timestamp, not a duration
+        return now - v
+    for fmt in ("%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            import calendar
+            import time as _t
+
+            return float(calendar.timegm(_t.strptime(s, fmt)))
+        except ValueError:
+            continue
+    raise ValueError(f"bad --since {spec!r} (want e.g. 5m, 2h, 1d, "
+                     "epoch seconds, or YYYY-MM-DDTHH:MM:SS UTC)")
+
+
+def _warehouse_events(d: str, since: Optional[float]):
+    """The ``tail --since`` warehouse fast path: when a warehouse
+    exists two levels up (the store base) and fully covers this dir's
+    event stream, answer from the indexed event table.  None -> the
+    caller falls back to the stream scan."""
+    from .telemetry import warehouse as wmod
+
+    base = os.path.dirname(os.path.dirname(os.path.abspath(d)))
+    try:
+        wh = wmod.open_if_exists(base)
+        if wh is None or not wh.events_fresh(d, base):
+            return None
+        return wh.events_since(d, base, since)
+    except Exception:  # noqa: BLE001 — fast path only
+        return None
+
+
 def tail_cmd(opts: argparse.Namespace) -> int:
     """`tail <run-dir>` — render a run's streamed events.jsonl as
-    human-readable progress lines; ``-f`` follows a live run.  The
+    human-readable progress lines; ``-f`` follows a live run; `--since
+    <ts|duration>` filters to recent events (served from the warehouse
+    event table when one covers the run, stream scan otherwise).  The
     footer names the still-open span chain and the final counter
     values — the post-mortem view for killed/wedged runs."""
     import time as _time
 
     from .telemetry import stream as tel_stream
 
+    since = None
+    if getattr(opts, "since", None):
+        try:
+            since = parse_since(opts.since)
+        except ValueError as e:
+            print(f"tail: {e}", file=sys.stderr)
+            return 2
     path = opts.dir
     if os.path.isdir(path):
         path = (tel_stream.events_path(path)
@@ -199,24 +253,39 @@ def tail_cmd(opts: argparse.Namespace) -> int:
               "--telemetry or JEPSEN_TELEMETRY=1 to stream)",
               file=sys.stderr)
         return 2
+
+    def since_filter(evs):
+        if since is None:
+            return evs
+        return [e for e in evs
+                if isinstance(e.get("t"), (int, float))
+                and e["t"] >= since]
+
     if not getattr(opts, "follow", False):
-        evs = tel_stream.read_events(path)
+        evs = None
+        if since is not None and os.path.isdir(opts.dir) and \
+                os.path.basename(path) == tel_stream.EVENTS_FILE:
+            evs = _warehouse_events(opts.dir, since)
+        if evs is None:
+            evs = since_filter(tel_stream.read_events(path))
         print(tel_stream.render_tail(evs, limit=opts.lines))
         return 0
-    offset = 0
+    cursor = None
     t0 = None
     first = True
     try:
         while True:
-            # byte cursor, not a re-parse: a multi-hour soak's
-            # events.jsonl is unbounded and a full-file read per poll
-            # is O(n^2) over the run
-            evs, offset = tel_stream.read_events_incremental(path, offset)
+            # rotation-proof byte cursor, not a re-parse: a multi-hour
+            # soak's events.jsonl is unbounded (and may size-rotate any
+            # number of times between polls) and a full-file read per
+            # poll is O(n^2) over the run
+            evs, cursor = tel_stream.follow_events(path, cursor)
             if evs:
                 # "end" can be followed by a straggler (e.g. a sampler
                 # tick racing close) — scan the batch, not just its tail
                 ended = any(e.get("ev") == "end" for e in evs)
-                if t0 is None:
+                evs = since_filter(evs)
+                if t0 is None and evs:
                     t0 = evs[0].get("t")
                 if first and opts.lines is not None \
                         and len(evs) > opts.lines:
@@ -269,6 +338,90 @@ def campaign_cmd(opts: argparse.Namespace) -> int:
         print(campaign.report_campaign(spec, base))
         return 0
     print(f"campaign: unknown action {opts.action!r}", file=sys.stderr)
+    return 2
+
+
+def obs_cmd(opts: argparse.Namespace) -> int:
+    """`obs ingest|rebuild|gate|sql|bench` — the sqlite telemetry
+    warehouse over the store dir (docs/TELEMETRY.md): build/refresh it,
+    query it, and gate span regressions statistically."""
+    import glob as _glob
+
+    from .telemetry import warehouse as wmod
+
+    base = opts.store_dir
+    if opts.action in ("ingest", "rebuild"):
+        wh = wmod.open_or_create(base)
+        stats = (wh.rebuild(base) if opts.action == "rebuild"
+                 else wh.ingest_store(base))
+        for pat in opts.bench or []:
+            paths = sorted(_glob.glob(pat)) or [pat]
+            for p in paths:
+                if wh.ingest_bench_file(p):
+                    stats["bench"] = stats.get("bench", 0) + 1
+                else:
+                    print(f"obs: bench file skipped: {p}",
+                          file=sys.stderr)
+        counts = wh.counts()
+        print(f"warehouse: {wmod.warehouse_path(base)}")
+        print("ingested: " + ", ".join(
+            f"{v} {k}" for k, v in sorted(stats.items())))
+        print("tables: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(counts.items()) if v))
+        if opts.bench and not stats.get("bench"):
+            # an explicitly requested bench ingest that landed nothing
+            # (typo'd glob, unparsable files) must not leave CI green
+            # while the bench series silently stops updating
+            print("obs: --bench matched/ingested no files",
+                  file=sys.stderr)
+            return 2
+        return 0
+    wh = wmod.open_if_exists(base)
+    if wh is None and opts.action != "gate":
+        print(f"obs: no warehouse at {wmod.warehouse_path(base)} "
+              "(run `obs ingest` first)", file=sys.stderr)
+        return 2
+    if opts.action == "bench":
+        rows = wh.bench_series()
+        if not rows:
+            print("obs: no bench results ingested (try `obs ingest "
+                  "--bench 'BENCH_r0*.json'`)", file=sys.stderr)
+            return 2
+        print(f"{'source':<24} {'value':>12} {'unit':<10} "
+              f"{'vs_baseline':>11} {'n_txns':>9} backend")
+        for r in rows:
+            print(f"{str(r['source']):<24} {r['value'] or 0:>12.1f} "
+                  f"{str(r['unit']):<10} {r['vs_baseline'] or 0:>11.3f} "
+                  f"{r['n_txns'] or 0:>9} {r['backend']}")
+        return 0
+    if opts.action == "sql":
+        if not opts.query:
+            print("obs: sql needs a query argument", file=sys.stderr)
+            return 2
+        try:
+            cols, rows = wh.query(opts.query)
+        except Exception as e:  # noqa: BLE001 — sqlite/read-only errors
+            print(f"obs: sql failed: {e}", file=sys.stderr)
+            return 2
+        print("\t".join(cols))
+        for r in rows:
+            print("\t".join(str(v) for v in r))
+        return 0
+    if opts.action == "gate":
+        if not opts.campaign or not opts.span:
+            print("obs: gate needs --campaign and --span",
+                  file=sys.stderr)
+            return 2
+        from .telemetry import gate as gate_mod
+
+        res = gate_mod.run_gate(
+            base, opts.campaign, opts.span,
+            from_gen=opts.from_gen, to_gen=opts.to_gen,
+            alpha=opts.alpha, threshold=opts.threshold,
+            min_runs=opts.min_runs)
+        print(gate_mod.render_gate(res))
+        return {"pass": 0, "regression": 1}.get(res.get("status"), 2)
+    print(f"obs: unknown action {opts.action!r}", file=sys.stderr)
     return 2
 
 
@@ -358,6 +511,13 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                      help="poll for new events until the run ends")
     ptl.add_argument("-n", "--lines", type=int, default=None,
                      help="only show the last N event lines")
+    ptl.add_argument("--since", default=None, metavar="TS|DUR",
+                     help="only events at/after this time: a duration "
+                          "back from now (90s, 5m, 2h, 1d), epoch "
+                          "seconds, or a UTC timestamp "
+                          "(YYYY-MM-DDTHH:MM:SS); answered from the "
+                          "warehouse event table when one covers the "
+                          "run (cli obs ingest), stream scan otherwise")
 
     psh = sub.add_parser("shrink",
                          help="delta-debug an invalid run to a minimal "
@@ -386,6 +546,38 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
     psh.add_argument("--force", action="store_true",
                      help="re-shrink even when a cached witness "
                           "matches the history digest")
+
+    po = sub.add_parser("obs",
+                        help="telemetry warehouse: ingest/rebuild the "
+                             "sqlite index over the store, query it, "
+                             "and gate span regressions "
+                             "(docs/TELEMETRY.md)")
+    po.add_argument("action",
+                    choices=("ingest", "rebuild", "gate", "sql",
+                             "bench"))
+    po.add_argument("query", nargs="?",
+                    help="SQL for the sql action (read-only)")
+    po.add_argument("--bench", action="append", metavar="GLOB",
+                    help="BENCH json file(s) to ingest alongside the "
+                         "store (repeatable; glob ok)")
+    po.add_argument("--campaign", help="gate: campaign name")
+    po.add_argument("--span", help="gate: span site to compare "
+                                   "(e.g. check:list-append)")
+    po.add_argument("--from-gen", dest="from_gen", default=None,
+                    help="gate: baseline generation (default: "
+                         "second-latest)")
+    po.add_argument("--to-gen", dest="to_gen", default=None,
+                    help="gate: candidate generation (default: latest)")
+    po.add_argument("--alpha", type=float, default=0.05,
+                    help="gate: Mann-Whitney one-sided significance "
+                         "level (default 0.05)")
+    po.add_argument("--threshold", type=float, default=0.25,
+                    help="gate: hard relative p95 regression bound "
+                         "(default 0.25 = +25%%)")
+    po.add_argument("--min-runs", dest="min_runs", type=int, default=3,
+                    help="gate: minimum runs per generation; fewer "
+                         "exits 2 (cannot evaluate), never a silent "
+                         "pass/fail")
 
     pc = sub.add_parser("campaign",
                         help="run/inspect a fleet of tests from a "
@@ -426,6 +618,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
             return shrink_cmd(opts, checker_fn)
         if opts.cmd == "campaign":
             return campaign_cmd(opts)
+        if opts.cmd == "obs":
+            return obs_cmd(opts)
         p.error(f"unknown command {opts.cmd}")
         return 2
 

@@ -174,11 +174,14 @@ def run(test: dict) -> dict:
         # <run-dir>/events.jsonl as they happen, so a killed run still
         # leaves a readable partial trace (docs/TELEMETRY.md)
         try:
+            mb = test.get("events-max-bytes")
             recorder = telemetry.attach_stream(
                 tel, store.test_dir(test),
                 meta={"name": test.get("name")},
                 interval_s=float(
-                    test.get("telemetry-sample-interval", 1.0)))
+                    test.get("telemetry-sample-interval", 1.0)),
+                max_bytes=int(mb) if mb else None,
+                keep=test.get("events-keep"))
         except Exception as e:  # noqa: BLE001 — never fail a run for it
             logger.warning("flight recorder unavailable: %s", e)
     try:

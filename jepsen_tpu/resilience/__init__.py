@@ -32,6 +32,7 @@ from jepsen_tpu.resilience.guard import (
     NO_PLAN,
     degrade_to_host,
     device_call,
+    env_anomaly,
     with_fallback,
 )
 from jepsen_tpu.resilience.policy import (
@@ -49,6 +50,6 @@ __all__ = [
     "DEADLINE_ERROR", "DEFAULT_POLICY", "deadline_result",
     "FaultPlan", "FaultInjected", "parse_spec", "plan_for", "use",
     "active_plan",
-    "device_call", "with_fallback", "degrade_to_host", "DEGRADED_HOST",
-    "NO_PLAN",
+    "device_call", "with_fallback", "degrade_to_host", "env_anomaly",
+    "DEGRADED_HOST", "NO_PLAN",
 ]

@@ -35,7 +35,7 @@ from jepsen_tpu.resilience.policy import (
 logger = logging.getLogger("jepsen.resilience")
 
 __all__ = ["device_call", "with_fallback", "degrade_to_host",
-           "DEGRADED_HOST", "NO_PLAN"]
+           "env_anomaly", "DEGRADED_HOST", "NO_PLAN"]
 
 DEGRADED_HOST = "host-fallback"
 
@@ -55,6 +55,23 @@ def _stream_event(ev: str, **fields: Any) -> None:
     from jepsen_tpu import telemetry
 
     telemetry.stream_event(ev, **fields)
+
+
+def env_anomaly(site: str, kind: str = "anomaly", **fields: Any) -> None:
+    """Record an ENVIRONMENT anomaly — a backend-init hang survived by
+    retrying, a flapping tunnel, a degraded accelerator — as a
+    structured resilience signal instead of a free-text field (ISSUE 6
+    satellite: bench r05 buried a 544 s backend-init hang in a prose
+    string).  Bumps the ``resilience-env-anomalies`` counter (visible
+    on ``/metrics`` and in telemetry snapshots) and streams an
+    ``env-anomaly`` event (visible to ``cli tail`` / ``/live`` and
+    counted by ``replay()``).  Never raises."""
+    try:
+        _registry().counter("resilience-env-anomalies", site=site,
+                            kind=kind).inc()
+        _stream_event("env-anomaly", site=site, kind=kind, **fields)
+    except Exception:  # noqa: BLE001 — observability must not fail work
+        logger.debug("env_anomaly(%s) failed", site, exc_info=True)
 
 
 def _annotate(**attrs: Any) -> None:

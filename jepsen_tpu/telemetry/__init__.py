@@ -41,6 +41,10 @@ from .metrics import Registry
 from .stream import Heartbeat, read_events
 from .stream import attach as attach_stream
 from .stream import event as stream_event
+
+# NOTE: gate, prometheus, and warehouse are sibling modules imported
+# lazily by their consumers (cli obs, web /metrics, Index fast paths)
+# — importing sqlite3 here would tax every `import jepsen_tpu`.
 from .spans import (
     NOOP,
     Collector,

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -61,8 +62,8 @@ from .metrics import Registry
 
 __all__ = ["EventStream", "ResourceSampler", "Recorder", "Heartbeat",
            "attach", "event", "read_events", "replay", "render_line",
-           "render_tail", "EVENTS_FILE", "SHRINK_EVENTS_FILE",
-           "events_path"]
+           "render_tail", "segment_files", "follow_events",
+           "EVENTS_FILE", "SHRINK_EVENTS_FILE", "events_path"]
 
 EVENTS_FILE = "events.jsonl"
 SHRINK_EVENTS_FILE = "events-shrink.jsonl"
@@ -89,6 +90,39 @@ def events_path(dirpath: str) -> Optional[str]:
         if mtime > best_mtime:
             best, best_mtime = p, mtime
     return best
+
+
+def segment_files(path: str) -> List[str]:
+    """All on-disk files of one rotated stream, oldest first: the
+    rotation segments ``<path>.N`` (largest N = oldest) then the live
+    file.  The reader-side contract behind size-based rotation: every
+    surface that replays a stream (``read_events``, the warehouse
+    ingest, ``cli tail``) spans segments through this one lookup."""
+    d = os.path.dirname(path) or "."
+    bn = os.path.basename(path)
+    segs: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        names = []
+    pat = re.compile(re.escape(bn) + r"\.(\d+)$")
+    for n in names:
+        m = pat.match(n)
+        if m:
+            segs.append((int(m.group(1)), os.path.join(d, n)))
+    out = [p for _, p in sorted(segs, reverse=True)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def _remove_segments(path: str) -> None:
+    for p in segment_files(path):
+        if p != path:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
 
 def _label_key(name: str, labels: Dict[str, Any]) -> str:
@@ -138,19 +172,33 @@ class EventStream:
     line followed by ``fsync`` — a kill between the two leaves at most
     one torn trailing line, which :func:`read_events` drops.  Emits
     must NEVER raise into the instrumented run: any failure (disk full,
-    closed fd) marks the stream broken and later emits are no-ops."""
+    closed fd) marks the stream broken and later emits are no-ops.
 
-    def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None):
+    Size-based rotation (``max_bytes``): when an append would push the
+    live file past the bound, the stream records a ``rotate`` event
+    in-stream, shifts ``events.jsonl`` → ``events.jsonl.1`` (… keep-N,
+    the oldest segment dropped), and continues into a fresh live file
+    opened with a ``rotate-cont`` marker — so soak/service runs never
+    grow one unbounded file.  Readers span segments transparently via
+    :func:`segment_files`."""
+
+    def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None,
+                 *, max_bytes: Optional[int] = None, keep: int = 3):
         self.path = path
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.keep = max(1, int(keep))
+        self._segment = 0
+        self._bytes = 0
         self._lock = threading.Lock()
         self._flush_lock = threading.Lock()
         self.broken = False
         self._metrics: Optional[_MetricsDelta] = None
+        # one session per file: truncate any previous stream (and drop
+        # its rotation segments) — a --force re-shrink appending after
+        # the old "end" event would make replay() render a killed
+        # re-run as ended, with counters mixed across sessions
+        _remove_segments(path)
         try:
-            # one session per file: truncate any previous stream — a
-            # --force re-shrink appending after the old "end" event
-            # would make replay() render a killed re-run as ended,
-            # with counters mixed across sessions
             self._f = open(path, "wb", buffering=0)
         except OSError:
             self._f = None
@@ -179,10 +227,42 @@ class EventStream:
             except Exception:  # noqa: BLE001 — bad payload, stream fine
                 return
             try:
+                if self.max_bytes and self._bytes \
+                        and self._bytes + len(data) > self.max_bytes:
+                    self._rotate()
                 self._f.write(data)
+                self._bytes += len(data)
                 os.fsync(self._f.fileno())
             except Exception:  # noqa: BLE001
                 self.broken = True
+
+    def _rotate(self) -> None:
+        """Rotate the live file (caller holds the emit lock).  The old
+        segment's LAST line is the ``rotate`` event and the new live
+        file's FIRST line is ``rotate-cont`` — both in-stream, so a
+        spanning replay sees an unbroken, self-describing sequence."""
+        self._segment += 1
+
+        def marker(ev: str) -> bytes:
+            return (json.dumps({"t": round(time.time(), 3), "ev": ev,
+                                "segment": self._segment},
+                               separators=(",", ":")) + "\n").encode()
+
+        self._f.write(marker("rotate"))
+        os.fsync(self._f.fileno())
+        self._f.close()
+        oldest = f"{self.path}.{self.keep}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, self.path + ".1")
+        self._f = open(self.path, "wb", buffering=0)
+        cont = marker("rotate-cont")
+        self._f.write(cont)
+        self._bytes = len(cont)
 
     # -- collector-facing hooks (spans.Collector calls these) ---------------
 
@@ -367,15 +447,33 @@ class Recorder:
         self.stream.close(**fields)
 
 
+def _env_int(name: str) -> Optional[int]:
+    try:
+        v = os.environ.get(name, "").strip()
+        return int(v) if v else None
+    except ValueError:
+        return None
+
+
 def attach(collector: Any, dirpath: str, *,
            meta: Optional[Dict[str, Any]] = None,
            interval_s: float = 1.0,
            filename: str = EVENTS_FILE,
-           sampler: bool = True) -> Recorder:
+           sampler: bool = True,
+           max_bytes: Optional[int] = None,
+           keep: Optional[int] = None) -> Recorder:
     """Attach a flight-recorder stream (and resource sampler) to a live
     collector; events land in ``<dirpath>/<filename>``.  Returns the
-    :class:`Recorder` whose ``close()`` the activator must call."""
-    s = EventStream(os.path.join(dirpath, filename), meta=meta)
+    :class:`Recorder` whose ``close()`` the activator must call.
+    ``max_bytes``/``keep`` enable size-based rotation (soak runs);
+    defaults come from ``JEPSEN_EVENTS_MAX_BYTES``/``JEPSEN_EVENTS_KEEP``
+    when unset."""
+    if max_bytes is None:
+        max_bytes = _env_int("JEPSEN_EVENTS_MAX_BYTES")
+    if keep is None:
+        keep = _env_int("JEPSEN_EVENTS_KEEP") or 3
+    s = EventStream(os.path.join(dirpath, filename), meta=meta,
+                    max_bytes=max_bytes, keep=keep)
     reg = getattr(collector, "registry", None)
     if reg is not None:
         s.bind_registry(reg)
@@ -391,10 +489,7 @@ def attach(collector: Any, dirpath: str, *,
 # Reading + rendering (cli tail, web /live)
 # ---------------------------------------------------------------------------
 
-def read_events(path: str) -> List[Dict[str, Any]]:
-    """Parse an events.jsonl, dropping a torn trailing line (crash
-    mid-append) and everything after the first unparsable record — the
-    same tolerance contract as the campaign ledger reader."""
+def _read_one(path: str) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     try:
         f = open(path, "rb")
@@ -415,17 +510,74 @@ def read_events(path: str) -> List[Dict[str, Any]]:
     return out
 
 
+def read_events(path: str, spanning: bool = True) -> List[Dict[str, Any]]:
+    """Parse an events.jsonl, dropping a torn trailing line (crash
+    mid-append) and everything after the first unparsable record — the
+    same tolerance contract as the campaign ledger reader.  With
+    ``spanning`` (the default) a size-rotated stream is read whole:
+    rotated segments oldest-first, then the live file — callers tailing
+    one physical file (the warehouse per-file ingest) pass False."""
+    if spanning:
+        out: List[Dict[str, Any]] = []
+        for p in segment_files(path) or [path]:
+            out.extend(_read_one(p))
+        return out
+    return _read_one(path)
+
+
+def _rotated_catchup(path: str, offset: int) -> List[Dict[str, Any]]:
+    """Events the follower missed across a rotation: the tail of the
+    just-rotated segment (``<path>.1``) from the old cursor.  Empty
+    when ``.1`` doesn't cover the cursor — that shrink was a new
+    session truncating the stream, not a rotation."""
+    p1 = path + ".1"
+    out: List[Dict[str, Any]] = []
+    try:
+        if os.path.getsize(p1) < offset:
+            return out
+        f = open(p1, "rb")
+    except OSError:
+        return out
+    with f:
+        f.seek(offset)
+        for line in f:
+            if not line.endswith(b"\n"):
+                break
+            try:
+                rec = json.loads(line) if line.strip() else None
+            except ValueError:
+                rec = None
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
 def read_events_incremental(
-        path: str, offset: int = 0) -> "tuple[List[Dict[str, Any]], int]":
+        path: str, offset: int = 0, follow_rotation: bool = True,
+        stop_at_corrupt: bool = False
+) -> "tuple[List[Dict[str, Any]], int]":
     """Parse complete event lines starting at byte ``offset``; returns
     ``(events, new_offset)`` with ``new_offset`` just past the last line
     consumed — the O(appended-bytes) cursor for following a live stream
     (``read_events`` re-parses the whole file each call).  A torn
     (unterminated) tail line is left unconsumed so the next poll retries
-    it once the writer finishes the append; a shrunken file means a new
-    session truncated the stream, so the cursor resets to 0 rather than
-    seeking past EOF forever; a complete-but-corrupt line is skipped —
-    it will never heal, and a follower must stay live past it."""
+    it once the writer finishes the append; a complete-but-corrupt line
+    is skipped — it will never heal, and a follower must stay live past
+    it (with ``stop_at_corrupt`` it instead STOPS there, cursor before
+    the bad line — the ``read_events`` scan semantics, used by the
+    warehouse ingest so the two backends index the same prefix).  A
+    shrunken file means either size rotation (the old bytes
+    moved to ``<path>.1`` — with ``follow_rotation`` the segment's tail
+    past the cursor is delivered first) or a new session truncating the
+    stream; both reset the cursor to 0.  (A rotation the poll only
+    sees after the NEW live file has already outgrown the old cursor
+    is indistinguishable from plain growth, and two rotations between
+    polls leave the cursor pointing at the wrong segment — a plain
+    byte cursor cannot tell segments apart.  Followers that must
+    survive arbitrary rotation cadence use :func:`follow_events`,
+    whose cursor also carries the live file's first-line identity; the
+    warehouse ingest re-reads segments by signature, so the durable
+    record stays exact either way.)"""
     out: List[Dict[str, Any]] = []
     try:
         f = open(path, "rb")
@@ -434,6 +586,8 @@ def read_events_incremental(
     with f:
         f.seek(0, os.SEEK_END)
         if f.tell() < offset:
+            if follow_rotation:
+                out.extend(_rotated_catchup(path, offset))
             offset = 0
         f.seek(offset)
         for line in f:
@@ -442,11 +596,131 @@ def read_events_incremental(
             try:
                 rec = json.loads(line) if line.strip() else None
             except ValueError:
+                if stop_at_corrupt:
+                    break  # scan semantics: cursor stays before it
                 rec = None
             offset += len(line)
             if isinstance(rec, dict):
                 out.append(rec)
     return out, offset
+
+
+_FIRST_LINE_CAP = 1 << 20  # 1 MiB — no sane first event comes close
+
+
+def _first_line(path: str) -> str:
+    """A file's first COMPLETE line — the stream's segment/session
+    identity: every live file opens with a unique first event (the
+    session's attach meta, or a timestamped ``rotate-cont`` marker),
+    and rotation renames preserve file content.  Shared by the
+    :func:`follow_events` cursor and the warehouse event ingest, so
+    the two can't disagree about what counts as the same session.
+    ``""`` means no identity yet (file absent, or the first line still
+    in flight).  A pathological first line longer than the cap yields
+    the capped prefix once the file has grown past it — a stable
+    identity rather than a permanent "" that would blind a follower
+    forever."""
+    try:
+        with open(path, "rb") as f:
+            first = f.readline(_FIRST_LINE_CAP)
+            if len(first) >= _FIRST_LINE_CAP and \
+                    not first.endswith(b"\n"):
+                # over-cap line: identity = the capped prefix, stable
+                # only once bytes BEYOND the cap exist (the prefix of a
+                # still-growing line could change between polls)
+                if f.read(1):
+                    return first.decode("utf-8", "replace")
+                return ""
+    except OSError:
+        return ""
+    if not first.endswith(b"\n"):
+        return ""
+    return first.decode("utf-8", "replace")
+
+
+def follow_events(path: str, cursor: Optional[Dict[str, Any]] = None
+                  ) -> "tuple[List[Dict[str, Any]], Dict[str, Any]]":
+    """The rotation-proof follower behind ``cli tail -f``: like
+    :func:`read_events_incremental`, but the opaque ``cursor`` dict
+    also carries the live file's first-line identity, so ANY number of
+    rotations between polls is spanned losslessly — the follower's
+    former live file is found among the rotated segments by first
+    line, its tail past the old offset drained, every newer segment
+    delivered whole, then the new live file read from byte 0.  A
+    former segment that aged out of keep-N (or a new session, which
+    removes old segments) delivers every surviving segment whole.
+    Pass the returned cursor back on the next poll; start with None.
+    The first poll spans existing rotated segments, matching
+    :func:`read_events`."""
+    cursor = cursor or {}
+    offset = int(cursor.get("offset") or 0)
+    head = cursor.get("head") or ""
+    live_head = _first_line(path)
+    out: List[Dict[str, Any]] = []
+    segs = [p for p in segment_files(path) if p != path]
+    # the resume anchor: identity + offset of the last position fully
+    # delivered, valid even if the live-file read below can't complete
+    # (rename race) — the next poll restarts the segment walk from it
+    anchor_off, anchor_head = offset, head
+    if not head or live_head != head:
+        if head:
+            # the live file was replaced since last poll (>=1
+            # rotations, or a new session): locate the former live
+            # file among the rotated segments by identity
+            idx = next((i for i, p in enumerate(segs)
+                        if _first_line(p) == head), None)
+            if idx is not None:
+                evs, new_off = read_events_incremental(
+                    segs[idx], offset, follow_rotation=False)
+                if _first_line(segs[idx]) != head:
+                    # a rotation renamed another segment onto this
+                    # path mid-read: the bytes may be the wrong
+                    # file's — drop them, retry from the old cursor
+                    return out, {"offset": anchor_off,
+                                 "head": anchor_head}
+                out.extend(evs)
+                anchor_off = new_off
+                segs = segs[idx + 1:]
+            # else: former segment dropped (keep-N overrun / new
+            # session, which removes old segments) — every surviving
+            # segment is newer than the cursor, deliver them whole
+        # fresh follower (no head): span already-rotated history,
+        # matching read_events.  Fingerprint each segment BEFORE
+        # reading and re-check after: a rotation racing the walk
+        # renames other content onto these paths, and anchoring to a
+        # fingerprint taken after such a rename would mark events as
+        # delivered that never were.
+        for p in segs:
+            fl = _first_line(p)
+            try:
+                size = os.path.getsize(p)
+            except OSError:
+                fl = ""
+            if not fl:
+                continue  # segment dropped by keep-N mid-walk
+            evs = read_events(p, spanning=False)
+            if _first_line(p) != fl:
+                # renamed under us: stop the walk; the next poll
+                # resumes the chain from the last good anchor
+                return out, {"offset": anchor_off, "head": anchor_head}
+            out.extend(evs)
+            anchor_off, anchor_head = size, fl
+        offset = 0
+    if not live_head:
+        # live file absent or its first line still in flight (a poll
+        # racing the rotation rename): deliver the segment catch-up
+        # and retry the live file from the anchor next poll
+        return out, {"offset": anchor_off, "head": anchor_head}
+    evs, offset = read_events_incremental(path, offset,
+                                          follow_rotation=False)
+    if _first_line(path) != live_head:
+        # a rotation raced the live read: the bytes parsed may belong
+        # to a different file than live_head names — drop the live
+        # batch (the next poll re-delivers it via the segment walk)
+        # but keep the rename-stable segment catch-up
+        return out, {"offset": anchor_off, "head": anchor_head}
+    out.extend(evs)
+    return out, {"offset": offset, "head": live_head}
 
 
 def replay(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
@@ -457,8 +731,9 @@ def replay(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     state: Dict[str, Any] = {
         "meta": {}, "open": [], "ended": False, "t0": None, "t_last": None,
         "counters": {}, "gauges": {}, "histograms": {}, "sample": {},
-        "spans_closed": 0, "events": 0,
+        "spans_closed": 0, "events": 0, "rotations": 0,
         "faults": 0, "retries": 0, "fallbacks": 0, "deadlines": 0,
+        "env_anomalies": 0,
     }
     open_spans: List[Dict[str, Any]] = []
     for e in events:
@@ -491,6 +766,10 @@ def replay(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         elif ev in ("fault", "retry", "fallback", "deadline"):
             key = "retries" if ev == "retry" else ev + "s"
             state[key] += 1
+        elif ev == "env-anomaly":
+            state["env_anomalies"] += 1
+        elif ev == "rotate":
+            state["rotations"] += 1
         elif ev == "end":
             state["ended"] = True
     state["open"] = open_spans
@@ -581,10 +860,14 @@ def render_tail(events: List[Dict[str, Any]],
         lines.append(f"last open span: {last['name']}{age}")
     else:
         lines.append("no open spans (stream truncated before close?)")
-    if st["faults"] or st["retries"] or st["fallbacks"] or st["deadlines"]:
+    if st["faults"] or st["retries"] or st["fallbacks"] or st["deadlines"] \
+            or st["env_anomalies"]:
+        env = (f", {st['env_anomalies']} env anomalies"
+               if st["env_anomalies"] else "")
         lines.append(f"resilience: {st['faults']} faults, "
                      f"{st['retries']} retries, {st['fallbacks']} "
-                     f"fallbacks, {st['deadlines']} deadline expiries")
+                     f"fallbacks, {st['deadlines']} deadline expiries"
+                     f"{env}")
     if st["counters"]:
         lines.append("counters:")
         for k, v in sorted(st["counters"].items()):

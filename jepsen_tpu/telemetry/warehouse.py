@@ -1,0 +1,1001 @@
+"""The observatory: a zero-dep sqlite telemetry warehouse (ISSUE 6).
+
+PRs 1 and 5 made every run *emit* rich telemetry (``telemetry.json``
+span forests, ``events.jsonl`` streams, campaign jsonl ledgers), but
+*querying* it still meant re-parsing every file per request — the jsonl
+scan cost that bites ``Index.flips()`` / ``span_trend()`` at fleet
+scale.  This module ingests all of it into one indexed sqlite file,
+``<store>/warehouse.sqlite``, and pushes the hot campaign queries down
+to SQL.
+
+Contracts:
+
+- **The jsonl ledgers stay the source of truth.**  The warehouse is a
+  derived index: ``rebuild()`` (``cli obs rebuild``) reconstructs it
+  from scratch at any time, and every query surface keeps a jsonl
+  fallback for stores that never built one.
+- **Ingest is incremental.**  Campaign ledgers are keyed by a byte
+  cursor (only appended records are parsed on re-ingest), run dirs by a
+  stat digest of their artifacts (an unchanged run is a no-op), event
+  streams by the live file's byte cursor plus a rotated-segment
+  signature.  Re-ingesting an unchanged store touches nothing.
+- **Crash-consistent.**  Each ingest unit (one ledger, one run dir,
+  one dir's event stream) commits atomically; a crash mid-ingest rolls
+  the in-flight unit back and the next ingest simply redoes it.
+- **Exact.**  The SQL-backed queries return byte-identical results to
+  the jsonl scans (asserted in tests): same ordering, same percentile
+  formula, same rounding.
+
+Tables (see ``docs/TELEMETRY.md`` for the query cookbook):
+
+- ``campaign_records`` + ``record_spans`` — one row per ledger record,
+  span durations exploded for indexed trend queries.
+- ``runs`` / ``run_spans`` / ``run_metrics`` — per run dir: verdict +
+  attribution flags, per-span total/count, counter & gauge snapshot.
+- ``witnesses`` — minimal-witness summaries (``witness.json``).
+- ``events`` — streamed flight-recorder events (``cli tail --since``).
+- ``bench`` — BENCH payloads (``bench.py`` self-ingests; ``cli obs
+  ingest --bench BENCH_r0*.json`` loads the committed trajectory).
+- ``ledgers`` / ``event_cursors`` — the incremental-ingest bookkeeping.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("jepsen.warehouse")
+
+__all__ = ["Warehouse", "warehouse_path", "open_if_exists", "for_ledger",
+           "WAREHOUSE_FILE", "SCHEMA_VERSION"]
+
+WAREHOUSE_FILE = "warehouse.sqlite"
+SCHEMA_VERSION = 1
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS meta(
+    key TEXT PRIMARY KEY, value TEXT);
+CREATE TABLE IF NOT EXISTS ledgers(
+    path TEXT PRIMARY KEY,          -- store-relative ledger path
+    cursor INTEGER NOT NULL DEFAULT 0);
+CREATE TABLE IF NOT EXISTS campaign_records(
+    id INTEGER PRIMARY KEY,
+    ledger TEXT NOT NULL,
+    campaign TEXT, run TEXT, key TEXT,
+    workload TEXT, fault TEXT, seed TEXT,  -- seed JSON-encoded
+    valid TEXT,                     -- JSON-encoded verdict; NULL=absent
+    error TEXT, degraded TEXT, deadline INTEGER,
+    dir TEXT, ops INTEGER, wall_s REAL,
+    gen TEXT, spec TEXT, ts TEXT,
+    witness TEXT);                  -- JSON witness summary, or NULL
+CREATE INDEX IF NOT EXISTS cr_ledger_key ON campaign_records(ledger, key, id);
+CREATE INDEX IF NOT EXISTS cr_ledger_run ON campaign_records(ledger, run, id);
+CREATE TABLE IF NOT EXISTS record_spans(
+    record_id INTEGER NOT NULL,
+    ledger TEXT NOT NULL,
+    name TEXT NOT NULL,
+    dur_s REAL NOT NULL);
+CREATE INDEX IF NOT EXISTS rs_ledger_name ON record_spans(ledger, name, record_id);
+CREATE INDEX IF NOT EXISTS rs_record ON record_spans(record_id);
+-- materialized at ingest time (the hot queries are O(result), not
+-- O(records)): verdict flips per regression key, and per-span-name
+-- duration rollups (whole-ledger stats + per-generation p95)
+CREATE TABLE IF NOT EXISTS flip_rollup(
+    record_id INTEGER NOT NULL,     -- the id of the LATER record
+    ledger TEXT NOT NULL,
+    key TEXT NOT NULL, run TEXT,
+    from_valid TEXT NOT NULL, to_valid TEXT NOT NULL,
+    regression INTEGER NOT NULL, ts TEXT, gen TEXT);
+CREATE INDEX IF NOT EXISTS flr_ledger ON flip_rollup(ledger, key, record_id);
+CREATE TABLE IF NOT EXISTS span_rollup(
+    ledger TEXT NOT NULL, name TEXT NOT NULL,
+    count INTEGER NOT NULL, min REAL, p50 REAL, p95 REAL, max REAL,
+    PRIMARY KEY(ledger, name));
+CREATE TABLE IF NOT EXISTS span_gen_rollup(
+    ledger TEXT NOT NULL, name TEXT NOT NULL,
+    gen TEXT NOT NULL,              -- str(gen or "?"), the trend label
+    first_id INTEGER NOT NULL,      -- first sample's record id: order
+    p95 REAL,
+    PRIMARY KEY(ledger, name, gen));
+CREATE TABLE IF NOT EXISTS runs(
+    dir TEXT PRIMARY KEY,           -- store-relative run dir
+    name TEXT, ts TEXT,
+    digest TEXT NOT NULL,
+    valid TEXT, error TEXT, degraded TEXT, deadline INTEGER,
+    ingested_at REAL);
+CREATE TABLE IF NOT EXISTS run_spans(
+    dir TEXT NOT NULL, name TEXT NOT NULL,
+    total_s REAL NOT NULL, count INTEGER NOT NULL);
+CREATE INDEX IF NOT EXISTS runsp_dir ON run_spans(dir);
+CREATE INDEX IF NOT EXISTS runsp_name ON run_spans(name);
+CREATE TABLE IF NOT EXISTS run_metrics(
+    dir TEXT NOT NULL, kind TEXT NOT NULL,
+    name TEXT NOT NULL, labels TEXT NOT NULL, value REAL);
+CREATE INDEX IF NOT EXISTS runm_dir ON run_metrics(dir);
+CREATE TABLE IF NOT EXISTS witnesses(
+    dir TEXT PRIMARY KEY,
+    ops INTEGER, source_ops INTEGER, digest TEXT,
+    anomalies TEXT, probes INTEGER);
+CREATE TABLE IF NOT EXISTS events(
+    id INTEGER PRIMARY KEY,
+    dir TEXT NOT NULL, t REAL, ev TEXT, doc TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS ev_dir_t ON events(dir, t, id);
+CREATE TABLE IF NOT EXISTS event_cursors(
+    dir TEXT PRIMARY KEY,
+    cursor INTEGER NOT NULL,        -- byte cursor into the LIVE file
+    sig TEXT NOT NULL,              -- rotated-segment signature (JSON)
+    head TEXT NOT NULL DEFAULT ''); -- live file's first line (session id)
+CREATE TABLE IF NOT EXISTS bench(
+    source TEXT PRIMARY KEY,
+    ingested_at REAL,
+    metric TEXT, value REAL, unit TEXT, vs_baseline REAL,
+    n_txns INTEGER, backend TEXT, wall_s REAL,
+    compile_or_warmup_s REAL, doc TEXT NOT NULL);
+"""
+
+#: every row-holding table, in wipe order (rebuild / per-unit deletes)
+_DATA_TABLES = ("record_spans", "flip_rollup", "span_rollup",
+                "span_gen_rollup", "campaign_records", "ledgers",
+                "run_spans", "run_metrics", "witnesses", "runs",
+                "events", "event_cursors", "bench")
+
+
+def warehouse_path(base: str) -> str:
+    """The store's warehouse file: ``<store>/warehouse.sqlite``."""
+    return os.path.join(base, WAREHOUSE_FILE)
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    """THE ledger span percentile (round nearest-rank) — imported from
+    the jsonl path so the two backends can't disagree."""
+    from jepsen_tpu.campaign.index import _percentile as p
+
+    return p(xs, q)
+
+
+_JSON_SIMPLE = {"true": True, "false": False, "null": None}
+_MISS = object()
+
+
+def _loads(s: str) -> Any:
+    """json.loads with a fast path for the three verdict literals —
+    the flips/latest decode loop is on the web request path."""
+    v = _JSON_SIMPLE.get(s, _MISS)
+    return json.loads(s) if v is _MISS else v
+
+
+class Warehouse:
+    """One sqlite warehouse.  Thread-safe: a single connection guarded
+    by a lock (handlers on the threaded web server share a cached
+    instance via :func:`for_ledger`)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.RLock()
+        self.db = sqlite3.connect(path, check_same_thread=False)
+        self.db.execute("PRAGMA journal_mode=WAL")
+        self.db.execute("PRAGMA synchronous=NORMAL")
+        with self._lock, self.db:
+            self.db.executescript(_SCHEMA)
+            self.db.execute(
+                "INSERT OR IGNORE INTO meta(key, value) VALUES "
+                "('schema_version', ?)", (str(SCHEMA_VERSION),))
+        # on-disk identity at open: lets the handle cache detect a
+        # deleted/replaced file (rm + rebuild in another process) and
+        # re-open instead of serving an unlinked inode forever
+        st = os.stat(path)
+        self._file_id = (st.st_ino, st.st_dev)
+
+    def file_unchanged(self) -> bool:
+        """True while ``self.path`` still names the inode this handle
+        opened — False once the file was deleted or replaced."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return False
+        return (st.st_ino, st.st_dev) == self._file_id
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self.db.close()
+            except sqlite3.Error:
+                pass
+
+    def __enter__(self) -> "Warehouse":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- ingest: campaign ledgers -------------------------------------------
+
+    def ingest_ledger(self, path: str, base: str) -> int:
+        """Incrementally ingest one campaign jsonl ledger; returns the
+        number of new records.  Keyed by byte cursor: only lines
+        appended since the last ingest are parsed; a torn/unparsable
+        tail line is left unconsumed (the writer's heal truncates it,
+        after which cursor == size again).  A file shrunk below the
+        cursor was healed/rewritten: its records are wiped and
+        re-ingested from byte 0."""
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(base))
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return 0
+        with self._lock:
+            row = self.db.execute(
+                "SELECT cursor FROM ledgers WHERE path = ?",
+                (rel,)).fetchone()
+            cursor = row[0] if row else 0
+            if size < cursor:
+                with self.db:
+                    self._wipe_ledger(rel)
+                cursor = 0
+            if size == cursor:
+                return 0
+            new = 0
+            # one transaction per ledger batch: records + flip/span
+            # rollups + the cursor land atomically, so a crash
+            # mid-ingest rolls the whole unit back and the next ingest
+            # simply redoes it
+            last_valid: Dict[str, Any] = {}  # key -> last verdict seen
+            touched_spans: set = set()
+            with self.db, open(path, "rb") as f:
+                f.seek(cursor)
+                for line in f:
+                    if not line.endswith(b"\n"):
+                        break  # torn tail: an append is in flight
+                    if not line.strip():
+                        cursor += len(line)
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        break  # crash debris: healed by the next writer
+                    if not isinstance(rec, dict):
+                        cursor += len(line)
+                        continue
+                    rid = self._insert_record(rel, rec)
+                    self._update_flips(rel, rid, rec, last_valid)
+                    spans = rec.get("spans")
+                    if isinstance(spans, dict):
+                        touched_spans.update(spans)
+                    cursor += len(line)
+                    new += 1
+                if touched_spans:
+                    self._refresh_span_rollups(rel, touched_spans)
+                self.db.execute(
+                    "INSERT INTO ledgers(path, cursor) VALUES (?, ?) "
+                    "ON CONFLICT(path) DO UPDATE SET cursor = ?",
+                    (rel, cursor, cursor))
+            return new
+
+    def _update_flips(self, ledger: str, rid: int, rec: Dict[str, Any],
+                      last_valid: Dict[str, Any]) -> None:
+        """Incrementally maintain the flip rollup: pair this record's
+        verdict with the previous verdict-bearing record for the same
+        key (seeded from SQL on the key's first sighting in a batch,
+        then carried in ``last_valid``).  Comparison is on the DECODED
+        Python values — exactly the jsonl scan's ``!=``."""
+        key = rec.get("key")
+        if "valid?" not in rec or not key:
+            return
+        cur = rec["valid?"]
+        prev = last_valid.get(key, _MISS)
+        if prev is _MISS:
+            row = self.db.execute(
+                "SELECT valid FROM campaign_records WHERE ledger = ? "
+                "AND key = ? AND valid IS NOT NULL AND id < ? "
+                "ORDER BY id DESC LIMIT 1", (ledger, key, rid)).fetchone()
+            prev = _loads(row[0]) if row else _MISS
+        if prev is not _MISS and prev != cur:
+            self.db.execute(
+                "INSERT INTO flip_rollup(record_id, ledger, key, run, "
+                "from_valid, to_valid, regression, ts, gen) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (rid, ledger, key, rec.get("run"), json.dumps(prev),
+                 json.dumps(cur), 1 if prev is True else 0,
+                 rec.get("ts"), rec.get("gen")))
+        last_valid[key] = cur
+
+    def _refresh_span_rollups(self, ledger: str, names: Any) -> None:
+        """Recompute the span rollups for the names a batch touched —
+        the percentiles can't be maintained incrementally, so ingest
+        re-derives them from ``record_spans`` (already in SQL) and the
+        queries become single indexed lookups."""
+        for name in sorted(names):
+            rows = self.db.execute(
+                "SELECT s.record_id, s.dur_s, r.gen FROM record_spans s "
+                "JOIN campaign_records r ON r.id = s.record_id "
+                "WHERE s.ledger = ? AND s.name = ? ORDER BY s.record_id",
+                (ledger, name)).fetchall()
+            self.db.execute(
+                "DELETE FROM span_rollup WHERE ledger = ? AND name = ?",
+                (ledger, name))
+            self.db.execute(
+                "DELETE FROM span_gen_rollup WHERE ledger = ? "
+                "AND name = ?", (ledger, name))
+            if not rows:
+                continue
+            vals = [dur for _, dur, _ in rows]
+            self.db.execute(
+                "INSERT INTO span_rollup(ledger, name, count, min, p50, "
+                "p95, max) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (ledger, name, len(vals), round(min(vals), 6),
+                 round(_percentile(vals, 50), 6),
+                 round(_percentile(vals, 95), 6), round(max(vals), 6)))
+            by_gen: Dict[str, List[float]] = {}
+            first: Dict[str, int] = {}
+            for rid, dur, gen in rows:
+                g = str(gen or "?")
+                if g not in by_gen:
+                    first[g] = rid
+                by_gen.setdefault(g, []).append(dur)
+            self.db.executemany(
+                "INSERT INTO span_gen_rollup(ledger, name, gen, "
+                "first_id, p95) VALUES (?, ?, ?, ?, ?)",
+                [(ledger, name, g, first[g],
+                  round(_percentile(vs, 95), 6))
+                 for g, vs in by_gen.items()])
+
+    def _wipe_ledger(self, rel: str) -> None:
+        for tbl in ("record_spans", "flip_rollup", "span_rollup",
+                    "span_gen_rollup"):
+            self.db.execute(f"DELETE FROM {tbl} WHERE ledger = ?", (rel,))
+        self.db.execute("DELETE FROM campaign_records WHERE ledger = ?",
+                        (rel,))
+        self.db.execute("DELETE FROM ledgers WHERE path = ?", (rel,))
+
+    def _insert_record(self, ledger: str, rec: Dict[str, Any]) -> int:
+        w = rec.get("witness")
+        cur = self.db.execute(
+            "INSERT INTO campaign_records(ledger, campaign, run, key, "
+            "workload, fault, seed, valid, error, degraded, deadline, "
+            "dir, ops, wall_s, gen, spec, ts, witness) "
+            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (ledger, rec.get("campaign"), rec.get("run"), rec.get("key"),
+             rec.get("workload"), rec.get("fault"),
+             json.dumps(rec.get("seed")),
+             json.dumps(rec["valid?"]) if "valid?" in rec else None,
+             rec.get("error"), rec.get("degraded"),
+             1 if rec.get("deadline") else 0,
+             rec.get("dir"), rec.get("ops"), rec.get("wall_s"),
+             rec.get("gen"), rec.get("spec"), rec.get("ts"),
+             json.dumps(w) if isinstance(w, dict) else None))
+        rid = cur.lastrowid
+        spans = rec.get("spans") or {}
+        if isinstance(spans, dict):
+            rows = [(rid, ledger, name, float(dur))
+                    for name, dur in spans.items()
+                    if isinstance(dur, (int, float))]
+            if rows:
+                self.db.executemany(
+                    "INSERT INTO record_spans(record_id, ledger, name, "
+                    "dur_s) VALUES (?, ?, ?, ?)", rows)
+        return rid
+
+    def ledger_fresh(self, path: str, base: str) -> bool:
+        """True iff this ledger is fully ingested (cursor == file size)
+        — the gate for the SQL fast path.  A missing file with no
+        cursor row counts as fresh (both sides empty)."""
+        rel = os.path.relpath(os.path.abspath(path), os.path.abspath(base))
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        with self._lock:
+            row = self.db.execute(
+                "SELECT cursor FROM ledgers WHERE path = ?",
+                (rel,)).fetchone()
+        return (row[0] if row else 0) == size
+
+    # -- ingest: run dirs ----------------------------------------------------
+
+    @staticmethod
+    def _run_digest(d: str) -> str:
+        parts = []
+        for fn in ("results.json", "telemetry.json", "witness.json"):
+            try:
+                st = os.stat(os.path.join(d, fn))
+                parts.append(f"{fn}:{st.st_size}:{st.st_mtime_ns}")
+            except OSError:
+                parts.append(f"{fn}:-")
+        return "|".join(parts)
+
+    def ingest_run_dir(self, d: str, base: str) -> bool:
+        """Ingest one run dir (verdict + spans + metric snapshot +
+        witness); returns True if anything changed.  Keyed by a stat
+        digest of the artifacts — an unchanged run is a no-op.  Missing
+        or unreadable artifacts are tolerated: a run with no
+        telemetry.json still gets its verdict row."""
+        rel = os.path.relpath(os.path.abspath(d), os.path.abspath(base))
+        digest = self._run_digest(d)
+        with self._lock:
+            row = self.db.execute(
+                "SELECT digest FROM runs WHERE dir = ?", (rel,)).fetchone()
+            if row and row[0] == digest:
+                return False
+            valid, flags = self._run_results(d)
+            spans, metrics = self._run_telemetry(d)
+            wit = self._run_witness(d)
+            with self.db:
+                for tbl in ("runs", "run_spans", "run_metrics",
+                            "witnesses"):
+                    self.db.execute(
+                        f"DELETE FROM {tbl} WHERE dir = ?", (rel,))
+                self.db.execute(
+                    "INSERT INTO runs(dir, name, ts, digest, valid, "
+                    "error, degraded, deadline, ingested_at) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    (rel, os.path.basename(os.path.dirname(rel)) or None,
+                     os.path.basename(rel), digest,
+                     json.dumps(valid) if valid is not _ABSENT else None,
+                     flags.get("error"), flags.get("degraded"),
+                     1 if flags.get("deadline") else 0, time.time()))
+                if spans:
+                    self.db.executemany(
+                        "INSERT INTO run_spans(dir, name, total_s, count) "
+                        "VALUES (?, ?, ?, ?)",
+                        [(rel, n, t, c) for n, (t, c) in
+                         sorted(spans.items())])
+                if metrics:
+                    self.db.executemany(
+                        "INSERT INTO run_metrics(dir, kind, name, labels, "
+                        "value) VALUES (?, ?, ?, ?, ?)",
+                        [(rel,) + m for m in metrics])
+                if wit is not None:
+                    self.db.execute(
+                        "INSERT INTO witnesses(dir, ops, source_ops, "
+                        "digest, anomalies, probes) "
+                        "VALUES (?, ?, ?, ?, ?, ?)",
+                        (rel, wit.get("ops"), wit.get("source-ops"),
+                         wit.get("digest"),
+                         json.dumps(wit.get("anomaly-types") or []),
+                         wit.get("probes")))
+            return True
+
+    def run_spans(self, d: str, base: Optional[str] = None
+                  ) -> List[Tuple[str, float, int]]:
+        """One ingested run's (span name, total seconds, count) rows,
+        largest total first — the web run page's span profile.  ``d``
+        may be store-relative already (pass ``base=None``)."""
+        rel = (d if base is None else
+               os.path.relpath(os.path.abspath(d), os.path.abspath(base)))
+        with self._lock:
+            return self.db.execute(
+                "SELECT name, total_s, count FROM run_spans "
+                "WHERE dir = ? ORDER BY total_s DESC, name",
+                (rel,)).fetchall()
+
+    @staticmethod
+    def _run_results(d: str) -> Tuple[Any, Dict[str, Any]]:
+        from jepsen_tpu.campaign.core import result_flags
+
+        try:
+            with open(os.path.join(d, "results.json")) as f:
+                res = json.load(f)
+        except (OSError, ValueError):
+            return _ABSENT, {}
+        if not isinstance(res, dict):
+            return _ABSENT, {}
+        return res.get("valid?", _ABSENT), result_flags(res)
+
+    @staticmethod
+    def _run_telemetry(d: str) -> Tuple[Dict[str, Tuple[float, int]],
+                                        List[Tuple]]:
+        """(spans, metric rows) from telemetry.json: per-span-name
+        (total seconds, count), and counter/gauge/histogram snapshot
+        rows for run_metrics."""
+        try:
+            with open(os.path.join(d, "telemetry.json")) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return {}, []
+        spans: Dict[str, Tuple[float, int]] = {}
+
+        def walk(sp: Dict[str, Any]) -> None:
+            dur = sp.get("dur_ns")
+            if isinstance(dur, (int, float)):
+                t, c = spans.get(sp["name"], (0.0, 0))
+                spans[sp["name"]] = (t + dur / 1e9, c + 1)
+            for ch in sp.get("children") or []:
+                walk(ch)
+
+        for r in doc.get("spans", []) if isinstance(doc, dict) else []:
+            walk(r)
+        spans = {n: (round(t, 6), c) for n, (t, c) in spans.items()}
+        m = doc.get("metrics") or {} if isinstance(doc, dict) else {}
+
+        def lbl(entry: Dict[str, Any]) -> str:
+            return json.dumps(entry.get("labels") or {}, sort_keys=True)
+
+        rows: List[Tuple] = []
+        for c in m.get("counters", []):
+            if isinstance(c.get("value"), (int, float)):
+                rows.append(("counter", c["name"], lbl(c),
+                             float(c["value"])))
+        for g in m.get("gauges", []):
+            if isinstance(g.get("value"), (int, float)):
+                rows.append(("gauge", g["name"], lbl(g),
+                             float(g["value"])))
+        for h in m.get("histograms", []):
+            if isinstance(h.get("count"), (int, float)):
+                rows.append(("histogram-count", h["name"], lbl(h),
+                             float(h["count"])))
+            if isinstance(h.get("sum"), (int, float)):
+                rows.append(("histogram-sum", h["name"], lbl(h),
+                             float(h["sum"])))
+        return spans, rows
+
+    @staticmethod
+    def _run_witness(d: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(d, "witness.json")) as f:
+                w = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return w if isinstance(w, dict) else None
+
+    # -- ingest: event streams ----------------------------------------------
+
+    def ingest_events(self, d: str, base: str) -> int:
+        """Ingest a run dir's streamed ``events.jsonl`` (rotated
+        segments included); returns new-event count.  Fast path: when
+        the rotated-segment signature AND the live file's first line
+        (the session id — a truncate-and-regrow new session can pass a
+        pure size check) are unchanged, only bytes appended to the
+        live file since the cursor are parsed.  Rotation or a new
+        session wipes the dir's events and re-ingests the whole
+        segment chain — the stream stays the source of truth — with
+        the wipe, re-insert, and cursor in ONE transaction, so a crash
+        mid-re-ingest rolls the unit back whole."""
+        from .stream import EVENTS_FILE, read_events, segment_files
+
+        rel = os.path.relpath(os.path.abspath(d), os.path.abspath(base))
+        live = os.path.join(d, EVENTS_FILE)
+        segs = [p for p in segment_files(live) if p != live]
+        sig = json.dumps([[os.path.basename(p), self._size(p)]
+                          for p in segs])
+        head = self._head(live)
+        live_size = self._size(live)
+        if live_size is None and not segs:
+            return 0
+        with self._lock:
+            row = self.db.execute(
+                "SELECT cursor, sig, head FROM event_cursors "
+                "WHERE dir = ?", (rel,)).fetchone()
+            cursor, old_sig, old_head = row if row else (0, "[]", "")
+            incremental = bool(row) and old_sig == sig \
+                and old_head == head and (live_size or 0) >= cursor
+            if incremental:
+                evs, new_cursor = self._read_incremental(live, cursor)
+                if not evs and new_cursor == cursor:
+                    return 0
+            else:
+                # rotation / new session / first sight: full re-ingest
+                evs = []
+                for p in segs:
+                    evs.extend(read_events(p, spanning=False))
+                live_evs, new_cursor = self._read_incremental(live, 0)
+                evs.extend(live_evs)
+            with self.db:
+                if not incremental:
+                    self.db.execute("DELETE FROM events WHERE dir = ?",
+                                    (rel,))
+                self.db.executemany(
+                    "INSERT INTO events(dir, t, ev, doc) "
+                    "VALUES (?, ?, ?, ?)",
+                    [(rel, e.get("t"), e.get("ev"),
+                      json.dumps(e, separators=(",", ":")))
+                     for e in evs])
+                self.db.execute(
+                    "INSERT INTO event_cursors(dir, cursor, sig, head) "
+                    "VALUES (?, ?, ?, ?) ON CONFLICT(dir) DO UPDATE "
+                    "SET cursor = ?, sig = ?, head = ?",
+                    (rel, new_cursor, sig, head,
+                     new_cursor, sig, head))
+            return len(evs)
+
+    @staticmethod
+    def _head(path: str) -> str:
+        """The live file's first complete line, as the session
+        identity — ONE implementation shared with the follow_events
+        cursor (stream._first_line), so the ingest and the follower
+        can't disagree about what counts as the same session."""
+        from .stream import _first_line
+
+        return _first_line(path)
+
+    @staticmethod
+    def _size(path: str) -> Optional[int]:
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return None
+
+    @staticmethod
+    def _read_incremental(path: str, cursor: int
+                          ) -> Tuple[List[Dict[str, Any]], int]:
+        from .stream import read_events_incremental
+
+        # stop_at_corrupt: index exactly the prefix the read_events
+        # scan delivers, so `tail --since` renders identically from
+        # either backend (a corrupt line also pins cursor < size,
+        # gating events_fresh off — the scan then answers)
+        return read_events_incremental(path, cursor, follow_rotation=False,
+                                       stop_at_corrupt=True)
+
+    def events_fresh(self, d: str, base: str) -> bool:
+        """True iff the dir's event stream is fully ingested — the gate
+        for the ``cli tail --since`` warehouse path."""
+        from .stream import EVENTS_FILE, segment_files
+
+        rel = os.path.relpath(os.path.abspath(d), os.path.abspath(base))
+        live = os.path.join(d, EVENTS_FILE)
+        segs = [p for p in segment_files(live) if p != live]
+        sig = json.dumps([[os.path.basename(p), self._size(p)]
+                          for p in segs])
+        size = self._size(live)
+        if size is None and not segs:
+            return False
+        with self._lock:
+            row = self.db.execute(
+                "SELECT cursor, sig, head FROM event_cursors "
+                "WHERE dir = ?", (rel,)).fetchone()
+        return bool(row) and row[1] == sig and row[0] == (size or 0) \
+            and row[2] == self._head(live)
+
+    def events_since(self, d: str, base: str,
+                     since: Optional[float] = None
+                     ) -> List[Dict[str, Any]]:
+        rel = os.path.relpath(os.path.abspath(d), os.path.abspath(base))
+        q = "SELECT doc FROM events WHERE dir = ?"
+        args: List[Any] = [rel]
+        if since is not None:
+            q += " AND t >= ?"
+            args.append(float(since))
+        q += " ORDER BY id"
+        with self._lock:
+            rows = self.db.execute(q, args).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    # -- ingest: bench -------------------------------------------------------
+
+    def ingest_bench(self, payload: Dict[str, Any], source: str) -> None:
+        """Upsert one BENCH payload keyed by ``source`` (a file name
+        for committed BENCH_r0*.json, a timestamped tag for bench.py
+        self-ingest) — the r03→r05 throughput trajectory becomes a
+        queryable series instead of loose files."""
+        with self._lock, self.db:
+            self.db.execute(
+                "INSERT OR REPLACE INTO bench(source, ingested_at, "
+                "metric, value, unit, vs_baseline, n_txns, backend, "
+                "wall_s, compile_or_warmup_s, doc) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (source, time.time(), payload.get("metric"),
+                 payload.get("value"), payload.get("unit"),
+                 payload.get("vs_baseline"), payload.get("n_txns"),
+                 payload.get("backend"), payload.get("wall_s"),
+                 payload.get("compile_or_warmup_s"),
+                 json.dumps(payload)))
+
+    def ingest_bench_file(self, path: str) -> bool:
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("bench ingest skipped %s: %s", path, e)
+            return False
+        if not isinstance(payload, dict):
+            return False
+        # the committed BENCH_r0*.json are driver wrappers ({n, cmd,
+        # rc, tail, parsed}) around the bench's JSON line — unwrap
+        if "metric" not in payload and \
+                isinstance(payload.get("parsed"), dict):
+            payload = payload["parsed"]
+        if "metric" not in payload:
+            logger.warning("bench ingest skipped %s: no metric", path)
+            return False
+        self.ingest_bench(payload, os.path.basename(path))
+        return True
+
+    def bench_series(self) -> List[Dict[str, Any]]:
+        """The bench trajectory, ordered by source name (BENCH_r03 <
+        BENCH_r04 < ...)."""
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT source, metric, value, unit, vs_baseline, "
+                "n_txns, backend, wall_s, compile_or_warmup_s "
+                "FROM bench ORDER BY source").fetchall()
+        cols = ("source", "metric", "value", "unit", "vs_baseline",
+                "n_txns", "backend", "wall_s", "compile_or_warmup_s")
+        return [dict(zip(cols, r)) for r in rows]
+
+    # -- ingest: whole store -------------------------------------------------
+
+    def ingest_store(self, base: str,
+                     events: bool = True) -> Dict[str, int]:
+        """Incrementally ingest everything under a store dir: campaign
+        ledgers, run dirs, and (optionally) event streams.  Re-running
+        on an unchanged store is a no-op."""
+        from jepsen_tpu import store as store_mod
+
+        stats = {"ledgers": 0, "records": 0, "runs": 0, "events": 0}
+        cdir = os.path.join(base, "campaigns")
+        if os.path.isdir(cdir):
+            for fn in sorted(os.listdir(cdir)):
+                if fn.endswith(".jsonl"):
+                    n = self.ingest_ledger(os.path.join(cdir, fn), base)
+                    stats["ledgers"] += 1
+                    stats["records"] += n
+        for d in store_mod.tests(base=base):
+            if self.ingest_run_dir(d, base):
+                stats["runs"] += 1
+            if events:
+                stats["events"] += self.ingest_events(d, base)
+        return stats
+
+    def rebuild(self, base: str) -> Dict[str, int]:
+        """Reconstruct from scratch: wipe every derived row, then
+        re-ingest the whole store.  The jsonl ledgers are the source of
+        truth; this is always safe.  The ``bench`` table survives — its
+        payloads come from OUTSIDE the store (BENCH_*.json files,
+        bench.py self-ingest) and can't be rederived from it."""
+        with self._lock, self.db:
+            for tbl in _DATA_TABLES:
+                if tbl != "bench":
+                    self.db.execute(f"DELETE FROM {tbl}")
+        return self.ingest_store(base)
+
+    def counts(self) -> Dict[str, int]:
+        out = {}
+        with self._lock:
+            for tbl in _DATA_TABLES:
+                out[tbl] = self.db.execute(
+                    f"SELECT COUNT(*) FROM {tbl}").fetchone()[0]
+        return out
+
+    # -- SQL-backed campaign queries (Index fast paths) ----------------------
+    #
+    # Each returns EXACTLY what the jsonl scan returns (same ordering,
+    # same percentile formula, same rounding) — tests assert equality.
+
+    def flips(self, ledger_rel: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT key, run, from_valid, to_valid, regression, "
+                "ts, gen FROM flip_rollup WHERE ledger = ? "
+                "ORDER BY key, record_id", (ledger_rel,)).fetchall()
+        return [{"key": key, "run": run, "from": _loads(pv),
+                 "to": _loads(cv), "regression": bool(reg), "when": ts,
+                 "gen": gen}
+                for key, run, pv, cv, reg, ts, gen in rows]
+
+    def span_values(self, ledger_rel: str) -> Dict[str, List[float]]:
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT name, dur_s FROM record_spans WHERE ledger = ? "
+                "ORDER BY record_id", (ledger_rel,)).fetchall()
+        out: Dict[str, List[float]] = {}
+        for name, dur in rows:
+            out.setdefault(name, []).append(dur)
+        return out
+
+    def span_stats(self, ledger_rel: str) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT name, count, min, p50, p95, max FROM span_rollup "
+                "WHERE ledger = ?", (ledger_rel,)).fetchall()
+        return {name: {"count": count, "min": mn, "p50": p50,
+                       "p95": p95, "max": mx}
+                for name, count, mn, p50, p95, mx in
+                sorted(rows, key=lambda r: r[0])}
+
+    def span_samples(self, ledger_rel: str, name: str
+                     ) -> List[Tuple[Optional[str], float]]:
+        """(gen, duration) pairs for one span name, in append order —
+        the material for span_trend and the regression gate."""
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT r.gen, s.dur_s FROM record_spans s "
+                "JOIN campaign_records r ON r.id = s.record_id "
+                "WHERE s.ledger = ? AND s.name = ? ORDER BY s.record_id",
+                (ledger_rel, name)).fetchall()
+        return [(gen, dur) for gen, dur in rows]
+
+    def span_trend(self, ledger_rel: str, name: str
+                   ) -> List[Tuple[str, float]]:
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT gen, p95 FROM span_gen_rollup WHERE ledger = ? "
+                "AND name = ? ORDER BY first_id",
+                (ledger_rel, name)).fetchall()
+        return [(gen, p95) for gen, p95 in rows]
+
+    def latest_by_run(self, ledger_rel: str) -> Dict[str, Dict[str, Any]]:
+        """The LATEST verdict-bearing record per run id, reconstructed
+        to the shape the web grid and verdict_counts consume."""
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT r.run, r.key, r.workload, r.fault, r.seed, "
+                "r.valid, r.error, r.degraded, r.deadline, r.dir, "
+                "r.ops, r.wall_s, r.gen, r.ts, r.witness "
+                "FROM campaign_records r JOIN ("
+                "  SELECT run, MAX(id) AS mid FROM campaign_records"
+                "  WHERE ledger = ? AND valid IS NOT NULL"
+                "    AND run IS NOT NULL AND run != '' GROUP BY run) t "
+                "ON r.id = t.mid", (ledger_rel,)).fetchall()
+        out: Dict[str, Dict[str, Any]] = {}
+        for (run, key, wl, fl, seed, valid, error, degraded, deadline,
+             d, ops, wall_s, gen, ts, wit) in rows:
+            out[run] = {
+                "run": run, "key": key, "workload": wl, "fault": fl,
+                "seed": _loads(seed) if seed is not None else None,
+                "valid?": _loads(valid),
+                "error": error, "degraded": degraded,
+                "deadline": bool(deadline), "dir": d, "ops": ops,
+                "wall_s": wall_s, "gen": gen, "ts": ts,
+                "witness": json.loads(wit) if wit else None,
+            }
+        return out
+
+    def verdict_counts(self, ledger_rel: str,
+                       runs: Optional[Any] = None) -> Dict[str, int]:
+        from jepsen_tpu.campaign.index import verdict_counts_over
+
+        latest = self.latest_by_run(ledger_rel)
+        if runs is not None:
+            wanted = set(runs)
+            latest = {k: v for k, v in latest.items() if k in wanted}
+        return verdict_counts_over(latest.values())
+
+    def witness_records(self, ledger_rel: str
+                        ) -> Dict[str, List[Dict[str, Any]]]:
+        """Witness-bearing records grouped by key, in append order —
+        the input shape `index.witness_pair_diffs` consumes."""
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT key, gen, witness FROM campaign_records "
+                "WHERE ledger = ? AND witness IS NOT NULL "
+                "AND key IS NOT NULL AND key != '' ORDER BY id",
+                (ledger_rel,)).fetchall()
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for key, gen, wit in rows:
+            w = json.loads(wit)
+            if isinstance(w, dict) and w.get("ops"):
+                out.setdefault(key, []).append({"gen": gen, "witness": w})
+        return out
+
+    # -- rollups (the /metrics exposition) -----------------------------------
+
+    def rollups(self) -> Dict[str, Any]:
+        """Warehouse-wide gauges for the Prometheus exposition: runs by
+        verdict, per-campaign latest verdict counts, latest bench
+        throughput."""
+        with self._lock:
+            run_rows = self.db.execute(
+                "SELECT valid, COUNT(*) FROM runs GROUP BY valid"
+            ).fetchall()
+            ledgers = [r[0] for r in self.db.execute(
+                "SELECT DISTINCT ledger FROM campaign_records").fetchall()]
+        runs_by_verdict: Dict[str, int] = {}
+        for valid, n in run_rows:
+            if valid is None:
+                k = "none"
+            else:
+                v = json.loads(valid)
+                k = ("true" if v is True else
+                     "false" if v is False else "unknown")
+            runs_by_verdict[k] = runs_by_verdict.get(k, 0) + n
+        campaigns = {}
+        for led in ledgers:
+            name = os.path.basename(led)
+            if name.endswith(".jsonl"):
+                name = name[:-len(".jsonl")]
+            campaigns[name] = self.verdict_counts(led)
+        return {"runs_by_verdict": runs_by_verdict,
+                "campaigns": campaigns,
+                "bench": self.bench_series()}
+
+    # -- raw SQL (cli obs sql; read-only) ------------------------------------
+
+    def query(self, sql: str) -> Tuple[List[str], List[Tuple]]:
+        """Run one read-only statement (the ``cli obs sql`` cookbook
+        hook).  Writes are refused — enforced at the ENGINE level via a
+        throwaway ``mode=ro`` connection, not just the keyword check:
+        sqlite accepts CTE-prefixed writes (``WITH x AS (SELECT 1)
+        DELETE FROM ...``) that a prefix regex would wave through."""
+        if not re.match(r"\s*(SELECT|WITH|EXPLAIN|PRAGMA)\b", sql,
+                        re.IGNORECASE):
+            raise ValueError("obs sql is read-only (SELECT/WITH only)")
+        con = sqlite3.connect(f"file:{self.path}?mode=ro", uri=True)
+        try:
+            cur = con.execute(sql)
+            cols = [c[0] for c in cur.description or []]
+            return cols, cur.fetchall()
+        finally:
+            con.close()
+
+
+class _Absent:
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "<absent>"
+
+
+_ABSENT = _Absent()
+
+
+# ---------------------------------------------------------------------------
+# Shared handles: the web server and Index fast paths reuse one
+# connection per warehouse file instead of re-opening sqlite per request.
+# ---------------------------------------------------------------------------
+
+_CACHE: Dict[str, Warehouse] = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def _cached(path: str) -> Warehouse:
+    key = os.path.abspath(path)
+    with _CACHE_LOCK:
+        wh = _CACHE.get(key)
+        if wh is not None and not wh.file_unchanged():
+            # the file was deleted or replaced under the cache (rm +
+            # `obs rebuild` in another process): drop the handle bound
+            # to the old inode and re-open the path
+            wh.close()
+            del _CACHE[key]
+            wh = None
+        if wh is None:
+            wh = _CACHE[key] = Warehouse(path)
+        return wh
+
+
+def open_or_create(base: str) -> Warehouse:
+    """The store's warehouse, creating the file on first use (``cli
+    obs ingest`` / bench self-ingest)."""
+    return _cached(warehouse_path(base))
+
+
+def open_if_exists(base: str) -> Optional[Warehouse]:
+    """The store's warehouse ONLY if someone already built one — the
+    read surfaces (web, Index fast paths) never create it implicitly.
+    A cached handle is only trusted while the file still names the
+    inode it opened (a deleted warehouse returns None again; a
+    replaced one — rm + rebuild in another process — is re-opened)."""
+    p = warehouse_path(base)
+    with _CACHE_LOCK:
+        wh = _CACHE.get(os.path.abspath(p))
+        if wh is not None:
+            if wh.file_unchanged():
+                return wh
+            wh.close()
+            del _CACHE[os.path.abspath(p)]
+    if not os.path.exists(p):
+        return None
+    return _cached(p)
+
+
+def for_ledger(ledger_path: str) -> Optional[Tuple[Warehouse, str]]:
+    """(warehouse, ledger-rel-path) when a warehouse exists next to
+    this campaign ledger AND fully covers it (cursor == size) — the
+    Index fast-path gate.  None means: use the jsonl scan."""
+    base = os.path.dirname(os.path.dirname(os.path.abspath(ledger_path)))
+    try:
+        wh = open_if_exists(base)
+        if wh is None:
+            return None
+        if not wh.ledger_fresh(ledger_path, base):
+            return None
+        rel = os.path.relpath(os.path.abspath(ledger_path), base)
+        return wh, rel
+    except sqlite3.Error as e:  # corrupt warehouse: fall back to jsonl
+        logger.warning("warehouse unavailable for %s: %s", ledger_path, e)
+        return None

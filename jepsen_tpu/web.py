@@ -136,6 +136,8 @@ class _Handler(BaseHTTPRequestHandler):
             path = unquote(urlparse(self.path).path)
             if path in ("/", "/index.html"):
                 return self._index()
+            if path == "/metrics":
+                return self._metrics()
             if path.startswith("/files/"):
                 return self._files(path[len("/files/"):])
             if path.startswith("/zip/"):
@@ -158,6 +160,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._campaign_live(rel[:-len("/live")])
                 if rel.endswith("/witness-diff"):
                     return self._witness_diff(rel[:-len("/witness-diff")])
+                if rel.endswith("/trend"):
+                    return self._trend(rel[:-len("/trend")])
                 return self._campaign(rel)
             self._send(404, b"not found", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
@@ -190,9 +194,10 @@ class _Handler(BaseHTTPRequestHandler):
                 f"{tel}"
                 f'<td><a href="/zip/{quote(rel)}">zip</a></td>'
                 "</tr>")
-        camp = ('<p><a href="/campaigns">campaigns</a></p>'
+        camp = ('<p><a href="/campaigns">campaigns</a> &middot; '
+                '<a href="/metrics">metrics</a></p>'
                 if os.path.isdir(os.path.join(self.base, "campaigns"))
-                else "")
+                else '<p><a href="/metrics">metrics</a></p>')
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
 <title>jepsen-tpu</title><style>
 body {{ font-family: sans-serif; margin: 2em; }}
@@ -234,16 +239,42 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 <title>{html.escape(rel)}</title><style>
 body {{ font-family: sans-serif; margin: 2em; }}
 pre {{ background: #f6f6f6; padding: 1em; overflow-x: auto; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 3px 8px; }}
 {_BADGE_CSS}</style></head><body>
 <p><a href="/">&larr; runs</a></p>
 <h2>{html.escape(s["name"])} <small>{html.escape(s["timestamp"])}</small>
 {_verdict_badges(s["valid?"], s["error"], s["degraded"], s["deadline"])}</h2>
 <p><a href="/files/{quote(rel)}/">files</a> {tel}{live}{wit}&middot;
 <a href="/zip/{quote(rel)}">zip</a></p>
+{self._warehouse_spans_html(rel)}
 <pre>{html.escape(results or "no results.json (run still in flight, "
                              "or it crashed before analysis)")}</pre>
 </body></html>"""
         self._send(200, doc.encode())
+
+    def _warehouse_spans_html(self, rel: str) -> str:
+        """Span totals for one run from the warehouse's ``run_spans``
+        table (when it's been ingested) — the run page then shows its
+        span profile without re-parsing telemetry.json per request."""
+        try:
+            from .telemetry import warehouse as wmod
+
+            wh = wmod.open_if_exists(self.base)
+            if wh is None:
+                return ""
+            rows = wh.run_spans(rel)
+        except Exception:  # noqa: BLE001 — decorative, never 500 a page
+            return ""
+        if not rows:
+            return ""
+        trs = "".join(
+            f"<tr><td><code>{html.escape(name)}</code></td>"
+            f"<td>{total:.4f}</td><td>{count}</td></tr>"
+            for name, total, count in rows)
+        return ("<h3>spans <small>(warehouse)</small></h3>"
+                "<table><tr><th>span</th><th>total s</th><th>count</th>"
+                f"</tr>{trs}</table>")
 
     def _witness(self, rel: str):
         """Minimal-witness page (docs/MINIMIZE.md): the shrunk failing
@@ -314,10 +345,45 @@ anomalies: <code>{html.escape(", ".join(w.get("anomaly-types") or ()))}
 </body></html>"""
         self._send(200, doc.encode())
 
+    def _autoingest(self) -> None:
+        """When a warehouse exists, incrementally ingest any campaign
+        ledger growth before a campaign page renders
+        (docs/TELEMETRY.md): the byte cursors make an unchanged ledger
+        a no-op, and the Index fast paths then answer from indexed SQL
+        instead of re-parsing the jsonl per request.  Ledgers ONLY —
+        everything these pages render comes from campaign_records;
+        run-dir/event ingest (which stats every run dir in the store)
+        stays with `cli obs ingest`.  No warehouse -> no-op (the read
+        surfaces never create one implicitly)."""
+        try:
+            from .telemetry import warehouse as wmod
+
+            wh = wmod.open_if_exists(self.base)
+            if wh is None:
+                return
+            cdir = os.path.join(self.base, "campaigns")
+            if os.path.isdir(cdir):
+                for fn in sorted(os.listdir(cdir)):
+                    if fn.endswith(".jsonl"):
+                        wh.ingest_ledger(os.path.join(cdir, fn),
+                                         self.base)
+        except Exception:  # noqa: BLE001 — rendering must survive
+            logger.debug("warehouse auto-ingest failed", exc_info=True)
+
+    def _metrics(self):
+        """Prometheus text exposition (docs/TELEMETRY.md): the live
+        registry's counters/gauges/histograms, campaign heartbeat
+        freshness, and warehouse rollup gauges."""
+        from .telemetry import prometheus as prom
+
+        body = prom.exposition(base=self.base)
+        self._send(200, body.encode(), prom.CONTENT_TYPE)
+
     def _campaigns(self):
         """Campaign list: every jsonl ledger under <store>/campaigns."""
         from .campaign.index import Index
 
+        self._autoingest()
         cdir = os.path.join(self.base, "campaigns")
         rows = []
         if os.path.isdir(cdir):
@@ -361,15 +427,15 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
         carry distinct badges), plus regressions and span aggregates."""
         from .campaign.index import Index
 
+        self._autoingest()
         name = unquote(name).rstrip("/")
         path = self._safe_path(os.path.join("campaigns", name + ".jsonl"))
         if path is None or not os.path.exists(path):
             return self._send(404, b"no such campaign", "text/plain")
         idx = Index(path)
-        latest: Dict[str, Dict[str, Any]] = {}
-        for r in idx.records:
-            if "valid?" in r and r.get("run"):
-                latest[r["run"]] = r
+        # warehouse-backed when fresh: the grid, the regression list,
+        # and the span aggregates below then never parse the jsonl
+        latest = idx.latest_by_run()
         seeds = sorted({r.get("seed") for r in latest.values()
                         if r.get("seed") is not None})
         grid: Dict[tuple, Dict[Any, Dict[str, Any]]] = {}
@@ -431,7 +497,8 @@ a {{ text-decoration: none; }}
 {_BADGE_CSS}</style></head><body>
 <p><a href="/campaigns">&larr; campaigns</a> &middot;
 <a href="/campaign/{quote(name)}/live">live</a> &middot;
-<a href="/campaign/{quote(name)}/witness-diff">witness diff</a></p>
+<a href="/campaign/{quote(name)}/witness-diff">witness diff</a> &middot;
+<a href="/campaign/{quote(name)}/trend">trend</a></p>
 <h1>campaign {html.escape(name)}</h1>
 <table><tr><th>workload</th><th>fault</th>{head}</tr>
 {"".join(rows)}</table>
@@ -642,6 +709,72 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 </body></html>"""
         self._send(200, doc.encode())
 
+    def _trend(self, name: str):
+        """Span-duration trend page: per span site, the p95 per
+        campaign generation (the `span_trend` query, warehouse-backed
+        when fresh) — the data `cli obs gate` turns into a CI check."""
+        from .campaign.index import Index
+
+        self._autoingest()
+        name = unquote(name).rstrip("/")
+        path = self._safe_path(os.path.join("campaigns", name + ".jsonl"))
+        if path is None or not os.path.exists(path):
+            return self._send(404, b"no such campaign", "text/plain")
+        idx = Index(path)
+        stats = idx.span_stats()
+        trends: Dict[str, Dict[str, float]] = {}
+        for span in stats:
+            for g, p95 in idx.span_trend(span):
+                trends.setdefault(span, {})[g] = p95
+        # column order must be chronological across ALL spans — gens
+        # are run_campaign UTC timestamps, so a lexical sort IS time
+        # order ("?" last); per-span first-seen order would scramble
+        # columns when spans cover different generation subsets and
+        # the >25% highlight would compare non-adjacent generations
+        gens = sorted({g for t in trends.values() for g in t},
+                      key=lambda g: (g == "?", g))
+        rows = []
+        for span in sorted(trends):
+            cells = []
+            prev = None
+            for g in gens:
+                v = trends[span].get(g)
+                if v is None:
+                    cells.append("<td>-</td>")
+                    prev = None  # gap: don't compare across it — the
+                    # highlight promises ADJACENT-generation deltas
+                    continue
+                mark = ""
+                if prev is not None and prev > 0:
+                    delta = (v - prev) / prev
+                    if delta > 0.25:
+                        mark = ' style="background:#f2a3a3"'
+                    elif delta < -0.25:
+                        mark = ' style="background:#9ce29c"'
+                cells.append(f"<td{mark}>{v:.4f}</td>")
+                prev = v
+            rows.append(f"<tr><td><code>{html.escape(span)}</code></td>"
+                        + "".join(cells) + "</tr>")
+        head = "".join(f"<th>{html.escape(g)}</th>" for g in gens)
+        body = ("<table><tr><th>span</th>" + head + "</tr>"
+                + "".join(rows) + "</table>" if rows else
+                "<p>no span samples indexed yet (runs need "
+                "<code>\"telemetry\": true</code>).</p>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>trend — {html.escape(name)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/campaign/{quote(name)}">&larr; campaign</a></p>
+<h1>span p95 trend — {html.escape(name)}</h1>
+<p>p95 span duration (s) per campaign generation; a &gt;25% step vs
+the previous generation is highlighted.  Enforce with
+<code>cli obs gate --campaign {html.escape(name)} --span &lt;name&gt;
+</code> (docs/TELEMETRY.md).</p>
+{body}</body></html>"""
+        self._send(200, doc.encode())
+
     def _witness_diff(self, name: str):
         """Witness drift across campaign generations (ROADMAP open
         item): per regression key, how the auto-shrunk minimal witness
@@ -651,6 +784,7 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
         the verdict grid still just shows False."""
         from .campaign.index import Index
 
+        self._autoingest()
         name = unquote(name).rstrip("/")
         path = self._safe_path(os.path.join("campaigns", name + ".jsonl"))
         if path is None or not os.path.exists(path):

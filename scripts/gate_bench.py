@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""obs-gate smoke (ISSUE 6 satellite): end-to-end regression gating.
+
+Runs a real telemetric mini-campaign into a throwaway store, then
+synthesizes two more generations from its ledger records — one
+unchanged (span durations jittered +-2%) and one carrying an injected
++50% p95 regression — ingests everything into the sqlite warehouse
+(`cli obs ingest`), and drives `cli obs gate` through its real argv
+surface, asserting the CI contract:
+
+    unchanged pair   -> exit 0 (PASS)
+    injected +50%    -> exit 1 (REGRESSION)
+    unknown span     -> exit 2 (cannot evaluate)
+
+Each gate decision is checked twice — BEFORE the warehouse exists
+(jsonl scan fallback) and AFTER `obs ingest` (SQL fast path) — and the
+two backends must agree.  Exercised by tier-1 via
+tests/test_warehouse.py's subprocess smoke.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/gate_bench.py
+    python scripts/gate_bench.py --runs 6 --keep-store
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu.utils.backend import force_cpu_backend  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu" \
+        or os.environ.get("JT_FORCE_CPU"):
+    force_cpu_backend()
+
+
+def synthesize_generations(path: str, scale: float, rng) -> int:
+    """Append two synthetic generations to a campaign ledger, anchored
+    at the REAL runs' per-span median (the first real generation
+    carries jit-warmup outliers that would drown a rank test at small
+    n): gen ``same`` draws median * U(0.9, 1.1) per run, and gen
+    ``regress`` is the SAME jittered samples * ``scale`` — a pure
+    injected regression, nothing else changed."""
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    first_gen = records[0].get("gen")
+    base = [r for r in records
+            if r.get("gen") == first_gen and r.get("spans")]
+    med = {}
+    for name in {n for r in base for n in r["spans"]}:
+        vals = sorted(r["spans"][name] for r in base if name in r["spans"])
+        med[name] = vals[len(vals) // 2]
+    jitter = [{name: m * rng.uniform(0.9, 1.1)
+               for name, m in med.items()} for _ in base]
+    with open(path, "a") as f:
+        for gen, mult in (("same", 1.0), ("regress", scale)):
+            for rec, spans in zip(base, jitter):
+                clone = dict(rec)
+                clone["gen"] = gen
+                clone["run"] = f"{rec.get('run')}@{gen}"
+                clone["spans"] = {name: round(v * mult, 9)
+                                  for name, v in spans.items()}
+                f.write(json.dumps(clone) + "\n")
+    return len(base)
+
+
+def gate(disp, base: str, campaign: str, span: str, pair=None) -> int:
+    from jepsen_tpu import cli
+
+    argv = ["--store-dir", base, "obs", "gate",
+            "--campaign", campaign, "--span", span]
+    if pair:
+        argv += ["--from-gen", pair[0], "--to-gen", pair[1]]
+    return cli.run(disp, argv)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--runs", type=int, default=4,
+                    help="campaign cells (= samples per generation); "
+                         "the Mann-Whitney gate needs >= 3")
+    ap.add_argument("--keep-store", action="store_true",
+                    help="leave the throwaway store on disk")
+    args = ap.parse_args()
+
+    from jepsen_tpu import campaign, cli
+
+    base = tempfile.mkdtemp(prefix="jepsen-gate-smoke-")
+    t0 = time.time()
+    try:
+        spec = {"name": "gate-smoke", "workloads": ["set"],
+                "seeds": list(range(args.runs)),
+                "opts": {"time-limit": 0.2, "telemetry": True,
+                         "concurrency": 2}}
+        summary = campaign.run_campaign(spec, base, workers=2)
+        assert summary["executed"] == args.runs, summary
+        path = summary["index"]
+
+        # pick a real checker span from the ledger to gate on
+        with open(path) as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        spans = sorted({name for r in recs
+                        for name in (r.get("spans") or ())
+                        if name.startswith("check:")})
+        span = spans[0] if spans else "workload"
+        real_gen = recs[0]["gen"]
+
+        n_synth = synthesize_generations(path, 1.5, random.Random(0))
+        assert n_synth == args.runs
+        print(f"ledger: {args.runs} real runs (gen {real_gen}) "
+              f"+ {n_synth} unchanged + {n_synth} regressed (x1.5), "
+              f"gating span {span!r}")
+
+        disp = cli.single_test_cmd(lambda o: {})
+        results = {}
+        for label in ("jsonl-scan", "warehouse"):
+            rc_pass = gate(disp, base, "gate-smoke", span,
+                           (real_gen, "same"))
+            rc_reg = gate(disp, base, "gate-smoke", span,
+                          ("same", "regress"))
+            rc_default = gate(disp, base, "gate-smoke", span)
+            rc_unknown = gate(disp, base, "gate-smoke", "no-such-span")
+            results[label] = (rc_pass, rc_reg, rc_default, rc_unknown)
+            if label == "jsonl-scan":  # second lap: the SQL fast path
+                assert cli.run(disp, ["--store-dir", base,
+                                      "obs", "ingest"]) == 0
+        assert results["jsonl-scan"] == results["warehouse"], \
+            f"backends disagree: {results}"
+        rc_pass, rc_reg, rc_default, rc_unknown = results["warehouse"]
+        assert rc_pass == 0, f"unchanged pair gated rc={rc_pass}, want 0"
+        assert rc_reg == 1, f"+50% regression gated rc={rc_reg}, want 1"
+        assert rc_default == 1, \
+            f"default pair (two latest) gated rc={rc_default}, want 1"
+        assert rc_unknown == 2, \
+            f"unknown span gated rc={rc_unknown}, want 2"
+        print(f"gate smoke OK in {time.time() - t0:.1f}s: pass=0 "
+              "regression=1 unknown=2, scan == warehouse")
+        return 0
+    finally:
+        if args.keep_store:
+            print(f"store kept at {base}")
+        else:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

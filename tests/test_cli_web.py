@@ -495,3 +495,146 @@ def test_cli_cpu_flag_forces_cpu_backend(tmp_path, monkeypatch):
                   "test-all", "--only", "set", "--time-limit", "1"])
     assert rc == 0
     assert calls, "JT_FORCE_CPU=1 must force the CPU backend"
+
+
+# ------------------------------------------- ISSUE 6: the observatory
+
+def test_parse_since():
+    now = 1_000_000_000.0
+    assert cli.parse_since("90s", now) == now - 90
+    assert cli.parse_since("5m", now) == now - 300
+    assert cli.parse_since("2h", now) == now - 7200
+    assert cli.parse_since("1d", now) == now - 86400
+    assert cli.parse_since("45", now) == now - 45  # bare small: duration
+    assert cli.parse_since("1722650000", now) == 1722650000.0  # epoch
+    assert cli.parse_since("1970-01-01T00:01:40", now) == 100.0
+    with pytest.raises(ValueError):
+        cli.parse_since("next tuesday", now)
+
+
+def test_cli_tail_since_scan_and_warehouse_agree(tmp_path, capsys):
+    """`tail --since` filters to recent events — from the stream scan
+    when no warehouse covers the run, from the indexed event table
+    when one does; both views must render identically."""
+    base = str(tmp_path / "s")
+    t = core.run(_test_fn({"store-dir": base, "telemetry": True}))
+    d = store.test_dir(t)
+    disp = cli.single_test_cmd(_test_fn)
+    assert cli.run(disp, ["tail", d, "--since", "1h"]) == 0
+    scan_out = capsys.readouterr().out
+    assert "run ended cleanly" in scan_out
+    # --since now: every event is older, nothing renders but the
+    # truncated-stream footer
+    assert cli.run(disp, ["tail", d, "--since", "0s"]) == 0
+    out = capsys.readouterr().out
+    assert "no open spans" in out and " span " not in out
+    # bad spec: clean error
+    assert cli.run(disp, ["tail", d, "--since", "nope"]) == 2
+    capsys.readouterr()
+    # now build the warehouse: same question, indexed answer
+    from jepsen_tpu.telemetry import warehouse as wmod
+
+    wh = wmod.open_or_create(base)
+    wh.ingest_store(base)
+    assert wh.events_fresh(d, base)
+    assert cli.run(disp, ["tail", d, "--since", "1h"]) == 0
+    assert capsys.readouterr().out == scan_out
+
+
+def test_web_metrics_endpoint(tmp_path):
+    """/metrics (ISSUE 6): Prometheus text exposition with the
+    pinned content type; campaign heartbeats and warehouse rollups
+    appear when present."""
+    base = str(tmp_path / "s")
+    os.makedirs(os.path.join(base, "campaigns"))
+    with open(os.path.join(base, "campaigns", "soak.jsonl"), "w") as f:
+        f.write(json.dumps({"campaign": "soak", "run": "r1",
+                            "key": "k", "valid?": True, "gen": "g1",
+                            "spans": {"check:la": 1.0}}) + "\n")
+    with open(os.path.join(base, "campaigns",
+                           "soak.live.json"), "w") as f:
+        json.dump({"campaign": "soak", "updated": time.time(),
+                   "total": 4, "done": 1, "workers": {},
+                   "finished": False}, f)
+    from jepsen_tpu.telemetry import warehouse as wmod
+
+    wmod.open_or_create(base).ingest_store(base)
+    srv = web.serve(port=0, base=base, background=True)
+    try:
+        port = srv.server_address[1]
+        status, ctype, body = _get(port, "/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain; version=0.0.4")
+        text = body.decode()
+        assert "# TYPE jepsen_campaign_runs_done gauge" in text
+        assert 'jepsen_campaign_runs_done{campaign="soak"} 1' in text
+        assert ('jepsen_warehouse_campaign_runs{campaign="soak",'
+                'valid="true"} 1') in text
+        assert text.endswith("\n")
+        # the index page links to it
+        status, _, body = _get(port, "/")
+        assert b"/metrics" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_web_trend_page_and_run_page_warehouse_spans(tmp_path):
+    """/campaign/<name>/trend (ISSUE 6): the per-generation span p95
+    table the gate enforces; and the run page's warehouse-backed span
+    profile."""
+    base = str(tmp_path / "s")
+    t = core.run(_test_fn({"store-dir": base, "telemetry": True}))
+    rel = os.path.relpath(store.test_dir(t), base)
+    os.makedirs(os.path.join(base, "campaigns"), exist_ok=True)
+    with open(os.path.join(base, "campaigns", "soak.jsonl"), "w") as f:
+        for gen, dur in (("g1", 1.0), ("g1", 1.1), ("g2", 2.0)):
+            f.write(json.dumps({
+                "campaign": "soak", "run": f"r-{gen}-{dur}", "key": "k",
+                "valid?": True, "gen": gen,
+                "spans": {"check:la": dur}}) + "\n")
+        # check:aaa sorts FIRST and skips g2 (samples in g1 + g3 only):
+        # column order must stay chronological (g1 g2 g3), not
+        # per-span first-seen — which would yield g1 g3 g2 and
+        # mis-pair every row's adjacent-column delta highlight
+        for gen in ("g1", "g3"):
+            f.write(json.dumps({
+                "campaign": "soak", "run": f"r-{gen}-aaa", "key": "k2",
+                "valid?": True, "gen": gen,
+                # aaa doubles g1 -> g3, but with NO g2 sample between:
+                # the highlight promises adjacent-generation deltas,
+                # so the gap must suppress it (asserted below)
+                "spans": {"check:aaa": 2.0 if gen == "g3" else 1.0,
+                          **({"check:la": 2.1} if gen == "g3"
+                             else {})}}) + "\n")
+    from jepsen_tpu.telemetry import warehouse as wmod
+
+    wmod.open_or_create(base).ingest_store(base)
+    srv = web.serve(port=0, base=base, background=True)
+    try:
+        port = srv.server_address[1]
+        status, _, body = _get(port, "/campaign/soak/trend")
+        assert status == 200
+        text = body.decode()
+        assert "check:la" in text
+        assert "<th>g1</th>" in text and "<th>g2</th>" in text
+        # chronological columns even though check:aaa (sorted first)
+        # has no g2 samples
+        assert text.index("<th>g1</th>") < text.index("<th>g2</th>") \
+            < text.index("<th>g3</th>")
+        assert "obs gate" in text  # tells you how to enforce it
+        # >25% step vs the previous generation is highlighted — and
+        # ONLY for adjacent generations: check:la's g1->g2 step is the
+        # single red cell; check:aaa's g1->g3 doubling straddles a
+        # missing g2 and must not be compared across the gap
+        assert text.count("background:#f2a3a3") == 1
+        # the campaign page links to the trend page
+        status, _, body = _get(port, "/campaign/soak")
+        assert status == 200 and b"/campaign/soak/trend" in body
+        # run page: span profile from the warehouse's run_spans table
+        status, _, body = _get(port, f"/run/{rel}")
+        assert status == 200
+        assert b"warehouse" in body and b"check:Stats" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()

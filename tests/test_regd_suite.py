@@ -112,6 +112,8 @@ def test_regd_primary_crash_recovery(tmp_path):
     assert any(op.f == "kill-primary" for op in hist)
 
 
+@pytest.mark.slow  # ~80 s real-daemon soak on this box — tier-1 budget
+# hog, and load-flaky under concurrent suites (PR 5 note)
 def test_regd_stale_reads_caught(tmp_path):
     """--stale-reads + a blocked backup: local backup reads diverge and
     the checker must find realtime anomalies (the deliberate hole)."""

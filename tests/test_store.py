@@ -242,6 +242,8 @@ def test_latest_across_names_orders_by_timestamp(tmp_path):
     assert newest is not None and "aaa-new" in newest
 
 
+@pytest.mark.slow  # ~60 s on this box — tier-1 budget hog (the >60 s
+# convention from ISSUE 3)
 def test_check_stored_streams_chunks(tmp_path):
     # store a multi-chunk run, check it end-to-end via the streaming
     # path, and pin the verdict against the materialized checker

@@ -597,3 +597,221 @@ def test_events_path_follows_the_freshest_stream(tmp_path):
     os.utime(run_p, None)
     os.utime(shrink_p, (1, 1))
     assert tel_stream.events_path(d) == run_p
+
+
+# ----------------------------------------------- size-based rotation
+
+def test_rotation_keeps_n_segments_with_in_stream_markers(tmp_path):
+    """Satellite (ISSUE 6): a bounded stream rotates events.jsonl ->
+    events.jsonl.1 ... keep-N with the rotation recorded IN-STREAM
+    (`rotate` closes the old segment, `rotate-cont` opens the new one),
+    and read_events transparently spans the surviving segments."""
+    p = str(tmp_path / "events.jsonl")
+    s = tel_stream.EventStream(p, meta={"name": "soak"},
+                               max_bytes=400, keep=2)
+    for i in range(60):
+        s.emit("tick", i=i)
+    s.close(valid=True)
+    names = sorted(os.path.basename(x)
+                   for x in tel_stream.segment_files(p))
+    assert names == ["events.jsonl", "events.jsonl.1", "events.jsonl.2"]
+    assert all(os.path.getsize(x) <= 400 + 120
+               for x in tel_stream.segment_files(p))
+    evs = tel_stream.read_events(p)
+    kinds = [e["ev"] for e in evs]
+    assert "rotate" in kinds and "rotate-cont" in kinds
+    st = tel_stream.replay(evs)
+    assert st["rotations"] >= 1 and st["ended"]
+    # keep=2 dropped the oldest segments; the surviving tail is
+    # contiguous and ends at the last tick
+    ticks = [e["i"] for e in evs if e["ev"] == "tick"]
+    assert ticks == list(range(ticks[0], 60))
+
+
+def test_rotation_markers_pair_segment_boundaries(tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    s = tel_stream.EventStream(p, max_bytes=300, keep=9)
+    for i in range(30):
+        s.emit("tick", i=i)
+    s.close()
+    # every rotated segment's LAST event is the rotate marker, and
+    # every continuation file's FIRST is rotate-cont (same segment no.)
+    segs = tel_stream.segment_files(p)
+    assert len(segs) >= 3
+    for seg, nxt in zip(segs[:-1], segs[1:]):
+        last = tel_stream.read_events(seg, spanning=False)[-1]
+        first = tel_stream.read_events(nxt, spanning=False)[0]
+        assert last["ev"] == "rotate"
+        assert first["ev"] == "rotate-cont"
+        assert first["segment"] == last["segment"]
+    # nothing lost across the whole chain (keep was large enough)
+    ticks = [e["i"] for e in tel_stream.read_events(p)
+             if e["ev"] == "tick"]
+    assert ticks == list(range(30))
+
+
+def test_incremental_follower_survives_rotation(tmp_path):
+    """`tail -f`'s byte cursor spans a rotation: when the live file
+    shrinks, the follower first drains the just-rotated segment's tail
+    past its old cursor, then restarts at byte 0 of the new file."""
+    p = str(tmp_path / "events.jsonl")
+    s = tel_stream.EventStream(p, max_bytes=300, keep=5)
+    off, got = 0, []
+    for i in range(40):
+        s.emit("tick", i=i)
+        evs, off = tel_stream.read_events_incremental(p, off)
+        got.extend(evs)
+    ticks = [e["i"] for e in got if e["ev"] == "tick"]
+    assert ticks == list(range(40))
+
+
+def test_follow_events_spans_multiple_rotations_between_polls(tmp_path):
+    """A plain byte cursor points at the wrong segment when the stream
+    rotates twice between polls; follow_events' identity-carrying
+    cursor spans any number of rotations losslessly."""
+    p = str(tmp_path / "events.jsonl")
+    s = tel_stream.EventStream(p, max_bytes=200, keep=10)
+    cur, got = None, []
+    for burst in range(6):
+        # ~10 lines per burst at ~40 B each vs a 200 B bound: >= 2
+        # rotations happen between consecutive polls
+        for i in range(burst * 10, burst * 10 + 10):
+            s.emit("tick", i=i)
+        evs, cur = tel_stream.follow_events(p, cur)
+        got.extend(evs)
+    ticks = [e["i"] for e in got if e["ev"] == "tick"]
+    assert ticks == list(range(60))
+    assert cur["head"].strip()  # cursor carries the live identity
+
+
+def test_first_line_identity_survives_oversized_first_line(tmp_path):
+    """A first line longer than the cap yields a stable capped-prefix
+    identity once the file has grown past it — never a permanent ""
+    that would blind follow_events/tail -f for the whole run."""
+    p = str(tmp_path / "events.jsonl")
+    big = '{"ev": "meta", "pad": "' + "x" * (2 << 20) + '"}\n'
+    with open(p, "w") as f:
+        f.write(big)
+        f.write('{"ev": "tick", "i": 0}\n')
+    h1 = tel_stream._first_line(p)
+    assert h1 and h1 == tel_stream._first_line(p)
+    # a normal small first line still returns the whole line
+    q = str(tmp_path / "small.jsonl")
+    with open(q, "w") as f:
+        f.write('{"ev": "meta"}\n')
+    assert tel_stream._first_line(q) == '{"ev": "meta"}\n'
+    # torn (no newline yet, under the cap): no identity yet
+    r = str(tmp_path / "torn.jsonl")
+    with open(r, "w") as f:
+        f.write('{"ev": "met')
+    assert tel_stream._first_line(r) == ""
+
+
+def test_follow_events_keepn_overrun_no_duplicates(tmp_path):
+    """When the follower's former segment aged out of keep-N, every
+    surviving segment is delivered whole: events are lost (they're
+    gone from disk), but what's delivered is ordered and duplicate
+    free, ending at the stream's last event."""
+    p = str(tmp_path / "events.jsonl")
+    s = tel_stream.EventStream(p, max_bytes=200, keep=1)
+    s.emit("tick", i=0)
+    evs, cur = tel_stream.follow_events(p, None)
+    for i in range(1, 80):  # many rotations; keep=1 drops history
+        s.emit("tick", i=i)
+    evs2, cur = tel_stream.follow_events(p, cur)
+    ticks = [e["i"] for e in evs + evs2 if e["ev"] == "tick"]
+    assert ticks == sorted(set(ticks)), "duplicated or reordered"
+    assert ticks[-1] == 79
+    # a third poll with nothing new delivers nothing
+    evs3, cur = tel_stream.follow_events(p, cur)
+    assert evs3 == []
+
+
+def test_follow_events_segment_walk_race_loses_nothing(tmp_path,
+                                                       monkeypatch):
+    """A rotation firing in the middle of the segment catch-up walk
+    renames other content onto the paths being walked; the post-read
+    fingerprint check must stop the walk at the last good anchor so
+    the next poll re-delivers — nothing lost, nothing duplicated."""
+    p = str(tmp_path / "events.jsonl")
+    s = tel_stream.EventStream(p, max_bytes=200, keep=50)
+    for i in range(20):  # several segments on disk
+        s.emit("tick", i=i)
+    real = tel_stream.read_events
+    fired = []
+
+    def racing(path, spanning=True):
+        evs = real(path, spanning=spanning)
+        if not fired and path != p:  # first rotated segment read
+            fired.append(path)
+            for i in range(100, 130):  # rotations rename mid-walk
+                s.emit("tick", i=i)
+        return evs
+
+    monkeypatch.setattr(tel_stream, "read_events", racing)
+    got, cur = [], None
+    evs, cur = tel_stream.follow_events(p, cur)  # the raced poll
+    got.extend(evs)
+    monkeypatch.setattr(tel_stream, "read_events", real)
+    for _ in range(4):  # drain: each poll may stop at a boundary
+        evs, cur = tel_stream.follow_events(p, cur)
+        got.extend(evs)
+    assert fired, "race injection never fired"
+    ticks = [e["i"] for e in got if e["ev"] == "tick"]
+    assert ticks == list(range(20)) + list(range(100, 130)), ticks
+
+
+def test_new_session_truncation_not_mistaken_for_rotation(tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    s1 = tel_stream.EventStream(p, meta={})
+    for i in range(20):
+        s1.emit("tick", i=i)
+    evs, off = tel_stream.read_events_incremental(p, 0)
+    assert len([e for e in evs if e["ev"] == "tick"]) == 20
+    # a NEW session truncates (no .1 segment exists): cursor resets,
+    # no phantom catch-up events are delivered
+    s2 = tel_stream.EventStream(p, meta={})
+    s2.emit("tick", i=99)
+    evs, off = tel_stream.read_events_incremental(p, off)
+    ticks = [e["i"] for e in evs if e["ev"] == "tick"]
+    assert ticks == [99]
+
+
+def test_attach_env_defaults_enable_rotation(tmp_path, monkeypatch):
+    monkeypatch.setenv("JEPSEN_EVENTS_MAX_BYTES", "300")
+    monkeypatch.setenv("JEPSEN_EVENTS_KEEP", "2")
+
+    class _Col:
+        registry = None
+    rec = tel_stream.attach(_Col(), str(tmp_path), sampler=False)
+    assert rec.stream.max_bytes == 300 and rec.stream.keep == 2
+    rec.close()
+
+
+def test_env_anomaly_counter_and_stream_event(tmp_path):
+    """Satellite (ISSUE 6): environment anomalies (bench r05's 544s
+    backend-init hang) are a structured resilience signal — a labeled
+    counter plus a streamed `env-anomaly` event that replay() tallies
+    — not a free-text field."""
+    from jepsen_tpu.resilience import env_anomaly
+
+    c = telemetry.activate()
+    rec = tel_stream.attach(c, str(tmp_path), sampler=False)
+    try:
+        env_anomaly("backend-init", kind="retried",
+                    probes=17, wait_s=544.0)
+    finally:
+        rec.close()
+        telemetry.deactivate(c)
+    snap = c.registry.snapshot()
+    ctr = [x for x in snap["counters"]
+           if x["name"] == "resilience-env-anomalies"]
+    assert ctr and ctr[0]["value"] == 1
+    assert ctr[0]["labels"] == {"site": "backend-init",
+                                "kind": "retried"}
+    evs = tel_stream.read_events(str(tmp_path / "events.jsonl"))
+    anoms = [e for e in evs if e["ev"] == "env-anomaly"]
+    assert anoms and anoms[0]["wait_s"] == 544.0
+    st = tel_stream.replay(evs)
+    assert st["env_anomalies"] == 1
+    assert "1 env anomalies" in tel_stream.render_tail(evs)
