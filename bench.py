@@ -28,6 +28,14 @@ this long before the CPU fallback, default 450), BENCH_DEADLINE (s,
 default 2700), BENCH_CACHE_DIR (persistent XLA compilation cache,
 default <repo>/.jax_cache).
 
+Streaming mode (``--streaming`` argv or BENCH_STREAMING=1, ISSUE 7
+satellite): additionally feeds each rung's history through the
+incremental ``verifier.VerifierSession`` in BENCH_STREAM_SEG-txn
+segments (default 100000) and reports incremental ops/s next to the
+batch number under ``"streaming"`` in the payload — the
+batch-vs-always-on throughput comparison, self-ingested into the
+warehouse with the rest of the payload.
+
 Exit status: 0 with a real value; 1 on any error/deadline path with no
 completed rung (the JSON line is still printed — consumers may read
 either the rc or the "error" field).
@@ -245,11 +253,13 @@ def _run_size(n_txns: int, repeats: int):
         telemetry.registry().gauge(
             "checker-ops-per-s", checker="device-core").set(
             round(ops_per_sec, 1))
+        streaming = (_run_streaming(p, n_txns)
+                     if _streaming_enabled() else None)
         doc = telemetry.snapshot(coll)
     finally:
         telemetry.deactivate(coll)
     spans = _span_durations_s(doc)
-    return {
+    out = {
         "metric": "elle-list-append-check-throughput",
         "value": round(ops_per_sec, 1),
         "unit": "ops/sec",
@@ -266,6 +276,42 @@ def _run_size(n_txns: int, repeats: int):
                                   for name, ds in sorted(spans.items())},
             "check_ops_per_s": round(ops_per_sec, 1),
         },
+    }
+    if streaming is not None:
+        out["streaming"] = streaming
+    return out
+
+
+def _streaming_enabled():
+    return "--streaming" in sys.argv or os.environ.get("BENCH_STREAMING")
+
+
+def _run_streaming(p, n_txns):
+    """ISSUE 7 satellite: the same history through the incremental
+    VerifierSession in segments — incremental ops/s next to batch
+    ops/s.  The final rolling verdict must be valid (the generator
+    emits strict-serializable histories)."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.verifier import VerifierSession, iter_packed_segments
+
+    seg = int(os.environ.get("BENCH_STREAM_SEG", 100_000))
+    ses = VerifierSession("bench", ("strict-serializable",))
+    n_segs = 0
+    t0 = time.perf_counter()
+    with telemetry.span("bench.streaming", n_txns=n_txns, seg=seg):
+        for cols, rd, base in iter_packed_segments(p, seg):
+            ses.append_columns(cols, rd_elems=rd, rd_base=base)
+            ses.verdict()  # rolling: sweep at every segment boundary
+            n_segs += 1
+        verdict = ses.verdict()
+    wall = time.perf_counter() - t0
+    return {
+        "value": round(n_txns / wall, 1),
+        "unit": "ops/sec",
+        "wall_s": round(wall, 3),
+        "segments": n_segs,
+        "segment_txns": seg,
+        "valid?": verdict.get("valid?"),
     }
 
 

@@ -267,20 +267,11 @@ def _check_device(history, consistency_models, anomalies, max_reported,
         found.setdefault(k, []).extend(v)
     ph.end()
 
-    found = {k: v for k, v in found.items() if k in want}
-    anomaly_types = sorted(found.keys())
-    boundary = consistency.friendly_boundary(anomaly_types)
-    bad = set(boundary["not"]) | set(boundary["also-not"])
-    requested_bad = bad & {consistency.canonical(m)
-                           for m in consistency_models}
-    return coverage.finalize_la(
-        {
-            "valid?": not requested_bad,
-            "anomaly-types": anomaly_types,
-            "anomalies": found,
-            "not": boundary["not"],
-            "also-not": boundary["also-not"],
-        }, want, sess_checked)
+    # shared verdict tail (oracle.boundary_verdict): the device pipeline
+    # reached this point only with committed txns (the no-ok case early-
+    # returned unknown above), so has_ok is True by construction
+    return oracle.boundary_verdict(found, consistency_models, want,
+                                   has_ok=True, sess_checked=sess_checked)
 
 
 def _expand_rels(rels: frozenset) -> Set[int]:

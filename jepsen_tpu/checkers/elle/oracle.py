@@ -65,6 +65,38 @@ class Txn:
         self.mops: List[Tuple[int, int, int, Optional[List[int]]]] = []
 
 
+def boundary_verdict(found: Dict[str, List[Any]],
+                     consistency_models: Sequence[str],
+                     want, has_ok: bool, sess_checked: bool,
+                     edge_counts: Optional[Dict[str, int]] = None
+                     ) -> Dict[str, Any]:
+    """THE list-append verdict tail, shared by the batch oracle, the
+    device pipeline, and the incremental verifier session: filter found
+    anomalies to the requested set, derive the friendly model boundary,
+    decide ``valid?`` (unknown when no txn ever committed), and apply
+    the coverage contract.  One implementation so a checker pair that
+    agrees on the anomaly set cannot disagree on the verdict."""
+    from jepsen_tpu.checkers.elle import coverage
+
+    found = {k: v for k, v in found.items() if k in want}
+    anomaly_types = sorted(found.keys())
+    boundary = consistency.friendly_boundary(anomaly_types)
+    bad = set(boundary["not"]) | set(boundary["also-not"])
+    requested_bad = bad & {consistency.canonical(m)
+                           for m in consistency_models}
+    valid: Any = "unknown" if not has_ok else not requested_bad
+    res: Dict[str, Any] = {
+        "valid?": valid,
+        "anomaly-types": anomaly_types,
+        "anomalies": found,
+        "not": boundary["not"],
+        "also-not": boundary["also-not"],
+    }
+    if edge_counts is not None:
+        res["edge-counts"] = edge_counts
+    return coverage.finalize_la(res, want, sess_checked)
+
+
 def _unpack(p: PackedTxns) -> List[Txn]:
     txns = [
         Txn(i, int(p.txn_type[i]), int(p.txn_process[i]),
@@ -358,26 +390,12 @@ def _check_body(history, p: PackedTxns, txns, found,
                 break  # one witness per spec, like the reference's default
 
     ph.end()
-    found = {k: v for k, v in found.items() if k in want}
-    anomaly_types = sorted(found.keys())
-    boundary = consistency.friendly_boundary(anomaly_types)
-    bad = set(boundary["not"]) | set(boundary["also-not"])
-    requested_bad = bad & {consistency.canonical(m) for m in consistency_models}
-    if not any(t.type == TXN_OK for t in txns):
-        valid: Any = "unknown"
-    else:
-        valid = not requested_bad
-    return coverage.finalize_la(
-        {
-            "valid?": valid,
-            "anomaly-types": anomaly_types,
-            "anomalies": found,
-            "not": boundary["not"],
-            "also-not": boundary["also-not"],
-            "edge-counts": {REL_NAMES[r]: int((edges.rel == r).sum())
-                            for r in np.unique(edges.rel)}
-            if len(edges) else {},
-        }, want, sess_checked)
+    return boundary_verdict(
+        found, consistency_models, want,
+        has_ok=any(t.type == TXN_OK for t in txns),
+        sess_checked=sess_checked,
+        edge_counts={REL_NAMES[r]: int((edges.rel == r).sum())
+                     for r in np.unique(edges.rel)} if len(edges) else {})
 
 
 def _realtime_with_subset(inv, comp, ok_ids, ok_info, n_nodes):

@@ -151,8 +151,19 @@ def run_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
 
 def serve_cmd(opts: argparse.Namespace) -> int:
     from . import web
-    web.serve(port=opts.port, base=opts.store_dir,
-              host=getattr(opts, "host", "127.0.0.1"))
+
+    verifier = None
+    if getattr(opts, "ingest", False):
+        from .verifier import VerifierService
+
+        verifier = VerifierService(opts.store_dir)
+    try:
+        web.serve(port=opts.port, base=opts.store_dir,
+                  host=getattr(opts, "host", "127.0.0.1"),
+                  verifier=verifier)
+    finally:
+        if verifier is not None:
+            verifier.close()
     return 0
 
 
@@ -491,6 +502,12 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
     ps.add_argument("-p", "--port", type=int, default=8080)
     ps.add_argument("--host", default="127.0.0.1",
                     help='bind address (use "0.0.0.0" to expose)')
+    ps.add_argument("--ingest", action="store_true",
+                    help="run the always-on verifier service: accept "
+                         "streamed history segments on POST "
+                         "/ingest/<session> and publish rolling "
+                         "verdicts on GET /verdict/<session> "
+                         "(docs/VERIFIER.md)")
 
     pa = sub.add_parser("analyze", help="re-check a stored run")
     pa.add_argument("dir", help="store run directory")

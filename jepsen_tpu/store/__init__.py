@@ -182,8 +182,11 @@ def tests(name: Optional[str] = None, *, base: Optional[str] = None) -> List[str
     for n in names:
         nd = os.path.join(b, n)
         # skip the base-level "current" symlink (and anything like it):
-        # only real per-name directories hold runs
-        if os.path.islink(nd) or not os.path.isdir(nd):
+        # only real per-name directories hold runs — and the campaigns/
+        # + verifier/ subtrees, which hold ledgers and verifier session
+        # dirs, not run dirs
+        if os.path.islink(nd) or not os.path.isdir(nd) \
+                or n in ("campaigns", "verifier"):
             continue
         for ts in os.listdir(nd):
             d = os.path.join(nd, ts)

@@ -150,6 +150,13 @@ class Registry:
                 })
         return out
 
+    def remove(self, name: str, **labels: Any) -> bool:
+        """Drop one instrument — e.g. a per-session labeled gauge when
+        the session ends, so a long-lived service doesn't accumulate
+        stale label series on /metrics forever."""
+        with self._lock:
+            return self._metrics.pop(_key(name, labels), None) is not None
+
     def clear(self) -> None:
         with self._lock:
             self._metrics.clear()

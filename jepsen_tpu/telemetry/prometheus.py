@@ -249,6 +249,11 @@ def render_warehouse(wh: Any) -> List[str]:
                 "jepsen_warehouse_campaign_runs"
                 f"{_labels_str({'campaign': camp, 'valid': verdict})} "
                 f"{counts.get(verdict, 0)}")
+    for state, n in sorted((roll.get("verifier_by_state") or {}).items()):
+        doc.family("jepsen_warehouse_verifier_sessions", "gauge",
+                   "ingested verifier sessions by state").append(
+            "jepsen_warehouse_verifier_sessions"
+            f"{_labels_str({'state': state})} {n}")
     for row in roll.get("bench") or []:
         if not isinstance(row.get("value"), (int, float)):
             continue

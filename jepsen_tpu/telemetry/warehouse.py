@@ -56,7 +56,7 @@ __all__ = ["Warehouse", "warehouse_path", "open_if_exists", "for_ledger",
            "WAREHOUSE_FILE", "SCHEMA_VERSION"]
 
 WAREHOUSE_FILE = "warehouse.sqlite"
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS meta(
@@ -108,7 +108,13 @@ CREATE TABLE IF NOT EXISTS runs(
     name TEXT, ts TEXT,
     digest TEXT NOT NULL,
     valid TEXT, error TEXT, degraded TEXT, deadline INTEGER,
+    status TEXT NOT NULL DEFAULT 'done',  -- 'running' until results.json
     ingested_at REAL);
+CREATE TABLE IF NOT EXISTS verifier_sessions(
+    name TEXT PRIMARY KEY,          -- session dir name
+    state TEXT, valid TEXT, anomalies TEXT,
+    txns INTEGER, ops INTEGER, segments INTEGER,
+    digest TEXT, seal_equal INTEGER, updated REAL);
 CREATE TABLE IF NOT EXISTS run_spans(
     dir TEXT NOT NULL, name TEXT NOT NULL,
     total_s REAL NOT NULL, count INTEGER NOT NULL);
@@ -143,7 +149,7 @@ CREATE TABLE IF NOT EXISTS bench(
 _DATA_TABLES = ("record_spans", "flip_rollup", "span_rollup",
                 "span_gen_rollup", "campaign_records", "ledgers",
                 "run_spans", "run_metrics", "witnesses", "runs",
-                "events", "event_cursors", "bench")
+                "events", "event_cursors", "verifier_sessions", "bench")
 
 
 def warehouse_path(base: str) -> str:
@@ -184,8 +190,16 @@ class Warehouse:
         self.db.execute("PRAGMA synchronous=NORMAL")
         with self._lock, self.db:
             self.db.executescript(_SCHEMA)
+            # v1 -> v2 migration: the runs.status column (in-progress
+            # runs land as status='running' instead of being
+            # indistinguishable from done-but-resultless ones)
+            cols = {r[1] for r in self.db.execute(
+                "PRAGMA table_info(runs)").fetchall()}
+            if "status" not in cols:
+                self.db.execute("ALTER TABLE runs ADD COLUMN status "
+                                "TEXT NOT NULL DEFAULT 'done'")
             self.db.execute(
-                "INSERT OR IGNORE INTO meta(key, value) VALUES "
+                "INSERT OR REPLACE INTO meta(key, value) VALUES "
                 "('schema_version', ?)", (str(SCHEMA_VERSION),))
         # on-disk identity at open: lets the handle cache detect a
         # deleted/replaced file (rm + rebuild in another process) and
@@ -415,7 +429,12 @@ class Warehouse:
         witness); returns True if anything changed.  Keyed by a stat
         digest of the artifacts — an unchanged run is a no-op.  Missing
         or unreadable artifacts are tolerated: a run with no
-        telemetry.json still gets its verdict row."""
+        telemetry.json still gets its verdict row, and a run with no
+        ``results.json`` *yet* (still executing, or crashed before
+        analysis) is recorded as ``status = 'running'`` instead of
+        being skipped — so fleet views and the verifier's session list
+        include live work (ISSUE 7 satellite).  When results appear the
+        stat digest changes and the row flips to ``'done'``."""
         rel = os.path.relpath(os.path.abspath(d), os.path.abspath(base))
         digest = self._run_digest(d)
         with self._lock:
@@ -424,6 +443,7 @@ class Warehouse:
             if row and row[0] == digest:
                 return False
             valid, flags = self._run_results(d)
+            status = "running" if valid is _ABSENT else "done"
             spans, metrics = self._run_telemetry(d)
             wit = self._run_witness(d)
             with self.db:
@@ -433,13 +453,14 @@ class Warehouse:
                         f"DELETE FROM {tbl} WHERE dir = ?", (rel,))
                 self.db.execute(
                     "INSERT INTO runs(dir, name, ts, digest, valid, "
-                    "error, degraded, deadline, ingested_at) "
-                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    "error, degraded, deadline, status, ingested_at) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                     (rel, os.path.basename(os.path.dirname(rel)) or None,
                      os.path.basename(rel), digest,
                      json.dumps(valid) if valid is not _ABSENT else None,
                      flags.get("error"), flags.get("degraded"),
-                     1 if flags.get("deadline") else 0, time.time()))
+                     1 if flags.get("deadline") else 0, status,
+                     time.time()))
                 if spans:
                     self.db.executemany(
                         "INSERT INTO run_spans(dir, name, total_s, count) "
@@ -667,6 +688,53 @@ class Warehouse:
             rows = self.db.execute(q, args).fetchall()
         return [json.loads(r[0]) for r in rows]
 
+    # -- ingest: verifier sessions -------------------------------------------
+
+    def ingest_verifier_sessions(self, base: str) -> int:
+        """Ingest the verifier's ``session.json`` snapshots
+        (``<store>/verifier/<name>/``, ISSUE 7): one upserted row per
+        session so fleet queries cover the always-on checker's live
+        and sealed work.  Returns sessions seen."""
+        from jepsen_tpu.verifier import scan_sessions
+
+        rows = []
+        for name, meta in scan_sessions(base):
+            v = meta.get("verdict") or {}
+            seal = meta.get("seal") or {}
+            rows.append((
+                name, meta.get("state"),
+                json.dumps(v["valid?"]) if "valid?" in v else None,
+                json.dumps(v.get("anomaly-types") or []),
+                meta.get("txns"), meta.get("ops"), meta.get("segments"),
+                meta.get("digest"),
+                (1 if seal.get("equal") else 0) if seal else None,
+                meta.get("updated")))
+        if not rows:
+            return 0
+        with self._lock, self.db:
+            self.db.executemany(
+                "INSERT OR REPLACE INTO verifier_sessions(name, state, "
+                "valid, anomalies, txns, ops, segments, digest, "
+                "seal_equal, updated) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)", rows)
+        return len(rows)
+
+    def verifier_sessions(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT name, state, valid, anomalies, txns, ops, "
+                "segments, digest, seal_equal, updated "
+                "FROM verifier_sessions ORDER BY name").fetchall()
+        cols = ("name", "state", "valid", "anomalies", "txns", "ops",
+                "segments", "digest", "seal_equal", "updated")
+        out = []
+        for r in rows:
+            d = dict(zip(cols, r))
+            d["valid"] = _loads(d["valid"]) if d["valid"] else None
+            d["anomalies"] = json.loads(d["anomalies"] or "[]")
+            out.append(d)
+        return out
+
     # -- ingest: bench -------------------------------------------------------
 
     def ingest_bench(self, payload: Dict[str, Any], source: str) -> None:
@@ -728,7 +796,8 @@ class Warehouse:
         on an unchanged store is a no-op."""
         from jepsen_tpu import store as store_mod
 
-        stats = {"ledgers": 0, "records": 0, "runs": 0, "events": 0}
+        stats = {"ledgers": 0, "records": 0, "runs": 0, "events": 0,
+                 "sessions": 0}
         cdir = os.path.join(base, "campaigns")
         if os.path.isdir(cdir):
             for fn in sorted(os.listdir(cdir)):
@@ -741,6 +810,7 @@ class Warehouse:
                 stats["runs"] += 1
             if events:
                 stats["events"] += self.ingest_events(d, base)
+        stats["sessions"] = self.ingest_verifier_sessions(base)
         return stats
 
     def rebuild(self, base: str) -> Dict[str, int]:
@@ -878,18 +948,22 @@ class Warehouse:
 
     def rollups(self) -> Dict[str, Any]:
         """Warehouse-wide gauges for the Prometheus exposition: runs by
-        verdict, per-campaign latest verdict counts, latest bench
-        throughput."""
+        verdict (in-progress runs roll up as ``running`` — the ISSUE 7
+        status fix), per-campaign latest verdict counts, verifier
+        session states, latest bench throughput."""
         with self._lock:
             run_rows = self.db.execute(
-                "SELECT valid, COUNT(*) FROM runs GROUP BY valid"
-            ).fetchall()
+                "SELECT valid, status, COUNT(*) FROM runs "
+                "GROUP BY valid, status").fetchall()
             ledgers = [r[0] for r in self.db.execute(
                 "SELECT DISTINCT ledger FROM campaign_records").fetchall()]
+            vf_rows = self.db.execute(
+                "SELECT state, COUNT(*) FROM verifier_sessions "
+                "GROUP BY state").fetchall()
         runs_by_verdict: Dict[str, int] = {}
-        for valid, n in run_rows:
+        for valid, status, n in run_rows:
             if valid is None:
-                k = "none"
+                k = "running" if status == "running" else "none"
             else:
                 v = json.loads(valid)
                 k = ("true" if v is True else
@@ -903,6 +977,8 @@ class Warehouse:
             campaigns[name] = self.verdict_counts(led)
         return {"runs_by_verdict": runs_by_verdict,
                 "campaigns": campaigns,
+                "verifier_by_state": {str(s or "?"): n
+                                      for s, n in vf_rows},
                 "bench": self.bench_series()}
 
     # -- raw SQL (cli obs sql; read-only) ------------------------------------

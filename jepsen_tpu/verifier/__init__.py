@@ -1,0 +1,33 @@
+"""verifier/ — the always-on incremental checking service (ISSUE 7).
+
+Turns the batch checker into infrastructure: clients stream history
+segments in (op-dict jsonl, the ``history.json`` line format), rolling
+verdicts stream out, and sealing a session runs the full batch checker
+and asserts it agrees with the incremental result.
+
+Layers (see ``docs/VERIFIER.md``):
+
+- :mod:`.session` — :class:`VerifierSession`, the incremental checker:
+  per-key tail-index edge maintenance, dirty-region cycle sweeps in
+  device-sized guarded chunks, the shared
+  :func:`~jepsen_tpu.checkers.elle.oracle.boundary_verdict` tail.
+- :mod:`.journal` — fsync'd per-session journals; accept → fsync → ack;
+  byte-cursor resume; crash replay to the identical verdict digest.
+- :mod:`.service` — :class:`VerifierService`, the session manager the
+  web server (``cli serve --ingest``) routes to.
+"""
+
+from .journal import SessionJournal, read_meta, split_segment
+from .service import VerifierService, scan_sessions
+from .session import (
+    VerdictMismatch,
+    VerifierSession,
+    iter_packed_segments,
+    verdict_digest,
+)
+
+__all__ = [
+    "VerifierSession", "VerifierService", "SessionJournal",
+    "VerdictMismatch", "verdict_digest", "iter_packed_segments",
+    "split_segment", "scan_sessions", "read_meta",
+]

@@ -1,0 +1,222 @@
+"""Per-session ingest journals: accept → fsync → ack (ISSUE 7).
+
+The durability half of the verifier service: every segment a client
+streams is validated line by line, appended to the session's
+``journal.jsonl``, and **fsync'd before the acknowledgment leaves the
+server** — so an acked op can never be lost to a crash.  The journal
+byte size *is* the ack cursor: a client resumes by resending from the
+last cursor it saw acked, and the server drops the already-journaled
+overlap (idempotent re-append).
+
+Crash discipline mirrors the flight recorder / campaign ledger: a
+``kill -9`` mid-append leaves at most one torn trailing line.  On
+recovery the journal is opened with :meth:`SessionJournal.recover`,
+which truncates crash debris back to the last complete line — the
+replayed session then reaches the identical verdict digest, because
+the incremental state is a pure function of the accepted op sequence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["SessionJournal", "split_segment", "op_feedable", "read_meta",
+           "JOURNAL_FILE", "META_FILE"]
+
+JOURNAL_FILE = "journal.jsonl"
+META_FILE = "session.json"
+
+
+def read_meta(dirpath: str) -> Optional[Dict[str, Any]]:
+    """A session dir's ``session.json`` snapshot, or None.  Module-level
+    so read-only surfaces (web listings, warehouse ingest) never
+    construct a :class:`SessionJournal` — whose recovery would
+    *truncate* another process's torn tail out from under it."""
+    try:
+        with open(os.path.join(dirpath, META_FILE)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+_OP_TYPES = frozenset({"invoke", "ok", "fail", "info"})
+_SCALAR = (str, int, float, bool, type(None))
+
+
+def _mop_ok(m: Any) -> bool:
+    if not isinstance(m, (list, tuple)) or len(m) < 2 \
+            or not isinstance(m[1], _SCALAR):
+        return False
+    kind = m[0]
+    if kind in ("append", "w"):
+        return len(m) >= 3 and isinstance(m[2], _SCALAR)
+    if kind == "r":
+        if len(m) < 3 or m[2] is None:
+            return True
+        return isinstance(m[2], list) and \
+            all(isinstance(v, _SCALAR) for v in m[2])
+    return False
+
+
+def op_feedable(rec: Dict[str, Any]) -> bool:
+    """Can the packer/session actually consume this op dict?  The
+    journal's acceptance predicate: a line that parses as JSON but
+    would blow up `Op.from_dict`/`TxnPacker.feed` (missing/unknown
+    ``type``, a non-list client value, malformed or unhashable mops)
+    must be REFUSED before it is fsync'd — a journaled-but-unfeedable
+    op would brick the session on every replay."""
+    if rec.get("type") not in _OP_TYPES:
+        return False
+    p = rec.get("process")
+    if not (isinstance(p, int) and p >= 0):
+        return True  # non-client op: the packer skips it entirely
+    v = rec.get("value")
+    if v is None:
+        return True
+    if not isinstance(v, list):
+        return False
+    return all(_mop_ok(m) for m in v)
+
+
+def split_segment(body: bytes) -> Tuple[bytes, int, List[Dict[str, Any]]]:
+    """Validate one streamed segment: returns ``(accepted_bytes,
+    n_lines, ops)`` where ``accepted_bytes`` is the longest prefix of
+    complete, parseable, FEEDABLE op-dict lines (:func:`op_feedable`).
+    A torn trailing line (no newline) is left for the client's next
+    send; a complete-but-corrupt/unfeedable line stops acceptance at
+    its start (the client gets the cursor before it and must fix its
+    stream)."""
+    ops: List[Dict[str, Any]] = []
+    accepted = 0
+    n = 0
+    start = 0
+    while True:
+        nl = body.find(b"\n", start)
+        if nl < 0:
+            break
+        line = body[start:nl]
+        if line.strip():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                break
+            if not isinstance(rec, dict) or not op_feedable(rec):
+                break
+            ops.append(rec)
+            n += 1
+        start = nl + 1
+        accepted = start
+    return body[:accepted], n, ops
+
+
+class SessionJournal:
+    """Append-only fsync'd op journal for one verifier session."""
+
+    def __init__(self, dirpath: str):
+        self.dir = dirpath
+        self.path = os.path.join(dirpath, JOURNAL_FILE)
+        os.makedirs(dirpath, exist_ok=True)
+        self._f = None
+        self.cursor = self.recover()
+
+    def recover(self) -> int:
+        """Scan the journal, truncating a torn/corrupt/unfeedable tail
+        back to the last complete replayable line; returns the durable
+        cursor — exactly the prefix :meth:`read_ops` will replay, so
+        the ack cursor and the replayed state can't diverge."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return 0
+        good = 0
+        with open(self.path, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break
+                if line.strip():
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        break
+                    if not isinstance(rec, dict) or not op_feedable(rec):
+                        break
+                good += len(line)
+        if good < size:
+            with open(self.path, "rb+") as f:
+                f.truncate(good)
+        return good
+
+    def _file(self):
+        if self._f is None:
+            self._f = open(self.path, "ab", buffering=0)
+        return self._f
+
+    def append(self, data: bytes) -> int:
+        """Append pre-validated journal bytes; fsync; return the new
+        cursor.  The caller (the service) acks only after this
+        returns — accepted segments land durably before the ack."""
+        if not data:
+            return self.cursor
+        f = self._file()
+        f.write(data)
+        os.fsync(f.fileno())
+        self.cursor += len(data)
+        return self.cursor
+
+    def read_ops(self, chunk_lines: int = 4096
+                 ) -> Iterator[List[Dict[str, Any]]]:
+        """Replay the journal as op-dict chunks (history order).  A
+        torn tail (only possible before :meth:`recover` ran) is
+        dropped, and replay STOPS at an unfeedable line (impossible
+        through `split_segment`; external corruption otherwise) — the
+        same discipline as every jsonl reader in the repo."""
+        out: List[Dict[str, Any]] = []
+        try:
+            f = open(self.path, "rb")
+        except OSError:
+            return
+        with f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break
+                if not isinstance(rec, dict) or not op_feedable(rec):
+                    break
+                out.append(rec)
+                if len(out) >= chunk_lines:
+                    yield out
+                    out = []
+        if out:
+            yield out
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+    # -- session meta (atomic state snapshot for read-only surfaces) -----
+
+    def write_meta(self, state: Dict[str, Any]) -> None:
+        """Atomically replace ``session.json`` — the state snapshot the
+        web pages and the warehouse ingest read without the service."""
+        tmp = os.path.join(self.dir, META_FILE + ".tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f, indent=1, sort_keys=True)
+            os.replace(tmp, os.path.join(self.dir, META_FILE))
+        except OSError:
+            pass
+
+    def read_meta(self) -> Optional[Dict[str, Any]]:
+        return read_meta(self.dir)

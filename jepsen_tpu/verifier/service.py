@@ -1,0 +1,470 @@
+"""The always-on verifier service (ISSUE 7): sessions over HTTP.
+
+Session lifecycle, storage, and observability for a fleet of
+:class:`~.session.VerifierSession`\\ s, served by ``cli serve
+--ingest`` (the web server routes ``POST /ingest/<session>``,
+``GET /verdict/<session>``, and the ``/verifier/<session>/<verb>``
+lifecycle endpoints here).
+
+Protocol (all JSON):
+
+- ``POST /ingest/<session>?cursor=N`` — body is op-dict jsonl (the
+  ``history.json`` line format).  The longest prefix of complete,
+  parseable lines is journaled (fsync'd) and fed to the incremental
+  checker; the response acks ``{"cursor": <journal bytes>}``.
+  ``cursor`` is the byte offset of the segment's first byte in the
+  client's logical stream: a resend after a lost ack overlaps, and the
+  server skips the already-journaled prefix (idempotent re-append).  A
+  cursor PAST the journal is a gap → 409, nothing accepted.
+- ``GET /verdict/<session>`` — the rolling verdict: oracle-shaped
+  result + ``new``/``cleared`` anomaly deltas and per-anomaly
+  ``first-seen`` timestamps.
+- ``POST /verifier/<session>/open|seal|expire`` — lifecycle.  ``open``
+  takes an optional config body (``consistency-models``,
+  ``anomalies``, ``sweep-deadline-s``, ``sweep-chunk``); ``seal`` runs
+  the full batch checker over the concatenated history and asserts
+  equality with the incremental verdict; ``expire`` drops the session
+  from memory (journal + state stay on disk, reloadable).
+
+Durability: a session is its journal.  On restart (or first touch of
+an on-disk session) the journal replays through a fresh
+:class:`VerifierSession` and reaches the identical verdict digest —
+pinned by the crash tests.
+
+Observability: per-session ``events.jsonl`` (ingest/verdict/seal
+events — the web ``/live/verifier/<name>`` page renders it), verifier
+gauges/counters on the live registry (scraped by ``/metrics``), and an
+atomically-replaced ``session.json`` snapshot per session so read-only
+surfaces (web pages without ``--ingest``, warehouse ingest) never need
+the service process.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu import resilience, store, telemetry
+from jepsen_tpu.resilience import Deadline, DeadlineExceeded
+from jepsen_tpu.telemetry.stream import EventStream
+
+from .journal import (
+    JOURNAL_FILE,
+    META_FILE,
+    SessionJournal,
+    read_meta,
+    split_segment,
+)
+from .session import (
+    INGEST_SITE,
+    SWEEP_CHUNK,
+    VerdictMismatch,
+    VerifierSession,
+    verdict_digest,
+)
+
+logger = logging.getLogger("jepsen.verifier")
+
+__all__ = ["VerifierService", "VERIFIER_DIR", "scan_sessions"]
+
+VERIFIER_DIR = "verifier"
+
+#: sweep-duration histogram bounds (seconds) — p95 derivable from the
+#: cumulative buckets on /metrics
+_SWEEP_BUCKETS = (0.001, 0.01, 0.1, 1.0, 10.0)
+
+
+def _registry():
+    return telemetry.registry()
+
+
+class _Live:
+    """One in-memory session: checker + journal + event stream."""
+
+    def __init__(self, name: str, dirpath: str,
+                 config: Dict[str, Any]):
+        self.name = name
+        self.dir = dirpath
+        self.config = config
+        self.lock = threading.RLock()
+        # set (under self.lock) when expire() retires this object: a
+        # handler that fetched it before the pop must not keep using
+        # the zombie — it re-resolves and gets a freshly recovered one
+        self.dead = False
+        self.journal = SessionJournal(dirpath)
+        self.session = VerifierSession(
+            name,
+            consistency_models=tuple(
+                config.get("consistency-models") or ("serializable",)),
+            anomalies=tuple(config.get("anomalies") or ()),
+            sweep_chunk=int(config.get("sweep-chunk") or 0) or SWEEP_CHUNK,
+            max_reported=int(config.get("max-reported") or 8))
+        self.opened = round(time.time(), 3)
+        self.last_ingest = self.opened
+        self.last_verdict_ts = self.opened
+        self.last_verdict: Optional[Dict[str, Any]] = None
+        self.seal_result: Optional[Dict[str, Any]] = None
+        self.stream = EventStream(
+            os.path.join(dirpath, "events.jsonl"),
+            meta={"name": f"verifier:{name}", "session": name})
+
+    @property
+    def state(self) -> str:
+        return "sealed" if self.seal_result is not None else "open"
+
+    def deadline(self) -> Optional[Deadline]:
+        s = self.config.get("sweep-deadline-s")
+        return Deadline(float(s)) if s else None
+
+    def snapshot(self, verdict: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        if verdict is None:
+            verdict = self.last_verdict  # keep the last one on disk
+        doc = {
+            "session": self.name,
+            "state": self.state,
+            "opened": self.opened,
+            "updated": round(time.time(), 3),
+            "cursor": self.journal.cursor,
+            "ops": self.session.n_events,
+            "txns": self.session.n_txns,
+            "segments": self.session.segments,
+            "config": self.config,
+        }
+        if verdict is not None:
+            doc["verdict"] = {
+                k: verdict.get(k) for k in
+                ("valid?", "anomaly-types", "error", "edge-counts",
+                 "first-seen", "not", "also-not")}
+            doc["digest"] = verdict_digest(verdict)
+        if self.seal_result is not None:
+            doc["seal"] = {
+                "equal": self.seal_result.get("equal"),
+                "digest": self.seal_result.get("digest"),
+                "valid?": (self.seal_result.get("verdict") or {}).get(
+                    "valid?"),
+                "anomaly-types": (self.seal_result.get("verdict") or {}
+                                  ).get("anomaly-types"),
+            }
+        return doc
+
+    def persist(self, verdict: Optional[Dict[str, Any]] = None) -> None:
+        if verdict is not None:
+            self.last_verdict = verdict
+        self.journal.write_meta(self.snapshot(verdict))
+
+    def close(self, reason: str) -> None:
+        self.stream.close(reason=reason)
+        self.journal.close()
+
+
+class VerifierService:
+    """Session manager behind the ingest endpoints.  Thread-safe: the
+    web server's handler threads call straight in."""
+
+    def __init__(self, base: Optional[str] = None,
+                 default_config: Optional[Dict[str, Any]] = None):
+        self.base = base or store.BASE
+        self.root = os.path.join(self.base, VERIFIER_DIR)
+        self.default_config = dict(default_config or {})
+        # reentrant: _get holds it while _update_gauges re-acquires.
+        # Held only for DICT bookkeeping — construction + journal
+        # replay of a session happen under its per-name lock, so
+        # recovering one big session never stalls the whole service
+        self._lock = threading.RLock()
+        self._live: Dict[str, _Live] = {}
+        self._name_locks: Dict[str, threading.RLock] = {}
+
+    # -- lookup / lifecycle -------------------------------------------------
+
+    def _dir(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    @staticmethod
+    def valid_name(name: str) -> bool:
+        return bool(name) and store.sanitize(name) == name
+
+    def _get(self, name: str, create: bool = False,
+             config: Optional[Dict[str, Any]] = None) -> Optional[_Live]:
+        if not self.valid_name(name):
+            raise ValueError(f"bad session name {name!r}")
+        with self._lock:
+            live = self._live.get(name)
+            if live is not None:
+                return live
+            nlock = self._name_locks.setdefault(name, threading.RLock())
+        with nlock:
+            with self._lock:
+                live = self._live.get(name)  # a racer built it first
+                if live is not None:
+                    return live
+            d = self._dir(name)
+            on_disk = os.path.exists(os.path.join(d, JOURNAL_FILE)) or \
+                os.path.exists(os.path.join(d, META_FILE))
+            if not on_disk and not create:
+                return None
+            cfg = dict(self.default_config)
+            meta = read_meta(d) if on_disk else None
+            if meta and isinstance(meta.get("config"), dict):
+                cfg.update(meta["config"])
+            if config:
+                cfg.update(config)
+            # construction + journal replay OUTSIDE the service lock:
+            # only this name's lock is held, other sessions keep moving
+            live = _Live(name, d, cfg)
+            if on_disk:
+                self._recover(live, meta)
+            with self._lock:
+                self._live[name] = live
+            self._update_gauges()
+            live.persist()
+            return live
+
+    def _recover(self, live: _Live, meta: Optional[Dict[str, Any]]
+                 ) -> None:
+        """Replay the journal into the fresh session — the restart
+        path.  A sealed session keeps its recorded seal block instead
+        of re-running the batch checker."""
+        n = 0
+        t0 = time.time()
+        for chunk in live.journal.read_ops():
+            live.session.append_ops(chunk)
+            n += len(chunk)
+        v = (meta.get("verdict") or {}) if meta else {}
+        live.session.restore_rolling(v.get("first-seen"),
+                                     v.get("anomaly-types"))
+        if meta and meta.get("state") == "sealed" and \
+                isinstance(meta.get("seal"), dict):
+            live.seal_result = {"equal": meta["seal"].get("equal"),
+                                "digest": meta["seal"].get("digest"),
+                                "verdict": dict(meta["seal"]),
+                                "recovered": True}
+        live.stream.emit("recover", ops=n,
+                         wall_s=round(time.time() - t0, 3))
+        logger.info("verifier: recovered session %s (%d journaled ops)",
+                    live.name, n)
+
+    def open(self, name: str, config: Optional[Dict[str, Any]] = None
+             ) -> Tuple[int, Dict[str, Any]]:
+        try:
+            live = self._get(name, create=True, config=config)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        with live.lock:
+            live.persist()
+            return 200, live.snapshot()
+
+    def ingest(self, name: str, body: bytes,
+               cursor: Optional[int] = None
+               ) -> Tuple[int, Dict[str, Any]]:
+        """Accept one streamed segment; journal-then-ack.  Runs under
+        the resilience guard (fault site ``verifier.ingest``) so chaos
+        tooling can hit the ingest path; the guarded unit is idempotent
+        — the overlap skip recomputes from the journal cursor, so a
+        retried attempt never double-appends."""
+        for _ in range(2):  # once more if expire() retired our handle
+            try:
+                live = self._get(name, create=True)
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            with live.lock:
+                if live.dead:
+                    continue  # re-resolve: a fresh recovery replaces it
+                if live.state == "sealed":
+                    return 409, {"error": "session sealed",
+                                 "cursor": live.journal.cursor}
+                try:
+                    return resilience.device_call(
+                        INGEST_SITE, self._ingest_locked, live, body,
+                        cursor)
+                except DeadlineExceeded:
+                    raise
+                except Exception as e:  # noqa: BLE001 — persistent
+                    logger.warning("verifier ingest failed for %s: %s",
+                                   name, e)
+                    return 503, {"error": f"{type(e).__name__}: {e}",
+                                 "cursor": live.journal.cursor}
+        return 503, {"error": "session expired mid-request; retry"}
+
+    def _ingest_locked(self, live: _Live, body: bytes,
+                       cursor: Optional[int]) -> Tuple[int, Dict[str, Any]]:
+        jr = live.journal
+        if cursor is not None:
+            cursor = int(cursor)
+            if cursor > jr.cursor:
+                return 409, {"error": "cursor gap", "cursor": jr.cursor,
+                             "client-cursor": cursor}
+            skip = jr.cursor - cursor
+            if skip >= len(body):
+                # pure replay of already-acked bytes: idempotent no-op
+                return 200, {"cursor": jr.cursor, "ops": 0,
+                             "txns": live.session.n_txns,
+                             "replayed": True}
+            body = body[skip:]
+        accepted, n_lines, ops = split_segment(body)
+        if not accepted:
+            return 200, {"cursor": jr.cursor, "ops": 0,
+                         "txns": live.session.n_txns}
+        jr.append(accepted)  # fsync BEFORE the ack or any checking
+        txns = live.session.append_ops(ops) if ops else 0
+        live.last_ingest = time.time()
+        reg = _registry()
+        reg.counter("verifier-ops-ingested").inc(n_lines)
+        reg.gauge("verifier-verdict-freshness-s",
+                  session=live.name).set(
+            round(live.last_ingest - live.last_verdict_ts, 3))
+        live.stream.emit("ingest", ops=n_lines, txns=txns,
+                         cursor=jr.cursor)
+        live.persist()
+        return 200, {"cursor": jr.cursor, "ops": n_lines, "txns": txns}
+
+    def verdict(self, name: str) -> Tuple[int, Dict[str, Any]]:
+        for _ in range(2):
+            try:
+                live = self._get(name)
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            if live is None:
+                return 404, {"error": f"no such session {name!r}"}
+            with live.lock:
+                if live.dead:
+                    continue
+                return self._verdict_locked(live)
+        return 503, {"error": "session expired mid-request; retry"}
+
+    def _verdict_locked(self, live: _Live) -> Tuple[int, Dict[str, Any]]:
+        t0 = time.perf_counter()
+        try:
+            res = live.session.verdict(deadline=live.deadline())
+        except Exception as e:  # noqa: BLE001 — injected persistent
+            return 503, {"error": f"{type(e).__name__}: {e}"}
+        dt = time.perf_counter() - t0
+        reg = _registry()
+        reg.histogram("verifier-sweep-s", _SWEEP_BUCKETS).observe(dt)
+        live.last_verdict_ts = time.time()
+        reg.gauge("verifier-verdict-freshness-s",
+                  session=live.name).set(0.0)
+        live.stream.emit("verdict", valid=res.get("valid?"),
+                         anomalies=res.get("anomaly-types"),
+                         new=res.get("new"), dur_s=round(dt, 6))
+        res["digest"] = verdict_digest(res)
+        live.persist(res)
+        return 200, res
+
+    def seal(self, name: str) -> Tuple[int, Dict[str, Any]]:
+        for _ in range(2):
+            try:
+                live = self._get(name)
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            if live is None:
+                return 404, {"error": f"no such session {name!r}"}
+            with live.lock:
+                if live.dead:
+                    continue
+                return self._seal_locked(live)
+        return 503, {"error": "session expired mid-request; retry"}
+
+    def _seal_locked(self, live: _Live) -> Tuple[int, Dict[str, Any]]:
+        if live.state == "sealed":
+            return 200, live.seal_result
+        try:
+            sealed = live.session.seal(deadline=live.deadline())
+        except VerdictMismatch as e:
+            live.stream.emit("seal-mismatch", error=str(e))
+            return 500, {"error": "verdict mismatch",
+                         "incremental": e.incremental,
+                         "batch": e.batch}
+        except Exception as e:  # noqa: BLE001
+            return 503, {"error": f"{type(e).__name__}: {e}"}
+        live.seal_result = sealed
+        live.stream.emit("seal", equal=sealed["equal"],
+                         digest=sealed["digest"],
+                         valid=sealed["verdict"].get("valid?"))
+        live.persist(sealed.get("incremental"))
+        self._drop_session_series(live.name)
+        self._update_gauges()
+        return 200, sealed
+
+    def expire(self, name: str) -> Tuple[int, Dict[str, Any]]:
+        """Drop a session from memory; journal + session.json stay on
+        disk (a later touch recovers it by replay).  The retired
+        object is marked dead under its own lock, so a handler that
+        fetched it pre-pop re-resolves instead of writing through a
+        zombie journal handle alongside the recovered replacement."""
+        with self._lock:
+            live = self._live.pop(name, None)
+        if live is None:
+            return 404, {"error": f"no such live session {name!r}"}
+        with live.lock:
+            live.dead = True
+            live.persist()
+            live.close("expired")
+        self._drop_session_series(name)
+        self._update_gauges()
+        return 200, {"expired": name}
+
+    # -- listings / metrics -------------------------------------------------
+
+    def sessions(self) -> List[Dict[str, Any]]:
+        """Every session, live ones first-hand, on-disk ones from
+        their ``session.json`` snapshots."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, meta in scan_sessions(self.base):
+            out[name] = dict(meta, live=False)
+        with self._lock:
+            lives = list(self._live.values())
+        for live in lives:
+            with live.lock:
+                out[live.name] = dict(live.snapshot(), live=True)
+        return [out[k] for k in sorted(out)]
+
+    @staticmethod
+    def _drop_session_series(name: str) -> None:
+        """Retire a finished session's per-session labeled series — a
+        long-lived daemon handling many short sessions must not grow
+        /metrics (and registry memory) monotonically."""
+        try:
+            _registry().remove("verifier-verdict-freshness-s",
+                               session=name)
+        except Exception:  # noqa: BLE001 — observability cleanup only
+            pass
+
+    def _update_gauges(self) -> None:
+        with self._lock:
+            active = sum(1 for v in self._live.values()
+                         if v.seal_result is None)
+        _registry().gauge("verifier-sessions-active").set(active)
+
+    def close(self) -> None:
+        with self._lock:
+            lives = list(self._live.values())
+            self._live.clear()
+        for live in lives:
+            with live.lock:
+                live.dead = True
+                live.persist()
+                live.close("service-stop")
+
+
+def scan_sessions(base: str) -> List[Tuple[str, Dict[str, Any]]]:
+    """On-disk session snapshots under ``<store>/verifier/`` — the
+    read-only listing the web pages use when no service is attached."""
+    root = os.path.join(base, VERIFIER_DIR)
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for n in names:
+        d = os.path.join(root, n)
+        if not os.path.isdir(d):
+            continue
+        meta = read_meta(d)
+        if meta is None:
+            meta = {"session": n, "state": "?"}
+        out.append((n, meta))
+    return out

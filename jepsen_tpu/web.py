@@ -107,6 +107,7 @@ def _verdict_cell(v: Any, error: Any = None, degraded: Any = None,
 
 class _Handler(BaseHTTPRequestHandler):
     base: str = store.BASE  # overridden per-server
+    verifier = None         # VerifierService when served with --ingest
 
     # -- helpers ----------------------------------------------------------
 
@@ -120,6 +121,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(content)
+
+    def _send_json(self, code: int, doc: Any) -> None:
+        self._send(code, json.dumps(doc, indent=1, sort_keys=True,
+                                    default=str).encode(),
+                   "application/json")
+
+    def _read_body(self) -> bytes:
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            n = 0
+        return self.rfile.read(n) if n > 0 else b""
 
     def _safe_path(self, rel: str) -> Optional[str]:
         """Resolve a store-relative path, refusing traversal outside it."""
@@ -163,12 +176,69 @@ class _Handler(BaseHTTPRequestHandler):
                 if rel.endswith("/trend"):
                     return self._trend(rel[:-len("/trend")])
                 return self._campaign(rel)
+            if path.startswith("/verdict/"):
+                return self._verdict_json(path[len("/verdict/"):])
+            if path in ("/verifier", "/verifier/"):
+                return self._verifier_list()
+            if path.startswith("/verifier/"):
+                return self._verifier_session(path[len("/verifier/"):])
             self._send(404, b"not found", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
             pass
         except Exception as e:  # noqa: BLE001
             logger.exception("web handler error")
             self._send(500, f"error: {e}".encode(), "text/plain")
+
+    def do_POST(self):  # noqa: N802 (stdlib API)
+        """The verifier ingest surface (docs/VERIFIER.md) — only
+        routed when the server was started with a service attached
+        (``cli serve --ingest``)."""
+        try:
+            parsed = urlparse(self.path)
+            path = unquote(parsed.path)
+            if self.verifier is None:
+                return self._send_json(
+                    404, {"error": "no verifier service (start with "
+                          "`serve --ingest`)"})
+            if path.startswith("/ingest/"):
+                name = path[len("/ingest/"):].strip("/")
+                cursor = None
+                for part in (parsed.query or "").split("&"):
+                    if part.startswith("cursor="):
+                        try:
+                            cursor = int(part[len("cursor="):])
+                        except ValueError:
+                            return self._send_json(
+                                400, {"error": "bad cursor"})
+                code, doc = self.verifier.ingest(
+                    name, self._read_body(), cursor=cursor)
+                return self._send_json(code, doc)
+            if path.startswith("/verifier/"):
+                rest = path[len("/verifier/"):].strip("/")
+                name, _, verb = rest.partition("/")
+                if verb == "open":
+                    cfg = None
+                    body = self._read_body()
+                    if body.strip():
+                        try:
+                            cfg = json.loads(body)
+                        except ValueError:
+                            return self._send_json(
+                                400, {"error": "bad config json"})
+                    code, doc = self.verifier.open(name, cfg)
+                elif verb == "seal":
+                    code, doc = self.verifier.seal(name)
+                elif verb == "expire":
+                    code, doc = self.verifier.expire(name)
+                else:
+                    code, doc = 404, {"error": f"unknown verb {verb!r}"}
+                return self._send_json(code, doc)
+            self._send_json(404, {"error": "not found"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.exception("web POST handler error")
+            self._send_json(500, {"error": str(e)})
 
     def _index(self):
         from .telemetry import stream as tel_stream
@@ -194,10 +264,14 @@ class _Handler(BaseHTTPRequestHandler):
                 f"{tel}"
                 f'<td><a href="/zip/{quote(rel)}">zip</a></td>'
                 "</tr>")
-        camp = ('<p><a href="/campaigns">campaigns</a> &middot; '
-                '<a href="/metrics">metrics</a></p>'
-                if os.path.isdir(os.path.join(self.base, "campaigns"))
-                else '<p><a href="/metrics">metrics</a></p>')
+        links = []
+        if os.path.isdir(os.path.join(self.base, "campaigns")):
+            links.append('<a href="/campaigns">campaigns</a>')
+        if self.verifier is not None or \
+                os.path.isdir(os.path.join(self.base, "verifier")):
+            links.append('<a href="/verifier">verifier</a>')
+        links.append('<a href="/metrics">metrics</a>')
+        camp = "<p>" + " &middot; ".join(links) + "</p>"
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
 <title>jepsen-tpu</title><style>
 body {{ font-family: sans-serif; margin: 2em; }}
@@ -831,6 +905,170 @@ generations (highlighted rows changed)</p>
 {body}</body></html>"""
         self._send(200, doc.encode())
 
+    # -- verifier pages (docs/VERIFIER.md) --------------------------------
+
+    def _verifier_rows(self):
+        """Session summaries: first-hand from the attached service, or
+        read-only from the on-disk ``session.json`` snapshots."""
+        if self.verifier is not None:
+            return self.verifier.sessions()
+        from .verifier import scan_sessions
+
+        return [dict(meta, live=False)
+                for _n, meta in scan_sessions(self.base)]
+
+    def _verdict_json(self, name: str):
+        """``GET /verdict/<session>`` — the rolling verdict as JSON.
+        With a service attached this sweeps dirty work first (rolling
+        verdicts out); read-only servers answer from the snapshot."""
+        name = name.strip("/")
+        if self.verifier is not None:
+            code, doc = self.verifier.verdict(name)
+            return self._send_json(code, doc)
+        from .verifier import VerifierService, read_meta
+
+        if not VerifierService.valid_name(name):
+            # same sanitization as the service path: the name is about
+            # to be joined into a filesystem path
+            return self._send_json(400, {"error": "bad session name"})
+        meta = read_meta(os.path.join(self.base, "verifier", name))
+        if meta is None:
+            return self._send_json(
+                404, {"error": f"no such session {name!r}"})
+        return self._send_json(200, dict(meta.get("verdict") or {},
+                                         session=name, snapshot=True,
+                                         digest=meta.get("digest")))
+
+    def _verifier_list(self):
+        """Session table: state, rolling verdict, ingest freshness —
+        the fleet view of the always-on checker."""
+        rows = []
+        now = time.time()
+        for s in self._verifier_rows():
+            name = str(s.get("session") or "?")
+            v = (s.get("verdict") or {})
+            upd = s.get("updated")
+            age = (f"{now - upd:.0f}s"
+                   if isinstance(upd, (int, float)) else "?")
+            links = [f'<a href="/verifier/{quote(name)}">session</a>']
+            d = os.path.join(self.base, "verifier", name)
+            from .telemetry import stream as tel_stream
+            if tel_stream.events_path(d):
+                links.append(
+                    f'<a href="/live/{quote("verifier/" + name)}">live</a>')
+            state = str(s.get("state") or "?")
+            if s.get("live"):
+                state += " &middot; in memory"
+            rows.append(
+                "<tr>"
+                f"<td><code>{html.escape(name)}</code></td>"
+                f"<td>{state}</td>"
+                f"{_verdict_cell(v.get('valid?', '?'), v.get('error'))}"
+                f"<td>{html.escape(', '.join(v.get('anomaly-types') or []) or '-')}</td>"
+                f"<td>{s.get('txns', '?')}</td>"
+                f"<td>{s.get('ops', '?')}</td>"
+                f"<td>{age}</td>"
+                f"<td>{' '.join(links)}</td></tr>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>verifier sessions</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/">&larr; runs</a></p><h1>verifier sessions</h1>
+<p>the always-on incremental checker: stream histories in
+(<code>POST /ingest/&lt;session&gt;</code>), rolling verdicts out
+(<code>GET /verdict/&lt;session&gt;</code>); see docs/VERIFIER.md</p>
+<table><tr><th>session</th><th>state</th><th>valid?</th>
+<th>anomalies</th><th>txns</th><th>ops</th><th>updated</th>
+<th>links</th></tr>
+{"".join(rows) or '<tr><td colspan="8">(no sessions)</td></tr>'}</table>
+</body></html>"""
+        self._send(200, doc.encode())
+
+    def _verifier_session(self, name: str):
+        """Per-session page: rolling verdict with badges, anomaly
+        first-seen table, seal result.  Auto-refreshes while the
+        session is open and the snapshot is fresh (same stale guard as
+        /live)."""
+        name = unquote(name).rstrip("/")
+        if not name or "/" in name:
+            return self._send(404, b"no such session", "text/plain")
+        # READ-ONLY rendering, service or not: a browser tab
+        # auto-refreshing every 2 s must not run sweeps, append verdict
+        # events, rewrite session.json, or zero the freshness gauge —
+        # the mutating rolling-verdict contract lives on GET /verdict
+        meta = None
+        if self.verifier is not None:
+            meta = {s.get("session"): s
+                    for s in self.verifier.sessions()}.get(name)
+        if meta is None:
+            from .verifier import VerifierService, read_meta
+
+            if not VerifierService.valid_name(name):
+                return self._send(404, b"no such session", "text/plain")
+            meta = read_meta(os.path.join(self.base, "verifier", name))
+            if meta is None:
+                return self._send(404, b"no such session", "text/plain")
+        verdict: Dict[str, Any] = dict(meta.get("verdict") or {})
+        if "digest" not in verdict and meta.get("digest"):
+            verdict["digest"] = meta.get("digest")
+        state = str(meta.get("state") or "?")
+        upd = meta.get("updated")
+        stale = (not isinstance(upd, (int, float))
+                 or time.time() - upd > _LIVE_STALE_S)
+        refresh = ("" if state == "sealed" or stale else
+                   '<meta http-equiv="refresh" content="2">')
+        fs = verdict.get("first-seen") or {}
+        anom_rows = "".join(
+            f"<tr><td><code>{html.escape(a)}</code></td>"
+            f"<td>{fs.get(a, '')}</td></tr>"
+            for a in (verdict.get("anomaly-types") or []))
+        anom_html = (f"<h2>anomalies (first seen)</h2><table>"
+                     f"<tr><th>anomaly</th><th>first seen (epoch s)</th>"
+                     f"</tr>{anom_rows}</table>" if anom_rows else
+                     "<p>no anomalies observed</p>")
+        seal = meta.get("seal") or {}
+        seal_html = ""
+        if state == "sealed":
+            seal_html = (
+                "<h2>seal</h2><p>incremental == batch: "
+                f"<b>{seal.get('equal')}</b> &middot; digest "
+                f"<code>{html.escape(str(seal.get('digest')))}</code>"
+                "</p>")
+        edge_rows = "".join(
+            f"<tr><td>{html.escape(r)}</td><td>{n}</td></tr>"
+            for r, n in sorted((verdict.get("edge-counts")
+                                or {}).items()))
+        edges_html = (f"<h2>dependency edges</h2><table><tr><th>rel</th>"
+                      f"<th>count</th></tr>{edge_rows}</table>"
+                      if edge_rows else "")
+        d = os.path.join(self.base, "verifier", name)
+        from .telemetry import stream as tel_stream
+        live_link = (
+            f'&middot; <a href="/live/{quote("verifier/" + name)}">live'
+            '</a> ' if tel_stream.events_path(d) else "")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+{refresh}<title>verifier — {html.escape(name)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/verifier">&larr; sessions</a> {live_link}&middot;
+<a href="/files/{quote("verifier/" + name)}/">files</a> &middot;
+<a href="/verdict/{quote(name)}">verdict.json</a></p>
+<h1>verifier session <code>{html.escape(name)}</code>
+{_verdict_badges(verdict.get("valid?", "?"), verdict.get("error"))}</h1>
+<p>state: <b>{html.escape(state)}</b> &middot;
+{meta.get("txns", "?")} txns / {meta.get("ops", "?")} ops over
+{meta.get("segments", "?")} segments &middot; journal cursor
+{meta.get("cursor", "?")} &middot; verdict digest
+<code>{html.escape(str(verdict.get("digest")
+                       or meta.get("digest") or "?"))}</code></p>
+{anom_html}{seal_html}{edges_html}
+</body></html>"""
+        self._send(200, doc.encode())
+
     def _files(self, rel: str):
         p = self._safe_path(rel.rstrip("/"))
         if p is None or not os.path.exists(p):
@@ -876,16 +1114,37 @@ generations (highlighted rows changed)</p>
 
 def serve(port: int = 8080, base: Optional[str] = None, *,
           host: str = "127.0.0.1",
-          background: bool = False) -> ThreadingHTTPServer:
+          background: bool = False,
+          verifier: Any = None) -> ThreadingHTTPServer:
     """Serve the store dir (reference `web/serve!`).  Binds localhost by
     default — stored test maps can hold cluster details; pass
     host="0.0.0.0" explicitly to expose.  With background=True, runs in a
-    daemon thread and returns the server (tests use this)."""
-    handler = type("Handler", (_Handler,), {"base": base or store.BASE})
+    daemon thread and returns the server (tests use this).  Pass a
+    `verifier.VerifierService` to route the ingest endpoints
+    (`cli serve --ingest`; docs/VERIFIER.md)."""
+    handler = type("Handler", (_Handler,), {"base": base or store.BASE,
+                                            "verifier": verifier})
     srv = ThreadingHTTPServer((host, port), handler)
-    logger.info("serving store %s on port %d", base or store.BASE, port)
+    logger.info("serving store %s on port %d%s", base or store.BASE, port,
+                " (verifier ingest on)" if verifier is not None else "")
     if background:
-        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        # make server_close STOP the loop first: closing the socket
+        # under a live serve_forever leaves that thread select()ing on
+        # a closed fd — which returns ready instantly, i.e. a leaked
+        # CPU-spinning thread per server.  A suite's worth of those was
+        # measurably convoying every GIL-releasing C call (sqlite,
+        # sockets) in the process — the source of its "ambient load"
+        # timing flakes.
+        orig_close = srv.server_close
+
+        def _close_and_stop() -> None:
+            srv.shutdown()       # returns once serve_forever exited
+            t.join(timeout=5)
+            orig_close()
+
+        srv.server_close = _close_and_stop  # type: ignore[assignment]
         return srv
     try:
         srv.serve_forever()

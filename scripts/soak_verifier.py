@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Verifier soak: N concurrent clients x M segments under ingest chaos.
+
+ISSUE 7 satellite.  Spins up one in-process `VerifierService`, then N
+client threads each stream M history segments into their own session
+with a seeded `FaultPlan` firing synthetic transients (and stalls) on
+the guarded ``verifier.ingest`` / ``verifier.sweep`` seams.  Clients
+speak the real cursor protocol — a 503 (persistent injected fault
+after retries) is retried from the last acked cursor, which must be
+idempotent.  At the end every session is sealed and the run FAILS
+unless every seal reports ``incremental == batch``.
+
+Usage::
+
+    python scripts/soak_verifier.py --fast          # tier-1 smoke
+    python scripts/soak_verifier.py                 # default soak
+    python scripts/soak_verifier.py --clients 8 --segments 20 \\
+        --txns 400 --fault-p 0.1 --seed 3           # the long one
+
+Exit 0 iff every session sealed equal.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.resilience import faults  # noqa: E402
+from jepsen_tpu.verifier import VerifierService  # noqa: E402
+from jepsen_tpu.workloads import synth  # noqa: E402
+
+
+def client(svc, name, segments, txns, seed, inject, errors, stats):
+    """One streaming client: generate a history, chop it into
+    line-boundary-agnostic byte segments, push them with cursor
+    resume, then seal."""
+    h = synth.la_history(n_txns=txns, n_keys=6, concurrency=5,
+                         seed=seed, fail_prob=0.05, info_prob=0.05)
+    if inject:
+        getattr(synth, inject)(h)
+    body = b"".join(json.dumps(op.to_dict()).encode() + b"\n"
+                    for op in h)
+    seg_bytes = max(64, len(body) // segments)
+    cur = 0
+    retries = 0
+    while cur < len(body):
+        # deliberately NOT line-aligned: the server acks only complete
+        # lines and the client always resends from the acked cursor
+        chunk = body[cur:cur + seg_bytes]
+        code, r = svc.ingest(name, chunk, cursor=cur)
+        if code == 503:
+            retries += 1
+            if retries > 50:
+                errors.append(f"{name}: too many 503s")
+                return
+            time.sleep(0.01)
+            continue
+        if code != 200:
+            errors.append(f"{name}: ingest rc={code} {r}")
+            return
+        if r["cursor"] == cur and len(chunk) == seg_bytes:
+            # a whole segment with no complete line would wedge the
+            # loop — only possible with absurdly tiny seg_bytes
+            seg_bytes *= 2
+        cur = max(cur, r["cursor"])
+    def retrying(fn, what):
+        # 503 = a persistent injected fault survived the guard's own
+        # retries; the chaos targets verifier.sweep/seal too, so the
+        # client must retry those exactly like the ingest path
+        for _ in range(50):
+            code, doc = fn()
+            if code != 503:
+                return code, doc
+            time.sleep(0.01)
+        errors.append(f"{name}: {what} still 503 after retries")
+        return 503, doc
+
+    code, v = retrying(lambda: svc.verdict(name), "verdict")
+    if code != 200:
+        if code != 503:
+            errors.append(f"{name}: verdict rc={code} {v}")
+        return
+    code, sealed = retrying(lambda: svc.seal(name), "seal")
+    if code != 200 or sealed.get("equal") is not True:
+        if code != 503:
+            errors.append(f"{name}: seal rc={code} {sealed}")
+        return
+    stats.append({"session": name, "txns": sealed["txns"],
+                  "valid?": sealed["verdict"].get("valid?"),
+                  "anomalies": sealed["verdict"].get("anomaly-types"),
+                  "retries-503": retries})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--segments", type=int, default=8)
+    ap.add_argument("--txns", type=int, default=200)
+    ap.add_argument("--fault-p", type=float, default=0.05)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--store", default=None,
+                    help="store dir (default: a temp dir)")
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 smoke: 2 clients x 3 segments x 80 "
+                         "txns")
+    args = ap.parse_args()
+    if args.fast:
+        args.clients, args.segments, args.txns = 2, 4, 80
+        args.fault_p = max(args.fault_p, 0.35)  # few calls: make chaos land
+    base = args.store
+    if base is None:
+        import tempfile
+
+        base = tempfile.mkdtemp(prefix="verifier-soak-")
+    svc = VerifierService(base)
+    plan = faults.FaultPlan(
+        seed=args.seed, p=args.fault_p,
+        kinds=("oom", "xla", "stall"), stall_s=0.01,
+        sites=("verifier.ingest", "verifier.sweep", "verifier.seal"))
+    injectors = [None, "inject_wr_cycle", "inject_g1a",
+                 "inject_rw_cycle"]
+    errors, stats = [], []
+    t0 = time.time()
+    with faults.use(plan):
+        threads = [
+            threading.Thread(
+                target=client,
+                args=(svc, f"soak-{i}", args.segments, args.txns,
+                      args.seed * 1000 + i,
+                      injectors[i % len(injectors)], errors, stats))
+            for i in range(args.clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    svc.close()
+    wall = time.time() - t0
+    for s in sorted(stats, key=lambda s: s["session"]):
+        print(f"  {s['session']}: {s['txns']} txns valid?="
+              f"{s['valid?']} anomalies={s['anomalies']} "
+              f"503-retries={s['retries-503']}")
+    print(f"faults injected: {len(plan.injected)} over "
+          f"{plan._n_calls} guarded calls")
+    if errors or len(stats) != args.clients:
+        for e in errors:
+            print(f"FAIL: {e}", file=sys.stderr)
+        print(f"soak FAILED ({len(stats)}/{args.clients} sealed) "
+              f"in {wall:.1f}s", file=sys.stderr)
+        return 1
+    print(f"soak OK: {args.clients} clients x {args.segments} segments "
+          f"x {args.txns} txns, every session sealed incremental == "
+          f"batch, in {wall:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,744 @@
+"""Always-on verifier tests (ISSUE 7).
+
+The contracts under test:
+
+- **Equality**: for every workload shape (valid and invalid histories,
+  fail/info-laden chaos ones included), sealing a streamed session
+  yields the same ``valid?`` and anomaly set as the batch checker on
+  the concatenated history — and the dependency-edge counts agree, so
+  the incremental graph IS the batch graph.
+- **Segmentation independence**: the rolling state is a function of
+  the op sequence, not of how it was chopped — any segmentation
+  reaches the identical verdict digest.
+- **Durability / rudeness**: kill -9 the serve daemon mid-session and
+  restart → journal replay reaches the identical digest; a torn final
+  journal line is dropped; a client re-append after a stale cursor ack
+  is idempotent.
+- **Resilience**: the sweep honors deadlines (unknown +
+  deadline-exceeded, never a hang) and the guarded ingest/sweep seams
+  retry injected transients.
+- **Speed** (slow-marked): incremental re-check of a +1k segment on a
+  100k-txn session is >= 10x faster than a full batch re-check,
+  span-cited.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import telemetry, web
+from jepsen_tpu.checkers.elle import oracle
+from jepsen_tpu.history.soa import pack_txns
+from jepsen_tpu.resilience import Deadline, faults
+from jepsen_tpu.verifier import (
+    SessionJournal,
+    VerdictMismatch,
+    VerifierService,
+    VerifierSession,
+    iter_packed_segments,
+    split_segment,
+    verdict_digest,
+)
+from jepsen_tpu.workloads import synth
+
+MODELS = ("strict-serializable",)
+
+
+# ------------------------------------------------------------ helpers
+
+def _ops(h):
+    return [op.to_dict() for op in h]
+
+
+def _jsonl(h) -> bytes:
+    return b"".join(json.dumps(d).encode() + b"\n" for d in _ops(h))
+
+
+def _feed(ses, ops, seg, rolling=True):
+    for i in range(0, len(ops), seg):
+        ses.append_ops(ops[i:i + seg])
+        if rolling:
+            ses.verdict()
+    return ses
+
+
+def _assert_equal(batch, inc, edges=True):
+    assert batch["valid?"] == inc["valid?"]
+    assert batch["anomaly-types"] == inc["anomaly-types"]
+    if edges:
+        assert batch.get("edge-counts") == inc.get("edge-counts")
+
+
+# ------------------------------------------- incremental == batch
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_valid_history_equality(seed):
+    h = synth.la_history(n_txns=200, n_keys=6, concurrency=5, seed=seed)
+    batch = oracle.check(pack_txns(h, "list-append"), MODELS)
+    ses = _feed(VerifierSession("t", MODELS), _ops(h), 37)
+    _assert_equal(batch, ses.verdict())
+    assert ses.seal()["equal"] is True
+
+
+@pytest.mark.parametrize("inject", ["inject_g1a", "inject_g1b",
+                                    "inject_wr_cycle", "inject_rw_cycle"])
+@pytest.mark.parametrize("seed", [0, 4])
+def test_invalid_history_equality(inject, seed):
+    h = synth.la_history(n_txns=200, n_keys=5, concurrency=5, seed=seed,
+                         fail_prob=0.05)
+    assert getattr(synth, inject)(h)
+    batch = oracle.check(pack_txns(h, "list-append"), MODELS)
+    assert batch["valid?"] is False
+    ses = _feed(VerifierSession("t", MODELS), _ops(h), 23)
+    _assert_equal(batch, ses.verdict())
+    assert ses.seal()["equal"] is True
+
+
+def test_chaos_faulted_history_equality():
+    """Fail/info-dense histories (the fault-injected workload shape):
+    same verdict through the stream as through the batch checker."""
+    for seed in (0, 1, 2):
+        h = synth.la_history(n_txns=250, n_keys=4, concurrency=8,
+                             seed=seed, fail_prob=0.15, info_prob=0.15)
+        if seed == 1:
+            synth.inject_rw_cycle(h)
+        batch = oracle.check(pack_txns(h, "list-append"), MODELS)
+        ses = _feed(VerifierSession("t", MODELS), _ops(h), 11)
+        _assert_equal(batch, ses.verdict())
+        ses.seal()
+
+
+def test_segmentation_independence_digest():
+    """The rolling state is a function of the op SEQUENCE: any
+    segmentation (1-op, 7-op, one-shot) reaches the same digest."""
+    h = synth.la_history(n_txns=120, n_keys=4, seed=5)
+    synth.inject_wr_cycle(h)
+    digests = set()
+    for seg in (1, 7, 10_000):
+        ses = _feed(VerifierSession("t", MODELS), _ops(h), seg)
+        digests.add(verdict_digest(ses.verdict()))
+    assert len(digests) == 1
+
+
+def test_replaced_version_order_retraction():
+    """A later, longer-but-incompatible read replaces a key's inferred
+    version order; edges derived from the old order are retracted and
+    the full-resweep path converges on the batch verdict."""
+    from jepsen_tpu.history.ops import INVOKE, OK, History, Op
+
+    def txn(p, mops):
+        return [Op(type=INVOKE, process=p, f="txn", value=mops),
+                Op(type=OK, process=p, f="txn", value=mops)]
+
+    ops = []
+    ops += txn(0, [["append", "x", 1], ["append", "x", 2]])
+    ops += txn(1, [["r", "x", [1, 2]]])
+    ops += txn(0, [["append", "x", 3], ["append", "x", 4]])
+    ops += txn(1, [["r", "x", [1, 3, 4]]])  # incompatible with [1,2]
+    h = History(ops)
+    batch = oracle.check(pack_txns(h, "list-append"), MODELS)
+    assert "incompatible-order" in batch["anomaly-types"]
+    ses = _feed(VerifierSession("t", MODELS), _ops(h), 2)
+    _assert_equal(batch, ses.verdict())
+    ses.seal()
+
+
+def test_rolling_deltas_and_first_seen():
+    h = synth.la_history(n_txns=100, n_keys=5, seed=3)
+    ses = VerifierSession("t", MODELS)
+    ses.append_ops(_ops(h))
+    v0 = ses.verdict()
+    assert v0["anomaly-types"] == [] and v0["new"] == []
+    # a fresh wr cycle appended later: A reads B's write, B reads A's
+    a = [["append", "zz", 9001], ["r", "zz2", [9002]]]
+    b = [["append", "zz2", 9002], ["r", "zz", [9001]]]
+    ses.append_ops([
+        {"type": "invoke", "process": 0, "f": "txn", "value": a},
+        {"type": "ok", "process": 0, "f": "txn", "value": a},
+        {"type": "invoke", "process": 1, "f": "txn", "value": b},
+        {"type": "ok", "process": 1, "f": "txn", "value": b},
+    ])
+    v1 = ses.verdict()
+    assert "G1c" in v1["anomaly-types"]
+    assert set(v1["new"]) == set(v1["anomaly-types"])  # all first-seen now
+    first = dict(v1["first-seen"])
+    v2 = ses.verdict()
+    assert v2["new"] == [] and v2["first-seen"] == first
+    ses.seal()  # and the delta-bearing state still equals batch
+
+
+def test_packed_columns_path_and_seal():
+    p = synth.packed_la_history(n_txns=4000, n_keys=500, seed=2)
+    batch = oracle.check(p, MODELS)
+    ses = VerifierSession("pk", MODELS)
+    for cols, rd, base in iter_packed_segments(p, 512):
+        ses.append_columns(cols, rd_elems=rd, rd_base=base)
+    _assert_equal(batch, ses.verdict())
+    sealed = ses.seal()
+    assert sealed["equal"] is True and sealed["txns"] == 4000
+
+
+def test_seal_raises_on_mismatch():
+    h = synth.la_history(n_txns=50, n_keys=3, seed=0)
+    ses = _feed(VerifierSession(
+        "t", MODELS,
+        batch_check=lambda p: {"valid?": False,
+                               "anomaly-types": ["G1c"]}), _ops(h), 10)
+    with pytest.raises(VerdictMismatch):
+        ses.seal()
+    assert ses.sealed is None
+
+
+def test_sweep_deadline_returns_unknown():
+    h = synth.la_history(n_txns=100, n_keys=4, seed=1)
+    ses = VerifierSession("t", MODELS)
+    ses.append_ops(_ops(h))
+    v = ses.verdict(deadline=Deadline(0.0))
+    assert v["valid?"] == "unknown" and v["error"] == "deadline-exceeded"
+    # budget restored: the backlog is intact and sweeps to the verdict
+    v2 = ses.verdict()
+    assert v2["valid?"] is True
+
+
+def test_sweep_transient_faults_retried_and_failure_keeps_backlog():
+    h = synth.la_history(n_txns=80, n_keys=4, seed=2)
+    synth.inject_wr_cycle(h)
+    batch = oracle.check(pack_txns(h, "list-append"), MODELS)
+    # transient fault on the first sweep dispatch: retried, same verdict
+    plan = faults.FaultPlan(seed=1, at={0: "oom"},
+                            sites=("verifier.sweep",))
+    ses = VerifierSession("t", MODELS, plan=plan)
+    ses.append_ops(_ops(h))
+    _assert_equal(batch, ses.verdict())
+    assert plan.injected
+    # persistent fault: sweep raises, backlog survives, next sweep wins
+    plan2 = faults.FaultPlan(seed=1, persistent=("verifier.sweep",),
+                             kinds=("oom",), max_faults=3)
+    ses2 = VerifierSession("t2", MODELS, plan=plan2)
+    ses2.append_ops(_ops(h))
+    with pytest.raises(Exception):
+        ses2.sweep()
+    _assert_equal(batch, ses2.verdict())  # max_faults exhausted: clean
+
+
+# ------------------------------------------------------- journal
+
+def test_split_segment_torn_corrupt_and_unfeedable():
+    good = b'{"type": "invoke"}\n{"type": "ok"}\n'
+    acc, n, ops = split_segment(good + b'{"type": "in')  # torn tail
+    assert acc == good and n == 2 and len(ops) == 2
+    # a parseable-but-unfeedable dict must NOT be accepted: journaled,
+    # it would brick every replay of the session (review finding)
+    for bad in (b'{"a": 1}\n',                      # no type
+                b'{"type": "nope"}\n',              # unknown type
+                b'{"type": "ok", "process": 0, "value": 3}\n',
+                b'{"type": "ok", "process": 0, '
+                b'"value": [["r", [1], null]]}\n',  # unhashable key
+                b'{"type": "ok", "process": 0, '
+                b'"value": [["append", "k", [1]]]}\n'):
+        acc, n, _ = split_segment(good + bad + good)
+        assert acc == good and n == 2, bad
+    acc, n, _ = split_segment(b'not json\n{"type": "ok"}\n')
+    assert acc == b"" and n == 0  # stops at the corrupt line
+    # non-client ops (nemesis etc) pass through — the packer skips them
+    acc, n, _ = split_segment(
+        b'{"type": "info", "process": ":nemesis", "f": "start"}\n')
+    assert n == 1
+
+
+def test_unfeedable_ingest_refused_session_survives(tmp_path):
+    """Review regression: a malformed-but-JSON op line must be refused
+    BEFORE the fsync — never journaled, never bricking replay."""
+    svc = VerifierService(str(tmp_path))
+    h = synth.la_history(n_txns=40, n_keys=3, seed=1)
+    body = _jsonl(h)
+    code, r = svc.ingest("s", b'{"foo": 1}\n' + body, cursor=0)
+    assert code == 200 and r["cursor"] == 0 and r["ops"] == 0
+    code, r = svc.ingest("s", body, cursor=0)
+    assert code == 200 and r["cursor"] == len(body)
+    _code, v1 = svc.verdict("s")
+    svc.close()
+    # restart replays cleanly to the same digest (nothing poisoned)
+    svc2 = VerifierService(str(tmp_path))
+    code, v2 = svc2.verdict("s")
+    assert code == 200 and v2["digest"] == v1["digest"]
+    assert svc2.seal("s")[1]["equal"] is True
+
+
+def test_restart_preserves_first_seen_and_deltas(tmp_path):
+    """Review regression: a restarted session must not re-report every
+    standing anomaly as 'new' with a reset first-seen timestamp."""
+    svc = VerifierService(str(tmp_path))
+    h = synth.la_history(n_txns=80, n_keys=4, seed=2)
+    synth.inject_g1a(h)
+    svc.ingest("s", _jsonl(h), cursor=0)
+    _code, v1 = svc.verdict("s")
+    assert v1["anomaly-types"] and v1["new"]
+    first = dict(v1["first-seen"])
+    svc.close()
+    svc2 = VerifierService(str(tmp_path))
+    _code, v2 = svc2.verdict("s")
+    assert v2["new"] == [] and v2["first-seen"] == first
+
+
+def test_session_gauge_series_dropped_on_expire_and_seal(tmp_path):
+    from jepsen_tpu import telemetry
+
+    svc = VerifierService(str(tmp_path))
+    h = synth.la_history(n_txns=30, n_keys=3, seed=0)
+    svc.ingest("ga", _jsonl(h), cursor=0)
+    svc.ingest("gb", _jsonl(h), cursor=0)
+
+    def series():
+        return {tuple(sorted(g["labels"].items()))
+                for g in telemetry.registry().snapshot()["gauges"]
+                if g["name"] == "verifier-verdict-freshness-s"}
+
+    assert (("session", "ga"),) in series()
+    svc.expire("ga")
+    assert (("session", "ga"),) not in series()
+    svc.seal("gb")
+    assert (("session", "gb"),) not in series()
+
+
+def test_bad_session_name_is_400_everywhere(tmp_path):
+    svc = VerifierService(str(tmp_path))
+    for fn in (lambda: svc.verdict("../evil"),
+               lambda: svc.seal("../evil"),
+               lambda: svc.ingest("../evil", b"{}\n", cursor=0),
+               lambda: svc.open("../evil")):
+        code, doc = fn()
+        assert code == 400 and "bad session name" in doc["error"]
+
+
+def test_readonly_verdict_rejects_traversal(tmp_path):
+    """Review regression: the no-service /verdict path joined the raw
+    name into a filesystem path — a traversal name must 400, never
+    read a file outside the store."""
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    (outside / "session.json").write_text('{"secret": 1}')
+    base = tmp_path / "store"
+    base.mkdir()
+    srv = web.serve(port=0, base=str(base), background=True)
+    try:
+        port = srv.server_address[1]
+        code, raw = _get(port,
+                         "/verdict/..%2F..%2Foutside")
+        assert code == 400 and b"secret" not in raw
+        code, _raw = _get(port, "/verifier/..%2F..%2Foutside")
+        assert code == 404
+    finally:
+        srv.server_close()
+
+
+def test_expired_zombie_handle_not_used(tmp_path):
+    """Review regression: a handler holding a _Live fetched before
+    expire() must re-resolve instead of appending through the retired
+    object's journal next to the recovered replacement."""
+    svc = VerifierService(str(tmp_path))
+    h = synth.la_history(n_txns=40, n_keys=3, seed=4)
+    body = _jsonl(h)
+    half = len(body) // 2
+    code, r = svc.ingest("z", body[:half], cursor=0)
+    acked = r["cursor"]
+    zombie = svc._get("z")
+    assert svc.expire("z")[0] == 200
+    assert zombie.dead is True
+    # the public path recovers a FRESH live and continues correctly
+    code, r = svc.ingest("z", body[acked:], cursor=acked)
+    assert code == 200 and r["cursor"] == len(body)
+    assert svc._get("z") is not zombie
+    assert svc.seal("z")[1]["equal"] is True
+
+
+def test_session_page_is_side_effect_free(verifier_server):
+    """Review regression: an auto-refreshing browser tab on
+    /verifier/<s> must not run sweeps, grow events.jsonl, or reset the
+    freshness gauge — only GET /verdict mutates."""
+    base, port, _svc = verifier_server
+    h = synth.la_history(n_txns=40, n_keys=3, seed=5)
+    _post(port, "/ingest/pg?cursor=0", _jsonl(h))
+    _get(port, "/verdict/pg")  # one real verdict so the page has data
+    ev = os.path.join(base, "verifier", "pg", "events.jsonl")
+    size0 = os.path.getsize(ev)
+    for _ in range(3):
+        code, page = _get(port, "/verifier/pg")
+        assert code == 200
+    assert os.path.getsize(ev) == size0
+
+
+def test_journal_recover_truncates_torn_tail(tmp_path):
+    d = str(tmp_path / "s")
+    j = SessionJournal(d)
+    j.append(b'{"type": "invoke", "process": 0, "f": "txn"}\n')
+    cur = j.cursor
+    j.close()
+    with open(j.path, "ab") as f:
+        f.write(b'{"type": "ok", "proc')  # kill -9 mid-append
+    j2 = SessionJournal(d)
+    assert j2.cursor == cur
+    assert os.path.getsize(j2.path) == cur  # debris truncated
+    assert sum(len(c) for c in j2.read_ops()) == 1
+
+
+# ------------------------------------------------------- service
+
+def test_service_ingest_ack_resume_idempotent(tmp_path):
+    svc = VerifierService(str(tmp_path))
+    h = synth.la_history(n_txns=100, n_keys=4, seed=9)
+    body = _jsonl(h)
+    code, r = svc.ingest("s", body[:1000], cursor=0)
+    assert code == 200 and 0 < r["cursor"] <= 1000
+    acked = r["cursor"]
+    # lost-ack resend: overlapping bytes from an older cursor
+    code, r = svc.ingest("s", body[:2000], cursor=0)
+    assert code == 200 and r["cursor"] > acked
+    acked = r["cursor"]
+    # pure replay of acked bytes: a no-op ack
+    code, r = svc.ingest("s", body[:acked], cursor=0)
+    assert code == 200 and r["cursor"] == acked and r["ops"] == 0
+    # gap refused, nothing accepted
+    code, r = svc.ingest("s", body[acked + 10:], cursor=acked + 10)
+    assert code == 409 and r["cursor"] == acked
+    # finish + seal
+    code, r = svc.ingest("s", body[acked:], cursor=acked)
+    assert code == 200 and r["cursor"] == len(body)
+    code, sealed = svc.seal("s")
+    assert code == 200 and sealed["equal"] is True
+    code, r = svc.ingest("s", b"{}\n", cursor=len(body))
+    assert code == 409 and "sealed" in r["error"]
+
+
+def test_service_restart_replays_to_identical_digest(tmp_path):
+    h = synth.la_history(n_txns=120, n_keys=4, seed=3)
+    synth.inject_g1a(h)
+    body = _jsonl(h)
+    svc = VerifierService(str(tmp_path))
+    svc.ingest("s", body, cursor=0)
+    _code, v1 = svc.verdict("s")
+    svc.close()
+    svc2 = VerifierService(str(tmp_path))
+    _code, v2 = svc2.verdict("s")
+    assert v2["digest"] == v1["digest"]
+    assert v2["anomaly-types"] == v1["anomaly-types"]
+    code, sealed = svc2.seal("s")
+    assert code == 200 and sealed["equal"] is True
+    # expire drops it from memory; a later touch recovers the seal
+    assert svc2.expire("s")[0] == 200
+    code, listed = 200, svc2.sessions()
+    assert [s["state"] for s in listed] == ["sealed"]
+
+
+def test_service_ingest_chaos_transient_then_ok(tmp_path):
+    plan = faults.FaultPlan(seed=7, at={0: "oom"},
+                            sites=("verifier.ingest",))
+    h = synth.la_history(n_txns=60, n_keys=3, seed=1)
+    body = _jsonl(h)
+    svc = VerifierService(str(tmp_path))
+    with faults.use(plan):
+        code, r = svc.ingest("s", body, cursor=0)
+    assert code == 200 and r["cursor"] == len(body)  # retried through
+    assert plan.injected
+    code, sealed = svc.seal("s")
+    assert code == 200 and sealed["equal"] is True
+
+
+# ------------------------------------------------- web surfaces
+
+@pytest.fixture()
+def verifier_server(tmp_path):
+    svc = VerifierService(str(tmp_path))
+    srv = web.serve(port=0, base=str(tmp_path), background=True,
+                    verifier=svc)
+    yield str(tmp_path), srv.server_address[1], svc
+    srv.server_close()
+    svc.close()
+
+
+def _post(port, path, data=b""):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_http_ingest_verdict_seal_pages(verifier_server):
+    _base, port, _svc = verifier_server
+    h = synth.la_history(n_txns=100, n_keys=4, seed=11)
+    synth.inject_rw_cycle(h)
+    body = _jsonl(h)
+    code, r = _post(port, "/verifier/s1/open",
+                    json.dumps({"consistency-models":
+                                ["strict-serializable"]}).encode())
+    assert code == 200 and r["state"] == "open"
+    cur = 0
+    while cur < len(body):
+        code, r = _post(port, f"/ingest/s1?cursor={cur}",
+                        body[cur:cur + 4096])
+        assert code == 200
+        cur = r["cursor"]
+    assert cur == len(body)
+    code, raw = _get(port, "/verdict/s1")
+    v = json.loads(raw)
+    assert code == 200 and v["valid?"] is False and v["anomaly-types"]
+    code, sealed = _post(port, "/verifier/s1/seal")
+    assert code == 200 and sealed["equal"] is True
+    # re-seal is idempotent
+    assert _post(port, "/verifier/s1/seal")[0] == 200
+    code, page = _get(port, "/verifier")
+    assert code == 200 and b"s1" in page and b"sealed" in page
+    code, page = _get(port, "/verifier/s1")
+    assert code == 200 and b"incremental == batch" in page
+    code, page = _get(port, "/live/verifier/s1")
+    assert code == 200  # the per-session events.jsonl renders as /live
+    code, page = _get(port, "/")
+    assert code == 200 and b"/verifier" in page
+    code, m = _get(port, "/metrics")
+    assert b"jepsen_verifier_ops_ingested_total" in m
+    assert b"jepsen_verifier_sweep_s_bucket" in m
+
+
+def test_http_read_only_pages_without_service(tmp_path):
+    """`serve` without --ingest still renders sessions from their
+    session.json snapshots (and 404s POSTs)."""
+    svc = VerifierService(str(tmp_path))
+    h = synth.la_history(n_txns=50, n_keys=3, seed=0)
+    svc.ingest("ro", _jsonl(h), cursor=0)
+    svc.verdict("ro")
+    svc.close()
+    srv = web.serve(port=0, base=str(tmp_path), background=True)
+    try:
+        port = srv.server_address[1]
+        code, page = _get(port, "/verifier")
+        assert code == 200 and b"ro" in page
+        code, raw = _get(port, "/verdict/ro")
+        assert code == 200 and json.loads(raw)["valid?"] is True
+        code, _doc = _post(port, "/ingest/ro?cursor=0", b"{}\n")
+        assert code == 404
+    finally:
+        srv.server_close()
+
+
+# ------------------------------------------------- kill -9 the daemon
+
+_SERVER = """\
+import sys
+from jepsen_tpu import web
+from jepsen_tpu.verifier import VerifierService
+base, port = sys.argv[1], int(sys.argv[2])
+svc = VerifierService(base)
+web.serve(port=port, base=base, verifier=svc)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_up(port, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/verifier", timeout=2)
+            return True
+        except Exception:  # noqa: BLE001
+            time.sleep(0.2)
+    return False
+
+
+def _spawn_server(base, port):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SERVER, base, str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert _wait_up(port), "serve daemon did not come up"
+    return proc
+
+
+def test_kill9_serve_daemon_replay_and_client_resume(tmp_path):
+    """THE crash/rudeness contract: kill -9 the serve daemon
+    mid-session; restart; the journal replays to the identical verdict
+    digest, and the client's resume from its last acked cursor is
+    idempotent — the sealed verdict equals the batch checker's."""
+    base = str(tmp_path)
+    h = synth.la_history(n_txns=150, n_keys=5, seed=13)
+    synth.inject_wr_cycle(h)
+    body = _jsonl(h)
+    port = _free_port()
+    proc = _spawn_server(base, port)
+    cur = 0
+    try:
+        # stream roughly half, then SIGKILL mid-session
+        while cur < len(body) // 2:
+            code, r = _post(port, f"/ingest/k9?cursor={cur}",
+                            body[cur:cur + 2048])
+            assert code == 200
+            cur = r["cursor"]
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    # restart; the replayed session must equal a fresh one fed the
+    # same journaled prefix (digest-pinned)
+    port2 = _free_port()
+    proc2 = _spawn_server(base, port2)
+    try:
+        code, raw = _get(port2, "/verdict/k9")
+        assert code == 200
+        recovered = json.loads(raw)
+        # the service default config checks "serializable" — the
+        # reference replay must use the same want set
+        ref = VerifierSession("ref", ("serializable",))
+        for chunk in SessionJournal(
+                os.path.join(base, "verifier", "k9")).read_ops():
+            ref.append_ops(chunk)
+        assert recovered["digest"] == verdict_digest(ref.verdict())
+        # client resumes from its last acked cursor (possibly behind
+        # the journal: overlap skipped idempotently), then seals
+        while cur < len(body):
+            code, r = _post(port2, f"/ingest/k9?cursor={cur}",
+                            body[cur:cur + 2048])
+            assert code == 200
+            cur = r["cursor"]
+        assert cur == len(body)
+        code, sealed = _post(port2, "/verifier/k9/seal")
+        assert code == 200 and sealed["equal"] is True
+        batch = oracle.check(pack_txns(h, "list-append"),
+                             ("serializable",))
+        # default service config checks serializable; anomaly SET of
+        # the sealed verdict matches the batch checker's
+        assert sealed["verdict"]["valid?"] == batch["valid?"]
+        assert sealed["verdict"]["anomaly-types"] == \
+            batch["anomaly-types"]
+    finally:
+        proc2.kill()
+        proc2.wait(timeout=10)
+
+
+# ------------------------------------------------- soak smoke (CI)
+
+def _run_soak(args, timeout):
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "soak_verifier.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, script, *args],
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "soak OK" in proc.stdout
+    return proc.stdout
+
+
+def test_soak_verifier_fast_smoke():
+    """scripts/soak_verifier.py --fast in a subprocess: concurrent
+    clients + FaultPlan chaos on the ingest path; every session seals
+    with incremental == batch."""
+    _run_soak(["--fast"], timeout=300)
+
+
+@pytest.mark.slow
+def test_soak_verifier_long():
+    """The long soak: 8 clients x 16 segments x 400 txns with 10%
+    chaos on every guarded verifier seam — every session must still
+    seal incremental == batch."""
+    out = _run_soak(["--clients", "8", "--segments", "16",
+                     "--txns", "400", "--fault-p", "0.1",
+                     "--seed", "1"], timeout=560)
+    assert "8 clients" in out
+
+
+# ------------------------------------------------- telemetry spans
+
+def test_verifier_spans_emitted():
+    coll = telemetry.activate()
+    try:
+        h = synth.la_history(n_txns=80, n_keys=4, seed=2)
+        ses = _feed(VerifierSession("t", MODELS), _ops(h), 20)
+        ses.seal()
+        doc = telemetry.snapshot(coll)
+    finally:
+        telemetry.deactivate(coll)
+    names = set()
+
+    def walk(sp):
+        names.add(sp["name"])
+        for c in sp.get("children") or []:
+            walk(c)
+
+    for r in doc.get("spans", []):
+        walk(r)
+    assert {"verifier.append", "verifier.sweep",
+            "verifier.seal-batch-check"} <= names
+
+
+# ------------------------------------------------- the 10x criterion
+
+@pytest.mark.slow
+def test_incremental_recheck_10x_faster_than_batch():
+    """Acceptance: +1k txns appended to a 100k-txn session re-checks
+    >= 10x faster than a full batch re-check of the concatenated
+    history.  Span-cited: both sides run under telemetry and the
+    asserted ratio comes from the recorded span durations."""
+    p = synth.packed_la_history(n_txns=101_000, n_keys=12_000, seed=4)
+    segs = list(iter_packed_segments(p, 10_000))
+    warm, extra = segs[:-1], segs[-1]  # the +1k tail segment
+    assert sum(len(c[0]["txn_type"]) for c in warm) == 100_000
+    ses = VerifierSession("big", MODELS)
+    for cols, rd, base in warm:
+        ses.append_columns(cols, rd_elems=rd, rd_base=base)
+    ses.verdict()  # steady state: swept through 100k txns
+
+    coll = telemetry.activate()
+    try:
+        with telemetry.span("verifier.incremental-recheck"):
+            cols, rd, base = extra
+            ses.append_columns(cols, rd_elems=rd, rd_base=base)
+            v = ses.verdict()
+        assert v["valid?"] is True and v["txns"] == 101_000
+        with telemetry.span("verifier.batch-recheck"):
+            batch = oracle.check(ses.to_packed(), MODELS)
+        assert batch["valid?"] is True
+        doc = telemetry.snapshot(coll)
+    finally:
+        telemetry.deactivate(coll)
+    durs = {}
+
+    def walk(sp):
+        durs.setdefault(sp["name"], 0)
+        durs[sp["name"]] += sp.get("dur_ns") or 0
+        for c in sp.get("children") or []:
+            walk(c)
+
+    for r in doc.get("spans", []):
+        walk(r)
+    inc_s = durs["verifier.incremental-recheck"] / 1e9
+    batch_s = durs["verifier.batch-recheck"] / 1e9
+    assert batch_s >= 10 * inc_s, \
+        f"incremental {inc_s:.2f}s vs batch {batch_s:.2f}s " \
+        f"({batch_s / max(inc_s, 1e-9):.1f}x, need >= 10x)"
