@@ -257,10 +257,26 @@ def run_campaign(spec: Union[str, dict], base: Optional[str] = None, *,
                     rec.get("run"), rec.get("valid?"))
 
     t0 = time.monotonic()
-    from jepsen_tpu.telemetry import Heartbeat
+    # heartbeat routing (ISSUE 9 satellite): when a fleet coordinator
+    # URL is configured (spec opts "coordinator", or the
+    # JEPSEN_COORDINATOR env for whole-process routing), progress is
+    # PUSHED over HTTP and the coordinator's single Heartbeat writer
+    # renders the live.json; the file-only path stays the fallback —
+    # both produce the same /campaign/<name>/live shape.
+    coord_url = spec["opts"].get("coordinator") or \
+        os.environ.get("JEPSEN_COORDINATOR", "").strip()
+    if coord_url:
+        from jepsen_tpu.telemetry import HttpHeartbeat
 
-    hb = Heartbeat(live_path(spec["name"], base), campaign=spec["name"],
-                   total=len(specs), done=len(specs) - len(todo))
+        hb = HttpHeartbeat(coord_url, campaign=spec["name"],
+                           total=len(specs),
+                           done=len(specs) - len(todo))
+    else:
+        from jepsen_tpu.telemetry import Heartbeat
+
+        hb = Heartbeat(live_path(spec["name"], base),
+                       campaign=spec["name"],
+                       total=len(specs), done=len(specs) - len(todo))
     sched = Scheduler(workers, device_slots=device_slots,
                       executor=executor, retry=retry,
                       run_deadline_s=run_deadline_s, heartbeat=hb)

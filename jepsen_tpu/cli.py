@@ -352,6 +352,108 @@ def campaign_cmd(opts: argparse.Namespace) -> int:
     return 2
 
 
+def fleet_cmd(opts: argparse.Namespace) -> int:
+    """`fleet serve|work|status` — the distributed campaign control
+    plane (docs/FLEET.md): a coordinator serves a spec as a leased
+    work queue over HTTP; remote workers claim, execute, and upload
+    verdicts; every cell lands exactly one attributable record."""
+    import json
+    import signal
+    import time as _time
+    import urllib.request
+
+    from . import report, web
+    from .fleet import FleetCoordinator, FleetWorker
+
+    base = opts.store_dir
+    if opts.action == "serve":
+        if not opts.spec:
+            print("fleet serve needs a campaign spec", file=sys.stderr)
+            return 2
+        try:
+            coord = FleetCoordinator(opts.spec, base,
+                                     lease_s=opts.lease,
+                                     run_deadline_s=opts.run_deadline)
+        except (OSError, ValueError) as e:
+            print(f"fleet: bad spec {opts.spec!r}: {e}", file=sys.stderr)
+            return 2
+        print(f"fleet {coord.name}: {len(coord.specs)} cells, "
+              f"{len(coord._done_ids)} already indexed, lease "
+              f"{coord.lease_s}s, boot digest {coord.boot_digest}",
+              flush=True)
+        if not getattr(opts, "until_done", False):
+            try:
+                web.serve(port=opts.port, base=base, host=opts.host,
+                          fleet=coord)
+            finally:
+                coord.close()
+            return 0
+        srv = web.serve(port=opts.port, base=base, host=opts.host,
+                        fleet=coord, background=True)
+        try:
+            while not coord.finished:
+                _time.sleep(0.2)
+        except KeyboardInterrupt:
+            return 1
+        finally:
+            coord.close()
+            srv.server_close()
+        summary = coord.summary()
+        print(report.render_campaign(summary))
+        bad = summary["counts"]["false"]
+        if bad:
+            print(f"{bad} invalid run(s)", file=sys.stderr)
+        return 1 if bad else 0
+    if opts.action == "work":
+        if not opts.coordinator:
+            print("fleet work needs --coordinator URL", file=sys.stderr)
+            return 2
+        worker = FleetWorker(opts.coordinator, base, name=opts.name,
+                             device_slots=opts.device_slots,
+                             poll_s=opts.poll)
+        # SIGTERM drains gracefully: finish the in-flight cell, release
+        # unstarted claims, exit — the lease protocol covers kill -9
+        try:
+            signal.signal(signal.SIGTERM,
+                          lambda *_: worker.stop.set())
+        except ValueError:
+            pass  # not the main thread (embedded use)
+        try:
+            n = worker.run()
+        except KeyboardInterrupt:
+            return 1
+        print(f"worker {worker.name}: {n} cells completed")
+        return 0
+    if opts.action == "status":
+        if not opts.coordinator:
+            print("fleet status needs --coordinator URL",
+                  file=sys.stderr)
+            return 2
+        url = opts.coordinator.rstrip("/") + "/fleet/status"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                s = json.loads(r.read().decode())
+        except Exception as e:  # noqa: BLE001 — network errors surfaced
+            print(f"fleet: status fetch failed: {e}", file=sys.stderr)
+            return 2
+        c = s.get("counts") or {}
+        print(f"fleet {s.get('campaign')}: {s.get('done')}/"
+              f"{s.get('total')} cells done "
+              f"({'finished' if s.get('finished') else 'running'}) — "
+              f"{c.get('queued')} queued, {c.get('claimed')} claimed, "
+              f"{c.get('requeues')} requeues, {c.get('duplicates')} "
+              f"duplicates discarded")
+        print(f"digest: {s.get('digest')}  boot: {s.get('boot-digest')}")
+        for w, d in sorted((s.get("workers") or {}).items()):
+            print(f"  worker {w}: host={d.get('host')} "
+                  f"slots={d.get('device-slots')} "
+                  f"seen {d.get('age-s')}s ago "
+                  f"({'alive' if d.get('alive') else 'silent'})")
+        return 0
+    print(f"fleet: unknown action {opts.action!r}", file=sys.stderr)
+    return 2
+
+
 def obs_cmd(opts: argparse.Namespace) -> int:
     """`obs ingest|rebuild|gate|sql|bench` — the sqlite telemetry
     warehouse over the store dir (docs/TELEMETRY.md): build/refresh it,
@@ -620,6 +722,39 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                          "the subprocess executor; cooperative checker "
                          "deadline otherwise)")
 
+    pfl = sub.add_parser("fleet",
+                         help="distributed campaign execution: a "
+                              "leased work queue served over HTTP + "
+                              "remote workers (docs/FLEET.md)")
+    pfl.add_argument("action", choices=("serve", "work", "status"))
+    pfl.add_argument("spec", nargs="?",
+                     help="campaign spec JSON file (serve)")
+    pfl.add_argument("-p", "--port", type=int, default=8080)
+    pfl.add_argument("--host", default="127.0.0.1",
+                     help='bind address (use "0.0.0.0" so remote '
+                          "workers can reach the control plane)")
+    pfl.add_argument("--coordinator", default=None, metavar="URL",
+                     help="coordinator base URL (work/status), e.g. "
+                          "http://host:8080")
+    pfl.add_argument("--lease", type=float, default=15.0,
+                     help="claim lease seconds; a worker that stops "
+                          "renewing for this long loses the cell, "
+                          "which requeues (serve)")
+    pfl.add_argument("--run-deadline", type=float, default=None,
+                     help="per-cell checker budget in seconds, merged "
+                          "into cells without their own (serve)")
+    pfl.add_argument("--until-done", action="store_true",
+                     help="serve: exit with the campaign summary once "
+                          "every cell has a verdict (default: keep "
+                          "serving)")
+    pfl.add_argument("--name", default=None,
+                     help="worker name (default: host-pid)")
+    pfl.add_argument("--device-slots", type=int, default=1,
+                     help="device pipelines this worker can run; 0 "
+                          "claims host-only cells")
+    pfl.add_argument("--poll", type=float, default=0.5,
+                     help="idle claim poll interval seconds (work)")
+
     def dispatch(opts: argparse.Namespace) -> int:
         if opts.cmd == "test":
             return run_test_cmd(test_fn, opts)
@@ -635,6 +770,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
             return shrink_cmd(opts, checker_fn)
         if opts.cmd == "campaign":
             return campaign_cmd(opts)
+        if opts.cmd == "fleet":
+            return fleet_cmd(opts)
         if opts.cmd == "obs":
             return obs_cmd(opts)
         p.error(f"unknown command {opts.cmd}")

@@ -1,0 +1,24 @@
+"""jepsen_tpu.fleet — the fault-tolerant multi-host control plane.
+
+A distributed, crash-tolerant execution layer over `campaign/`
+(docs/FLEET.md): a coordinator serves a campaign spec as a **leased
+work queue** over HTTP (`coordinator.FleetCoordinator` on `web.serve`),
+remote workers (`worker.FleetWorker`, ``cli fleet work``) claim cells,
+execute them through `campaign.core.execute_run`, renew their leases
+while running, and upload the verdict record — and the whole plane
+survives its own nemeses: worker ``kill -9`` (lease lapses, cell
+requeues), coordinator ``kill -9`` (the fsync'd ledger replays to the
+identical queue state), partitions (workers retry through them), and
+zombie double-completions (discarded, at-most-once verdicts).
+
+The contract is the campaign contract, distributed: every cell
+terminates with exactly one attributable verdict record in the same
+append-only index a single-process `run_campaign` writes.
+"""
+
+from .coordinator import FleetCoordinator
+from .queue import WorkQueue, fleet_path, record_digest
+from .worker import FleetWorker
+
+__all__ = ["FleetCoordinator", "FleetWorker", "WorkQueue",
+           "fleet_path", "record_digest"]

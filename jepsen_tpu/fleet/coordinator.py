@@ -1,0 +1,396 @@
+"""The fleet coordinator: the campaign's HTTP control plane.
+
+One coordinator per campaign (``cli fleet serve <spec.json>``), mounted
+on `web.serve` (the handler routes ``POST /fleet/<verb>`` and ``GET
+/fleet/status`` here).  It owns three durable artifacts:
+
+- the **work queue ledger** (`queue.WorkQueue`,
+  ``<store>/fleet/<name>.jsonl``) — who holds what under which lease;
+- the **campaign index** (`campaign.index.Index`) — the same
+  append-only verdict ledger a single-process `run_campaign` writes,
+  so every downstream surface (web grid, warehouse, regression
+  queries, resume) works unchanged on a distributed campaign;
+- the **heartbeat file** (`telemetry.Heartbeat`,
+  ``<store>/campaigns/<name>.live.json``) — ONE writer for the live
+  dashboard, fed over HTTP by remote workers (the PR 5 open item:
+  heartbeats pushed over HTTP merge into the exact shape the
+  single-process scheduler writes, so ``/campaign/<name>/live`` renders
+  both).
+
+Crash discipline: the queue ledger is appended BEFORE the index (a
+``complete`` event is the commit point), and boot **reconciles** —
+any queue-done cell whose record is missing from the index (a crash
+landed between the two appends) is re-appended from the ledger's own
+copy of the record.  A ``kill -9``'d coordinator therefore replays to
+the identical queue state (`boot digest pinned <queue.WorkQueue.digest>`)
+and never loses or doubles a verdict.
+
+Chaos: every endpoint fires the active `resilience.FaultPlan` at its
+site (``fleet.register`` / ``fleet.claim`` / ``fleet.heartbeat`` /
+``fleet.complete`` / ``fleet.release`` / ``fleet.status``) — an
+injected fault becomes a 503 the workers' retry policy rides out, a
+``stall`` kind becomes server-side latency.  The same site family
+fires client-side in `worker.FleetWorker`, so a plan installed in
+either process partitions that side of the seam.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple, Union
+
+from jepsen_tpu import store
+from jepsen_tpu.campaign import core as ccore
+from jepsen_tpu.campaign import plan as plan_mod
+from jepsen_tpu.campaign.index import Index
+from jepsen_tpu.resilience import faults as faults_mod
+from jepsen_tpu.resilience.faults import FaultInjected
+
+from .queue import WorkQueue, fleet_path
+
+logger = logging.getLogger("jepsen.fleet")
+
+__all__ = ["FleetCoordinator"]
+
+#: a worker whose last heartbeat is older than this many leases is
+#: counted dead by the workers-alive gauge (it can still come back)
+ALIVE_LEASES = 3.0
+
+
+def _registry():
+    from jepsen_tpu import telemetry
+
+    return telemetry.registry()
+
+
+class FleetCoordinator:
+    """Serve one campaign spec to a fleet of HTTP workers.
+
+    Thread-safe — the web server's handler threads call straight in
+    (the queue has its own lock; the coordinator lock covers the index
+    append + done-set bookkeeping).
+    """
+
+    def __init__(self, spec: Union[str, dict],
+                 base: Optional[str] = None, *,
+                 lease_s: float = 15.0,
+                 run_deadline_s: Optional[float] = None):
+        self.spec = plan_mod.load_spec(spec)
+        self.base = base or store.BASE
+        self.name = self.spec["name"]
+        self.lease_s = float(lease_s)
+        self.specs = plan_mod.expand(self.spec)
+        self.gen = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        self.spec_digest = plan_mod.spec_digest(self.spec)
+        self._lock = threading.RLock()
+        for rs in self.specs:
+            # same opt plumbing as run_campaign: a hard per-run wall
+            # also bounds the checkers cooperatively
+            if run_deadline_s and \
+                    rs.opts.get("checker-time-limit") is None:
+                rs.opts["checker-time-limit"] = run_deadline_s
+        self.idx = Index(ccore.index_path(self.name, self.base))
+        spec_ids = {rs.run_id for rs in self.specs}
+        self._done_ids = self.idx.completed_ids() & spec_ids
+        self.queue = WorkQueue(fleet_path(self.name, self.base))
+        #: the replayed-state digest at construction — the kill -9
+        #: crash tests compare this against an independent replay of
+        #: the pre-kill ledger
+        self.boot_digest = self.queue.digest()
+        enqueued = 0
+        for rs in self.specs:
+            if rs.run_id in self._done_ids:
+                continue  # resume parity: indexed cells never re-run
+            if self.queue.enqueue(rs.to_dict()):
+                enqueued += 1
+        self._reconcile()
+        #: worker registry: name -> capabilities + last_seen
+        self.workers: Dict[str, Dict[str, Any]] = {}
+        from jepsen_tpu.telemetry import Heartbeat
+
+        #: the ONE live.json writer per campaign; remote workers feed
+        #: it over /fleet/heartbeat
+        self._hbs: Dict[str, Any] = {}
+        self._hbs[self.name] = Heartbeat(
+            ccore.live_path(self.name, self.base), campaign=self.name,
+            total=len(self.specs), done=len(self._done_ids))
+        logger.info(
+            "fleet %s: %d cells (%d already indexed, %d enqueued), "
+            "lease %.1fs, boot digest %s", self.name, len(self.specs),
+            len(self._done_ids), enqueued, self.lease_s,
+            self.boot_digest)
+        self._update_gauges()
+
+    def _reconcile(self) -> None:
+        """Re-append index records for queue-done cells the index
+        missed — the crash window between the queue's ``complete``
+        event (the commit point) and the index append."""
+        indexed = self.idx.completed_ids()
+        for cell in self.queue.done_cells():
+            run = cell["run"]
+            if run in indexed or not isinstance(cell["record"], dict):
+                continue
+            self.idx.append(self._stamp(cell["record"],
+                                        cell["completed_by"]))
+            self._done_ids.add(run)
+            logger.info("fleet %s: reconciled missing index record "
+                        "for %s", self.name, run)
+
+    def _stamp(self, record: Dict[str, Any], worker: Any
+               ) -> Dict[str, Any]:
+        rec = dict(record)
+        rec.setdefault("gen", self.gen)
+        rec.setdefault("spec", self.spec_digest)
+        if worker:
+            rec.setdefault("fleet-worker", str(worker))
+        return rec
+
+    # -- shared endpoint plumbing -------------------------------------------
+
+    def _guarded(self, site: str, fn, *args
+                 ) -> Tuple[int, Dict[str, Any]]:
+        """Fire the active fault plan at this control-plane seam, then
+        run the handler.  Injected faults surface as 503 (the "drop"
+        the workers' retry policy rides out); ``stall`` kinds sleep
+        inside ``fire`` — server-side latency."""
+        try:
+            plan = faults_mod.active_plan()
+            if plan is not None:
+                plan.fire(site)
+        except FaultInjected as e:
+            return 503, {"error": str(e), "injected": True}
+        try:
+            return fn(*args)
+        except Exception as e:  # noqa: BLE001 — a handler bug must
+            logger.exception("fleet %s failed", site)  # not kill serve
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    @property
+    def finished(self) -> bool:
+        with self._lock:
+            return len(self._done_ids) >= len(self.specs)
+
+    # -- endpoints (code, doc) ----------------------------------------------
+
+    def register(self, body: Dict[str, Any]
+                 ) -> Tuple[int, Dict[str, Any]]:
+        return self._guarded("fleet.register", self._register, body)
+
+    def _register(self, body: Dict[str, Any]
+                  ) -> Tuple[int, Dict[str, Any]]:
+        worker = str(body.get("worker") or "")
+        if not worker:
+            return 400, {"error": "register needs a worker name"}
+        with self._lock:
+            self.workers[worker] = {
+                "host": body.get("host"),
+                "backend": body.get("backend"),
+                "device-slots": int(body.get("device-slots", 1)),
+                "registered": round(time.time(), 3),
+                "last-seen": round(time.time(), 3),
+            }
+        self._update_gauges()
+        return 200, {"ok": True, "campaign": self.name,
+                     "lease-s": self.lease_s,
+                     "total": len(self.specs)}
+
+    def claim(self, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        return self._guarded("fleet.claim", self._claim, body)
+
+    def _claim(self, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        worker = str(body.get("worker") or "")
+        if not worker:
+            return 400, {"error": "claim needs a worker name"}
+        caps = self._touch(worker)
+        spec, deadline = self.queue.claim(
+            worker, lease_s=self.lease_s,
+            device_ok=caps.get("device-slots", 1) > 0)
+        self._update_gauges()
+        if spec is None:
+            c = self.queue.counts()
+            return 200, {"spec": None, "finished": self.finished,
+                         "queued": c["queued"], "claimed": c["claimed"]}
+        return 200, {"spec": spec, "lease-s": self.lease_s,
+                     "deadline": deadline}
+
+    def heartbeat(self, body: Dict[str, Any]
+                  ) -> Tuple[int, Dict[str, Any]]:
+        return self._guarded("fleet.heartbeat", self._heartbeat, body)
+
+    def _heartbeat(self, body: Dict[str, Any]
+                   ) -> Tuple[int, Dict[str, Any]]:
+        """The merged heartbeat sink: fleet workers renew leases and
+        publish in-flight state; remote `run_campaign`\\ s
+        (`telemetry.stream.HttpHeartbeat`) push whole-campaign
+        progress.  Everything lands in the campaign's ONE
+        `telemetry.Heartbeat` writer, so ``/campaign/<n>/live``
+        renders both sources in the same shape."""
+        campaign = str(body.get("campaign") or self.name)
+        worker = body.get("worker")
+        hb = self._hb_for(campaign, total=body.get("total"),
+                          done=body.get("init-done"))
+        if worker is not None:
+            # liveness only for KNOWN fleet workers (registered, or
+            # implicitly via claim/complete): a remote run_campaign's
+            # scheduler slot names (campaign-worker-0, ...) pushing
+            # through this sink are that campaign's workers, not this
+            # fleet's — registering them would pollute the worker
+            # table and over-count the workers-alive gauge
+            with self._lock:
+                known = str(worker) in self.workers
+            if known:
+                self._touch(str(worker))
+            if "state" in body:
+                hb.worker(str(worker), body.get("state"))
+        done = body.get("done")
+        if isinstance(done, dict):
+            hb.record_done(done.get("run"), done.get("valid?"))
+        lost = []
+        for run in body.get("renew") or []:
+            if not self.queue.renew(str(run), str(worker or ""),
+                                    self.lease_s):
+                lost.append(str(run))
+        self.queue.expire()
+        if body.get("finished"):
+            hb.close()
+        self._update_gauges()
+        return 200, {"ok": True, "lost": lost, "lease-s": self.lease_s}
+
+    def complete(self, body: Dict[str, Any]
+                 ) -> Tuple[int, Dict[str, Any]]:
+        return self._guarded("fleet.complete", self._complete, body)
+
+    def _complete(self, body: Dict[str, Any]
+                  ) -> Tuple[int, Dict[str, Any]]:
+        worker = str(body.get("worker") or "")
+        run = str(body.get("run") or "")
+        record = body.get("record")
+        if not worker or not run or not isinstance(record, dict):
+            return 400, {"error": "complete needs worker, run, record"}
+        self._touch(worker)
+        status = self.queue.complete(run, worker, record)
+        if status == "unknown":
+            return 404, {"error": f"no such cell {run!r}"}
+        if status == "accepted":
+            with self._lock:
+                self.idx.append(self._stamp(record, worker))
+                self._done_ids.add(run)
+                hb = self._hbs.get(self.name)
+                if hb is not None:
+                    hb.record_done(run, record.get("valid?"))
+                    if self.finished:
+                        hb.close()
+        self._update_gauges()
+        if status == "duplicate":
+            logger.warning("fleet %s: duplicate completion of %s by "
+                           "zombie %s discarded", self.name, run, worker)
+            return 200, {"ok": False, "duplicate": True}
+        return 200, {"ok": True, "status": status,
+                     "finished": self.finished}
+
+    def release(self, body: Dict[str, Any]
+                ) -> Tuple[int, Dict[str, Any]]:
+        return self._guarded("fleet.release", self._release, body)
+
+    def _release(self, body: Dict[str, Any]
+                 ) -> Tuple[int, Dict[str, Any]]:
+        worker = str(body.get("worker") or "")
+        run = str(body.get("run") or "")
+        ok = self.queue.release(run, worker)
+        self._update_gauges()
+        return 200, {"ok": ok}
+
+    def status(self) -> Tuple[int, Dict[str, Any]]:
+        return self._guarded("fleet.status", self._status)
+
+    def _status(self) -> Tuple[int, Dict[str, Any]]:
+        self.queue.expire()
+        now = time.time()
+        with self._lock:
+            workers = {
+                w: {"host": c.get("host"), "backend": c.get("backend"),
+                    "device-slots": c.get("device-slots"),
+                    "age-s": round(now - c["last-seen"], 3),
+                    "alive": now - c["last-seen"] <=
+                    ALIVE_LEASES * self.lease_s}
+                for w, c in self.workers.items()}
+            done = len(self._done_ids)
+        self._update_gauges()
+        return 200, {
+            "campaign": self.name,
+            "gen": self.gen,
+            "spec-digest": self.spec_digest,
+            "total": len(self.specs),
+            "done": done,
+            "finished": done >= len(self.specs),
+            "counts": self.queue.counts(),
+            "leases": self.queue.leases(),
+            "digest": self.queue.digest(),
+            "boot-digest": self.boot_digest,
+            "lease-s": self.lease_s,
+            "workers": workers,
+        }
+
+    # -- internals -----------------------------------------------------------
+
+    def _touch(self, worker: str) -> Dict[str, Any]:
+        """Refresh a worker's liveness; unseen workers get implicit
+        default capabilities (register is polite, not mandatory)."""
+        with self._lock:
+            caps = self.workers.setdefault(worker, {
+                "host": None, "backend": None, "device-slots": 1,
+                "registered": round(time.time(), 3),
+                "last-seen": round(time.time(), 3)})
+            caps["last-seen"] = round(time.time(), 3)
+            return dict(caps)
+
+    def _hb_for(self, campaign: str, total: Any = None,
+                done: Any = None):
+        from jepsen_tpu.telemetry import Heartbeat
+
+        with self._lock:
+            hb = self._hbs.get(campaign)
+            if hb is None:
+                hb = self._hbs[campaign] = Heartbeat(
+                    ccore.live_path(campaign, self.base),
+                    campaign=campaign,
+                    total=int(total or 0), done=int(done or 0))
+            return hb
+
+    def _update_gauges(self) -> None:
+        """The fleet's /metrics surface (live registry): workers alive
+        by heartbeat freshness, active leases, cells by state."""
+        try:
+            reg = _registry()
+            now = time.time()
+            with self._lock:
+                alive = sum(
+                    1 for c in self.workers.values()
+                    if now - c["last-seen"] <= ALIVE_LEASES * self.lease_s)
+            c = self.queue.counts()
+            reg.gauge("fleet-workers-alive").set(alive)
+            reg.gauge("fleet-leases-active").set(c["claimed"])
+            for state in ("queued", "claimed", "done"):
+                reg.gauge("fleet-cells", state=state).set(c[state])
+        except Exception:  # noqa: BLE001 — observability only
+            logger.debug("fleet gauge update failed", exc_info=True)
+
+    def summary(self) -> Dict[str, Any]:
+        """The suite rollup once the fleet finished — the same shape
+        `run_campaign` returns (`campaign.core.summarize`)."""
+        return ccore.summarize(self.spec, self.base, idx=self.idx)
+
+    def close(self) -> None:
+        """Shut down the observability side.  Only THIS fleet's own
+        heartbeat is closed, and only when its campaign actually
+        finished — an interrupted fleet must leave its in-flight state
+        in live.json for the /live post-mortem (same contract as
+        `run_campaign`), and heartbeats created for OTHER campaigns
+        pushing through /fleet/heartbeat belong to those campaigns:
+        they close themselves via their own ``finished`` push."""
+        with self._lock:
+            hb = self._hbs.get(self.name)
+        if hb is not None and self.finished:
+            hb.close()

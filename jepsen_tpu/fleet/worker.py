@@ -1,0 +1,228 @@
+"""The fleet worker: claim → execute → renew → complete, over HTTP.
+
+``cli fleet work --coordinator URL`` runs one of these until the
+coordinator reports the campaign finished.  Execution is exactly
+`campaign.core.execute_run` — shrink-on-invalid, telemetry streaming,
+crash→attributable-record semantics all included — so a distributed
+cell's index record is indistinguishable from a single-process one
+(modulo the ``fleet-worker`` stamp the coordinator adds).
+
+Resilience contract:
+
+- every control-plane call goes through `resilience.device_call` with
+  a seeded `RetryPolicy` and the :func:`~.policy.is_transient_http`
+  classifier — connection refusals (a coordinator restarting),
+  timeouts, 502/503/504, and injected `FaultInjected` transients are
+  ridden out with bounded backoff; 4xx protocol errors propagate.
+  The call sites are the ``fleet.*`` fault-plan family, so a plan
+  installed in the worker process (``JEPSEN_FAULTS`` env in the chaos
+  soak) drops/stalls the client side of the same seams the
+  coordinator guards server-side.
+- a renewer thread heartbeats + renews the lease at ``lease/3`` while
+  a cell runs; a LOST lease (the coordinator expired it — e.g. after a
+  partition) is noted but execution continues: the completion is then
+  either the first verdict (accepted) or a zombie duplicate the
+  coordinator discards.  Renewer failures never kill the run.
+- SIGTERM (``cli fleet work`` installs the handler) drains gracefully:
+  the in-flight cell finishes and uploads, a claimed-but-unstarted
+  cell is released back to the queue, and the loop exits.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import resilience, store
+from jepsen_tpu.campaign.plan import RunSpec
+from jepsen_tpu.campaign.scheduler import crash_record
+from jepsen_tpu.resilience import RetryPolicy
+from jepsen_tpu.resilience.policy import is_transient_http
+
+logger = logging.getLogger("jepsen.fleet")
+
+__all__ = ["FleetWorker"]
+
+
+class FleetWorker:
+    """One remote executor against a fleet coordinator."""
+
+    def __init__(self, coordinator: str, base: Optional[str] = None, *,
+                 name: Optional[str] = None, device_slots: int = 1,
+                 backend: Optional[str] = None, poll_s: float = 0.5,
+                 lease_s: float = 15.0,
+                 retry: Optional[RetryPolicy] = None,
+                 timeout_s: float = 10.0):
+        self.url = coordinator.rstrip("/")
+        self.base = base or store.BASE
+        self.name = name or f"{socket.gethostname()}-{os.getpid()}"
+        self.device_slots = int(device_slots)
+        self.backend = backend
+        self.poll_s = float(poll_s)
+        self.lease_s = float(lease_s)  # server value adopted at register
+        self.timeout_s = float(timeout_s)
+        # generous by default: the retry window must cover a
+        # coordinator kill -9 + restart (a few seconds of ECONNREFUSED)
+        self.retry = retry or RetryPolicy(
+            max_attempts=8, base_delay_s=0.2, multiplier=2.0,
+            max_delay_s=2.0, classify=is_transient_http)
+        #: SIGTERM drain flag (cli fleet work sets it from the handler)
+        self.stop = threading.Event()
+        self.cells_done = 0
+        self.duplicates = 0
+
+    # -- transport -----------------------------------------------------------
+
+    def _post(self, site: str, path: str,
+              doc: Dict[str, Any]) -> Dict[str, Any]:
+        """One guarded control-plane POST: the active fault plan fires
+        at `site` (client-side chaos), transients retry per the
+        policy."""
+        body = json.dumps(doc).encode()
+
+        def send() -> Dict[str, Any]:
+            req = urllib.request.Request(
+                self.url + path, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode() or "{}")
+
+        return resilience.device_call(site, send, policy=self.retry)
+
+    # -- protocol ------------------------------------------------------------
+
+    def register(self) -> Dict[str, Any]:
+        r = self._post("fleet.register", "/fleet/register", {
+            "worker": self.name, "host": socket.gethostname(),
+            "backend": self.backend, "device-slots": self.device_slots})
+        if isinstance(r.get("lease-s"), (int, float)):
+            self.lease_s = float(r["lease-s"])
+        logger.info("fleet worker %s registered with %s (campaign %s, "
+                    "lease %.1fs)", self.name, self.url,
+                    r.get("campaign"), self.lease_s)
+        return r
+
+    def run(self) -> int:
+        """Claim-execute until the campaign finishes (or SIGTERM
+        drains); returns the number of cells this worker completed."""
+        self.register()
+        claim_fails = 0
+        while not self.stop.is_set():
+            try:
+                r = self._post("fleet.claim", "/fleet/claim",
+                               {"worker": self.name})
+            except Exception as e:  # noqa: BLE001 — outage outlasting
+                # the retry budget: keep polling (a daemon rides out
+                # long partitions), give up only after many in a row
+                claim_fails += 1
+                if claim_fails > 10:
+                    raise
+                logger.warning("fleet worker %s: claim failed (%s); "
+                               "re-polling", self.name, e)
+                time.sleep(self.poll_s)
+                continue
+            claim_fails = 0
+            spec = r.get("spec")
+            if not spec:
+                if r.get("finished"):
+                    break
+                time.sleep(self.poll_s)
+                continue
+            if self.stop.is_set():
+                # drained between claim and start: give the cell back
+                # instead of sitting on the lease until it lapses
+                self._post("fleet.release", "/fleet/release",
+                           {"worker": self.name, "run": spec["run_id"]})
+                break
+            self._run_cell(spec)
+        logger.info("fleet worker %s done: %d cells completed "
+                    "(%d duplicates discarded upstream)",
+                    self.name, self.cells_done, self.duplicates)
+        return self.cells_done
+
+    def _run_cell(self, spec: Dict[str, Any]) -> None:
+        from jepsen_tpu.campaign.core import execute_run
+
+        rs = RunSpec.from_dict(spec)
+        rs.opts["_base"] = self.base
+        run_id = rs.run_id
+        state = {"run": run_id, "workload": rs.workload_label,
+                 "fault": rs.fault_label, "seed": rs.seed,
+                 "slot": None, "worker-host": socket.gethostname()}
+        stop_renew = threading.Event()
+        lease_lost = threading.Event()
+
+        def renew_loop() -> None:
+            # heartbeat + renew at lease/3; failures are logged, never
+            # fatal — a lapsed lease just makes the completion racy,
+            # which the coordinator's at-most-once rule resolves
+            while not stop_renew.wait(max(0.2, self.lease_s / 3.0)):
+                try:
+                    r = self._post("fleet.heartbeat", "/fleet/heartbeat",
+                                   {"worker": self.name, "state": state,
+                                    "renew": [run_id]})
+                    if run_id in (r.get("lost") or []):
+                        lease_lost.set()
+                        logger.warning(
+                            "fleet worker %s: lease on %s LOST "
+                            "(requeued elsewhere); finishing anyway",
+                            self.name, run_id)
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    logger.warning("fleet worker %s: heartbeat failed "
+                                   "(%s)", self.name, e)
+
+        # announce the claim before execution so the live dashboard
+        # names the in-flight cell even if the run wedges instantly
+        try:
+            self._post("fleet.heartbeat", "/fleet/heartbeat",
+                       {"worker": self.name, "state": state,
+                        "renew": [run_id]})
+        except Exception:  # noqa: BLE001
+            pass
+        renewer = threading.Thread(target=renew_loop, daemon=True,
+                                   name=f"fleet-renew-{self.name}")
+        renewer.start()
+        t0 = time.monotonic()
+        try:
+            rec = execute_run(rs, self.base)
+        except Exception as e:  # noqa: BLE001 — same contract as the
+            # scheduler: whatever escapes execute_run becomes an
+            # attributable unknown record, never a worker crash
+            rec = crash_record(rs, f"{type(e).__name__}: {e}", 1,
+                               time.monotonic() - t0)
+        finally:
+            stop_renew.set()
+            renewer.join(timeout=5)
+        try:
+            r = self._post("fleet.complete", "/fleet/complete",
+                           {"worker": self.name, "run": run_id,
+                            "record": rec})
+            if r.get("duplicate"):
+                self.duplicates += 1
+                logger.warning("fleet worker %s: completion of %s was "
+                               "a duplicate (cell finished elsewhere)",
+                               self.name, run_id)
+            else:
+                self.cells_done += 1
+        except Exception as e:  # noqa: BLE001 — an upload outage
+            # outlasting the retries loses THIS attempt, not the cell:
+            # the lease lapses, the cell requeues, and another worker
+            # (or this one, next claim) re-executes it — exactly-one
+            # still holds because this record never landed
+            logger.warning("fleet worker %s: complete(%s) failed "
+                           "beyond retries (%s); cell will requeue on "
+                           "lease expiry", self.name, run_id, e)
+        finally:
+            try:
+                self._post("fleet.heartbeat", "/fleet/heartbeat",
+                           {"worker": self.name, "state": None})
+            except Exception:  # noqa: BLE001
+                pass

@@ -43,11 +43,13 @@ from jepsen_tpu.resilience.policy import (
     RetryPolicy,
     deadline_result,
     is_transient,
+    is_transient_http,
 )
 
 __all__ = [
     "Deadline", "DeadlineExceeded", "RetryPolicy", "is_transient",
-    "DEADLINE_ERROR", "DEFAULT_POLICY", "deadline_result",
+    "is_transient_http", "DEADLINE_ERROR", "DEFAULT_POLICY",
+    "deadline_result",
     "FaultPlan", "FaultInjected", "parse_spec", "plan_for", "use",
     "active_plan",
     "device_call", "with_fallback", "degrade_to_host", "env_anomaly",

@@ -23,11 +23,12 @@ module stays importable (and testable) without a device runtime.
 from __future__ import annotations
 
 import random
+import socket
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
 __all__ = ["Deadline", "DeadlineExceeded", "RetryPolicy", "is_transient",
-           "DEADLINE_ERROR", "deadline_result"]
+           "is_transient_http", "DEADLINE_ERROR", "deadline_result"]
 
 DEADLINE_ERROR = "deadline-exceeded"
 
@@ -163,6 +164,36 @@ def is_transient(exc: BaseException) -> bool:
         return False
     msg = str(exc)
     return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+#: HTTP statuses a control-plane client should retry: the server (or a
+#: gateway in front of it) said "not now", not "never"
+_TRANSIENT_HTTP = frozenset({502, 503, 504})
+
+
+def is_transient_http(exc: BaseException) -> bool:
+    """Transient classifier for control-plane HTTP clients (the fleet
+    worker's coordinator calls, ISSUE 9): everything
+    :func:`is_transient` accepts, plus connection-level failures and
+    5xx overload/gateway responses.
+
+    A coordinator restart window looks like ECONNREFUSED and a
+    partition like a timeout — both must be ridden out with bounded
+    backoff, while 4xx protocol errors are real bugs (bad cursor, bad
+    body) and propagate immediately.  :class:`DeadlineExceeded` stays
+    non-retryable via the :func:`is_transient` delegation order."""
+    if isinstance(exc, DeadlineExceeded):
+        return False
+    if is_transient(exc):
+        return True
+    import urllib.error
+
+    if isinstance(exc, urllib.error.HTTPError):  # before URLError: subclass
+        return exc.code in _TRANSIENT_HTTP
+    # URLError wraps the socket-level reason; raw socket errors appear
+    # when the failure races the response read
+    return isinstance(exc, (urllib.error.URLError, ConnectionError,
+                            TimeoutError, socket.timeout, OSError))
 
 
 class RetryPolicy:

@@ -183,10 +183,10 @@ def tests(name: Optional[str] = None, *, base: Optional[str] = None) -> List[str
         nd = os.path.join(b, n)
         # skip the base-level "current" symlink (and anything like it):
         # only real per-name directories hold runs — and the campaigns/
-        # + verifier/ subtrees, which hold ledgers and verifier session
-        # dirs, not run dirs
+        # + verifier/ + fleet/ subtrees, which hold ledgers and
+        # verifier session dirs, not run dirs
         if os.path.islink(nd) or not os.path.isdir(nd) \
-                or n in ("campaigns", "verifier"):
+                or n in ("campaigns", "verifier", "fleet"):
             continue
         for ts in os.listdir(nd):
             d = os.path.join(nd, ts)

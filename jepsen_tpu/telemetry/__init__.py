@@ -38,7 +38,7 @@ from typing import Any, Optional
 from . import export, metrics, spans, stream
 from .export import chrome_trace, snapshot, summarize, write_run
 from .metrics import Registry
-from .stream import Heartbeat, read_events
+from .stream import Heartbeat, HttpHeartbeat, read_events
 from .stream import attach as attach_stream
 from .stream import event as stream_event
 
@@ -68,6 +68,7 @@ __all__ = [
     "chrome_trace", "write_run", "summarize", "enable", "disable",
     "wanted_for", "export", "metrics", "spans", "stream",
     "attach_stream", "stream_event", "read_events", "Heartbeat",
+    "HttpHeartbeat",
 ]
 
 def registry() -> Registry:

@@ -61,6 +61,7 @@ from .export import _fmt_dur, _jsonable
 from .metrics import Registry
 
 __all__ = ["EventStream", "ResourceSampler", "Recorder", "Heartbeat",
+           "HttpHeartbeat",
            "attach", "event", "read_events", "replay", "render_line",
            "render_tail", "segment_files", "follow_events",
            "EVENTS_FILE", "SHRINK_EVENTS_FILE", "events_path"]
@@ -969,3 +970,67 @@ class Heartbeat:
         except (OSError, ValueError):
             return None
         return doc if isinstance(doc, dict) else None
+
+
+class HttpHeartbeat:
+    """:class:`Heartbeat` twin that PUSHES over HTTP to a fleet
+    coordinator instead of writing ``live.json`` locally — the PR 5
+    open item ("heartbeats pushed over HTTP"), closed by ISSUE 9.
+
+    Same interface and the same no-raise guarantee as
+    :class:`Heartbeat`; a `run_campaign` whose spec opts (or the
+    ``JEPSEN_COORDINATOR`` env) name a coordinator URL uses this
+    instead, and the coordinator's single `Heartbeat` writer merges
+    the pushes into the exact ``live.json`` shape the file path
+    writes — so ``/campaign/<name>/live`` renders both sources
+    unchanged (pinned in tests/test_fleet.py).
+
+    Best-effort by design: a dropped push loses a dashboard tick,
+    never work — the ledger stays the record.  A FAILED push arms a
+    cooldown (``backoff_s``) during which further pushes are skipped
+    outright: heartbeats are called synchronously from the campaign
+    scheduler's worker threads, and an unreachable coordinator —
+    exactly the partition the fleet rides out elsewhere — must cost
+    one timeout per cooldown window, not one per cell transition."""
+
+    def __init__(self, url: str, *, campaign: Optional[str] = None,
+                 total: int = 0, done: int = 0,
+                 timeout_s: float = 2.0, backoff_s: float = 5.0):
+        self.url = url.rstrip("/") + "/fleet/heartbeat"
+        self.campaign = campaign
+        self.timeout_s = float(timeout_s)
+        self.backoff_s = float(backoff_s)
+        self._down_until = 0.0
+        self._post({"total": int(total), "init-done": int(done)})
+
+    def _post(self, doc: Dict[str, Any]) -> None:
+        import urllib.request
+
+        if time.monotonic() < self._down_until:
+            return  # coordinator recently unreachable: skip, don't stall
+        body = dict(doc)
+        if self.campaign:
+            body["campaign"] = self.campaign
+        try:
+            req = urllib.request.Request(
+                self.url, data=json.dumps(_jsonable(body)).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+            self._down_until = 0.0
+        except Exception:  # noqa: BLE001 — see no-raise guarantee
+            self._down_until = time.monotonic() + self.backoff_s
+
+    def worker(self, worker_id: str,
+               state: Optional[Dict[str, Any]]) -> None:
+        self._post({"worker": str(worker_id), "state": state})
+
+    def record_done(self, run_id: str, valid: Any = None) -> None:
+        self._post({"done": {"run": run_id, "valid?": valid}})
+
+    def write(self, force: bool = False) -> None:
+        pass  # every update is already pushed
+
+    def close(self) -> None:
+        self._post({"finished": True})

@@ -56,7 +56,7 @@ __all__ = ["Warehouse", "warehouse_path", "open_if_exists", "for_ledger",
            "WAREHOUSE_FILE", "SCHEMA_VERSION"]
 
 WAREHOUSE_FILE = "warehouse.sqlite"
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS meta(
@@ -137,6 +137,23 @@ CREATE TABLE IF NOT EXISTS event_cursors(
     cursor INTEGER NOT NULL,        -- byte cursor into the LIVE file
     sig TEXT NOT NULL,              -- rotated-segment signature (JSON)
     head TEXT NOT NULL DEFAULT ''); -- live file's first line (session id)
+CREATE TABLE IF NOT EXISTS fleet_events(
+    id INTEGER PRIMARY KEY,
+    ledger TEXT NOT NULL,           -- store-relative fleet ledger path
+    ev TEXT, run TEXT, worker TEXT, reason TEXT, ts REAL,
+    deadline REAL);
+CREATE INDEX IF NOT EXISTS fe_ledger_ev ON fleet_events(ledger, ev, id);
+CREATE INDEX IF NOT EXISTS fe_worker ON fleet_events(ledger, worker, id);
+-- materialized per-worker rollup (the "which host's cells requeue
+-- most" query): recomputed per ingest batch from fleet_events
+CREATE TABLE IF NOT EXISTS fleet_worker_rollup(
+    ledger TEXT NOT NULL, worker TEXT NOT NULL,
+    claims INTEGER NOT NULL DEFAULT 0,
+    renews INTEGER NOT NULL DEFAULT 0,
+    completes INTEGER NOT NULL DEFAULT 0,
+    requeues INTEGER NOT NULL DEFAULT 0,
+    duplicates INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY(ledger, worker));
 CREATE TABLE IF NOT EXISTS bench(
     source TEXT PRIMARY KEY,
     ingested_at REAL,
@@ -149,7 +166,8 @@ CREATE TABLE IF NOT EXISTS bench(
 _DATA_TABLES = ("record_spans", "flip_rollup", "span_rollup",
                 "span_gen_rollup", "campaign_records", "ledgers",
                 "run_spans", "run_metrics", "witnesses", "runs",
-                "events", "event_cursors", "verifier_sessions", "bench")
+                "events", "event_cursors", "verifier_sessions",
+                "fleet_events", "fleet_worker_rollup", "bench")
 
 
 def warehouse_path(base: str) -> str:
@@ -229,16 +247,21 @@ class Warehouse:
     def __exit__(self, *exc: Any) -> None:
         self.close()
 
-    # -- ingest: campaign ledgers -------------------------------------------
+    # -- ingest: byte-cursor jsonl core (campaign + fleet ledgers) -----------
 
-    def ingest_ledger(self, path: str, base: str) -> int:
-        """Incrementally ingest one campaign jsonl ledger; returns the
-        number of new records.  Keyed by byte cursor: only lines
-        appended since the last ingest are parsed; a torn/unparsable
-        tail line is left unconsumed (the writer's heal truncates it,
-        after which cursor == size again).  A file shrunk below the
-        cursor was healed/rewritten: its records are wiped and
-        re-ingested from byte 0."""
+    def _ingest_jsonl(self, path: str, base: str, *,
+                      wipe: Any, insert: Any, flush: Any = None) -> int:
+        """THE byte-cursor jsonl ingest discipline, shared by every
+        ledger family so the subtle invariants can't drift between
+        copies: only lines appended since the last ingest are parsed;
+        a torn/unparsable tail line is left unconsumed (the writer's
+        heal truncates it, after which cursor == size again); a file
+        shrunk below the cursor was healed/rewritten — ``wipe(rel)``
+        drops its derived rows and ingest restarts from byte 0.  One
+        transaction per batch: ``insert(rel, rec)`` rows, the
+        ``flush(rel)`` rollup refresh, and the cursor land atomically,
+        so a crash mid-ingest rolls the whole unit back and the next
+        ingest simply redoes it.  Returns the number of new records."""
         rel = os.path.relpath(os.path.abspath(path), os.path.abspath(base))
         try:
             size = os.path.getsize(path)
@@ -251,17 +274,11 @@ class Warehouse:
             cursor = row[0] if row else 0
             if size < cursor:
                 with self.db:
-                    self._wipe_ledger(rel)
+                    wipe(rel)
                 cursor = 0
             if size == cursor:
                 return 0
             new = 0
-            # one transaction per ledger batch: records + flip/span
-            # rollups + the cursor land atomically, so a crash
-            # mid-ingest rolls the whole unit back and the next ingest
-            # simply redoes it
-            last_valid: Dict[str, Any] = {}  # key -> last verdict seen
-            touched_spans: set = set()
             with self.db, open(path, "rb") as f:
                 f.seek(cursor)
                 for line in f:
@@ -274,23 +291,40 @@ class Warehouse:
                         rec = json.loads(line)
                     except ValueError:
                         break  # crash debris: healed by the next writer
-                    if not isinstance(rec, dict):
-                        cursor += len(line)
-                        continue
-                    rid = self._insert_record(rel, rec)
-                    self._update_flips(rel, rid, rec, last_valid)
-                    spans = rec.get("spans")
-                    if isinstance(spans, dict):
-                        touched_spans.update(spans)
+                    if isinstance(rec, dict):
+                        insert(rel, rec)
+                        new += 1
                     cursor += len(line)
-                    new += 1
-                if touched_spans:
-                    self._refresh_span_rollups(rel, touched_spans)
+                if new and flush is not None:
+                    flush(rel)
                 self.db.execute(
                     "INSERT INTO ledgers(path, cursor) VALUES (?, ?) "
                     "ON CONFLICT(path) DO UPDATE SET cursor = ?",
                     (rel, cursor, cursor))
             return new
+
+    # -- ingest: campaign ledgers -------------------------------------------
+
+    def ingest_ledger(self, path: str, base: str) -> int:
+        """Incrementally ingest one campaign jsonl ledger; returns the
+        number of new records (cursor/torn/shrink semantics:
+        :meth:`_ingest_jsonl`)."""
+        last_valid: Dict[str, Any] = {}  # key -> last verdict seen
+        touched_spans: set = set()
+
+        def insert(rel: str, rec: Dict[str, Any]) -> None:
+            rid = self._insert_record(rel, rec)
+            self._update_flips(rel, rid, rec, last_valid)
+            spans = rec.get("spans")
+            if isinstance(spans, dict):
+                touched_spans.update(spans)
+
+        def flush(rel: str) -> None:
+            if touched_spans:
+                self._refresh_span_rollups(rel, touched_spans)
+
+        return self._ingest_jsonl(path, base, wipe=self._wipe_ledger,
+                                  insert=insert, flush=flush)
 
     def _update_flips(self, ledger: str, rid: int, rec: Dict[str, Any],
                       last_valid: Dict[str, Any]) -> None:
@@ -735,6 +769,60 @@ class Warehouse:
             out.append(d)
         return out
 
+    # -- ingest: fleet ledgers (ISSUE 9) -------------------------------------
+
+    def ingest_fleet_ledger(self, path: str, base: str) -> int:
+        """Incrementally ingest one fleet work-queue ledger
+        (``<store>/fleet/<name>.jsonl``, docs/FLEET.md) into
+        ``fleet_events`` + the per-worker rollup; returns new events.
+        Shares :meth:`_ingest_jsonl`'s cursor/torn/shrink discipline
+        (the ``ledgers`` table keys on the store-relative path, which
+        is disjoint from campaign ledgers' ``campaigns/...``)."""
+        def insert(rel: str, ev: Dict[str, Any]) -> None:
+            self.db.execute(
+                "INSERT INTO fleet_events(ledger, ev, run, worker, "
+                "reason, ts, deadline) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (rel, ev.get("ev"), ev.get("run"), ev.get("worker"),
+                 ev.get("reason"), ev.get("ts"), ev.get("deadline")))
+
+        return self._ingest_jsonl(path, base,
+                                  wipe=self._wipe_fleet_ledger,
+                                  insert=insert,
+                                  flush=self._refresh_fleet_rollup)
+
+    def _refresh_fleet_rollup(self, rel: str) -> None:
+        self.db.execute(
+            "DELETE FROM fleet_worker_rollup WHERE ledger = ?", (rel,))
+        self.db.execute(
+            "INSERT INTO fleet_worker_rollup(ledger, worker, claims, "
+            "renews, completes, requeues, duplicates) "
+            "SELECT ledger, worker, "
+            "SUM(ev = 'claim'), SUM(ev = 'renew'), "
+            "SUM(ev = 'complete'), SUM(ev = 'requeue'), "
+            "SUM(ev = 'duplicate') "
+            "FROM fleet_events WHERE ledger = ? AND worker IS NOT NULL "
+            "GROUP BY worker", (rel,))
+
+    def _wipe_fleet_ledger(self, rel: str) -> None:
+        self.db.execute("DELETE FROM fleet_events WHERE ledger = ?",
+                        (rel,))
+        self.db.execute(
+            "DELETE FROM fleet_worker_rollup WHERE ledger = ?", (rel,))
+        self.db.execute("DELETE FROM ledgers WHERE path = ?", (rel,))
+
+    def fleet_worker_rollup(self, ledger_rel: str
+                            ) -> List[Dict[str, Any]]:
+        """Per-worker control-plane tallies for one fleet ledger,
+        requeue-heaviest first — "which host's cells requeue most"."""
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT worker, claims, renews, completes, requeues, "
+                "duplicates FROM fleet_worker_rollup WHERE ledger = ? "
+                "ORDER BY requeues DESC, worker", (ledger_rel,)).fetchall()
+        cols = ("worker", "claims", "renews", "completes", "requeues",
+                "duplicates")
+        return [dict(zip(cols, r)) for r in rows]
+
     # -- ingest: bench -------------------------------------------------------
 
     def ingest_bench(self, payload: Dict[str, Any], source: str) -> None:
@@ -797,7 +885,7 @@ class Warehouse:
         from jepsen_tpu import store as store_mod
 
         stats = {"ledgers": 0, "records": 0, "runs": 0, "events": 0,
-                 "sessions": 0}
+                 "sessions": 0, "fleet-events": 0}
         cdir = os.path.join(base, "campaigns")
         if os.path.isdir(cdir):
             for fn in sorted(os.listdir(cdir)):
@@ -805,6 +893,12 @@ class Warehouse:
                     n = self.ingest_ledger(os.path.join(cdir, fn), base)
                     stats["ledgers"] += 1
                     stats["records"] += n
+        fdir = os.path.join(base, "fleet")
+        if os.path.isdir(fdir):
+            for fn in sorted(os.listdir(fdir)):
+                if fn.endswith(".jsonl"):
+                    stats["fleet-events"] += self.ingest_fleet_ledger(
+                        os.path.join(fdir, fn), base)
         for d in store_mod.tests(base=base):
             if self.ingest_run_dir(d, base):
                 stats["runs"] += 1

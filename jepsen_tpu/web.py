@@ -108,6 +108,7 @@ def _verdict_cell(v: Any, error: Any = None, degraded: Any = None,
 class _Handler(BaseHTTPRequestHandler):
     base: str = store.BASE  # overridden per-server
     verifier = None         # VerifierService when served with --ingest
+    fleet = None            # FleetCoordinator when served via fleet serve
 
     # -- helpers ----------------------------------------------------------
 
@@ -182,6 +183,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._verifier_list()
             if path.startswith("/verifier/"):
                 return self._verifier_session(path[len("/verifier/"):])
+            if path in ("/fleet", "/fleet/"):
+                return self._fleet_page()
+            if path == "/fleet/status":
+                return self._fleet_status()
             self._send(404, b"not found", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
             pass
@@ -190,12 +195,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(500, f"error: {e}".encode(), "text/plain")
 
     def do_POST(self):  # noqa: N802 (stdlib API)
-        """The verifier ingest surface (docs/VERIFIER.md) — only
-        routed when the server was started with a service attached
-        (``cli serve --ingest``)."""
+        """The verifier ingest surface (docs/VERIFIER.md) and the
+        fleet control plane (docs/FLEET.md) — each only routed when
+        the server was started with that service attached (``cli
+        serve --ingest`` / ``cli fleet serve``)."""
         try:
             parsed = urlparse(self.path)
             path = unquote(parsed.path)
+            if path.startswith("/fleet/"):
+                return self._fleet_post(path[len("/fleet/"):].strip("/"))
             if self.verifier is None:
                 return self._send_json(
                     404, {"error": "no verifier service (start with "
@@ -270,6 +278,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.verifier is not None or \
                 os.path.isdir(os.path.join(self.base, "verifier")):
             links.append('<a href="/verifier">verifier</a>')
+        if self.fleet is not None:
+            links.append('<a href="/fleet">fleet</a>')
         links.append('<a href="/metrics">metrics</a>')
         camp = "<p>" + " &middot; ".join(links) + "</p>"
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
@@ -1069,6 +1079,101 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 </body></html>"""
         self._send(200, doc.encode())
 
+    # -- fleet control plane (docs/FLEET.md) ------------------------------
+
+    def _fleet_post(self, verb: str):
+        """``POST /fleet/<verb>`` — the coordinator's control plane:
+        register / claim / heartbeat / complete / release.  JSON in,
+        JSON out; only routed when a `FleetCoordinator` is attached
+        (``cli fleet serve``)."""
+        if self.fleet is None:
+            return self._send_json(
+                404, {"error": "no fleet coordinator (start with "
+                      "`fleet serve <spec.json>`)"})
+        handlers = {
+            "register": self.fleet.register,
+            "claim": self.fleet.claim,
+            "heartbeat": self.fleet.heartbeat,
+            "complete": self.fleet.complete,
+            "release": self.fleet.release,
+        }
+        fn = handlers.get(verb)
+        if fn is None:
+            return self._send_json(404,
+                                   {"error": f"unknown verb {verb!r}"})
+        body = self._read_body()
+        doc: Dict[str, Any] = {}
+        if body.strip():
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                return self._send_json(400, {"error": "bad json body"})
+            if not isinstance(doc, dict):
+                return self._send_json(400,
+                                       {"error": "body must be a dict"})
+        code, out = fn(doc)
+        self._send_json(code, out)
+
+    def _fleet_status(self):
+        if self.fleet is None:
+            return self._send_json(
+                404, {"error": "no fleet coordinator (start with "
+                      "`fleet serve <spec.json>`)"})
+        code, doc = self.fleet.status()
+        self._send_json(code, doc)
+
+    def _fleet_page(self):
+        """Fleet dashboard: queue counts, active leases, and worker
+        liveness — the control-plane view next to the campaign's
+        /campaign/<n>/live run view."""
+        if self.fleet is None:
+            return self._send(404, b"no fleet coordinator (start with "
+                              b"`fleet serve <spec.json>`)",
+                              "text/plain")
+        code, s = self.fleet.status()
+        if code != 200:
+            return self._send_json(code, s)
+        c = s.get("counts") or {}
+        wrows = "".join(
+            f"<tr><td>{html.escape(w)}</td>"
+            f"<td>{html.escape(str(d.get('host')))}</td>"
+            f"<td>{d.get('device-slots')}</td>"
+            f"<td>{d.get('age-s')}s</td>"
+            f"<td>{'alive' if d.get('alive') else 'silent'}</td></tr>"
+            for w, d in sorted((s.get("workers") or {}).items()))
+        lrows = "".join(
+            f"<tr><td><code>{html.escape(str(l['run']))}</code></td>"
+            f"<td>{html.escape(str(l['worker']))}</td>"
+            f"<td>{l['deadline']}</td></tr>"
+            for l in s.get("leases") or [])
+        name = str(s.get("campaign"))
+        state = "finished" if s.get("finished") else "running"
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>fleet — {html.escape(name)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/">&larr; runs</a> &middot;
+<a href="/campaign/{quote(name)}">campaign</a> &middot;
+<a href="/campaign/{quote(name)}/live">live</a> &middot;
+<a href="/fleet/status">status.json</a></p>
+<h1>fleet — {html.escape(name)}</h1>
+<p>{state}: {s.get("done")}/{s.get("total")} cells done &middot;
+{c.get("queued")} queued, {c.get("claimed")} claimed &middot;
+{c.get("requeues")} requeues, {c.get("duplicates")} duplicate
+completions discarded &middot; queue digest
+<code>{html.escape(str(s.get("digest")))}</code></p>
+<h2>workers</h2>
+<table><tr><th>worker</th><th>host</th><th>device slots</th>
+<th>last seen</th><th></th></tr>{wrows or
+'<tr><td colspan="5">(none registered)</td></tr>'}</table>
+<h2>active leases</h2>
+<table><tr><th>run</th><th>worker</th><th>deadline</th></tr>{lrows or
+'<tr><td colspan="3">(none)</td></tr>'}</table>
+</body></html>"""
+        self._send(200, doc.encode())
+
     def _files(self, rel: str):
         p = self._safe_path(rel.rstrip("/"))
         if p is None or not os.path.exists(p):
@@ -1115,18 +1220,24 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 def serve(port: int = 8080, base: Optional[str] = None, *,
           host: str = "127.0.0.1",
           background: bool = False,
-          verifier: Any = None) -> ThreadingHTTPServer:
+          verifier: Any = None,
+          fleet: Any = None) -> ThreadingHTTPServer:
     """Serve the store dir (reference `web/serve!`).  Binds localhost by
     default — stored test maps can hold cluster details; pass
     host="0.0.0.0" explicitly to expose.  With background=True, runs in a
     daemon thread and returns the server (tests use this).  Pass a
     `verifier.VerifierService` to route the ingest endpoints
-    (`cli serve --ingest`; docs/VERIFIER.md)."""
+    (`cli serve --ingest`; docs/VERIFIER.md), and/or a
+    `fleet.FleetCoordinator` to route the fleet control plane
+    (`cli fleet serve`; docs/FLEET.md)."""
     handler = type("Handler", (_Handler,), {"base": base or store.BASE,
-                                            "verifier": verifier})
+                                            "verifier": verifier,
+                                            "fleet": fleet})
     srv = ThreadingHTTPServer((host, port), handler)
-    logger.info("serving store %s on port %d%s", base or store.BASE, port,
-                " (verifier ingest on)" if verifier is not None else "")
+    logger.info("serving store %s on port %d%s%s", base or store.BASE,
+                port,
+                " (verifier ingest on)" if verifier is not None else "",
+                " (fleet control plane on)" if fleet is not None else "")
     if background:
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()

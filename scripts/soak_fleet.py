@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Fleet soak: N workers x M-cell campaign under control-plane chaos.
+
+ISSUE 9 acceptance driver.  Starts a real coordinator subprocess
+(``fleet serve``) and N real worker subprocesses (``fleet work``) over
+HTTP, then turns the framework's own nemeses on its control plane:
+
+- seeded ``JEPSEN_FAULTS`` plans drop (synthetic transients) and stall
+  the ``fleet.claim`` / ``fleet.heartbeat`` / ``fleet.complete`` seams
+  on BOTH sides (server 503s + client-side injection before send);
+- one worker is ``kill -9``'d while it holds a lease — the lease
+  lapses and its cell requeues and completes elsewhere;
+- the coordinator is ``kill -9``'d mid-campaign and restarted — the
+  ledger replays to the identical queue state (digest compared against
+  an independent in-process replay of the pre-restart ledger) while
+  the surviving workers ride out the ECONNREFUSED window on retries;
+- (full mode) a worker is SIGSTOP'd past its lease and SIGCONT'd — the
+  zombie's eventual completion must be discarded as a duplicate.
+
+The run FAILS unless every cell lands **exactly one** attributable
+verdict record (zero lost, zero duplicated) and the distributed result
+set equals a single-process ``run_campaign`` of the same spec on
+verdict keys.
+
+Usage::
+
+    python scripts/soak_fleet.py --fast      # tier-1 smoke (the
+                                             # acceptance config:
+                                             # 12 cells x 3 workers)
+    python scripts/soak_fleet.py             # default soak
+    python scripts/soak_fleet.py --workers 5 --cells 30 --fault-p 0.2
+
+Exit 0 iff the acceptance holds.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def get_status(url, timeout=2.0):
+    with urllib.request.urlopen(url + "/fleet/status",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def wait_status(url, pred, deadline_s, what):
+    """Poll /fleet/status until pred(status) (chaos 503s and restart
+    windows are ridden out); returns the matching status."""
+    t_end = time.time() + deadline_s
+    last = None
+    while time.time() < t_end:
+        try:
+            last = get_status(url)
+            if pred(last):
+                return last
+        except Exception:
+            pass
+        time.sleep(0.1)
+    raise TimeoutError(f"timed out waiting for {what}; last status: "
+                       f"{json.dumps(last, indent=1, default=str)}")
+
+
+def spawn_coordinator(base, spec_path, port, lease, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu", "--store-dir", base,
+         "fleet", "serve", spec_path, "--port", str(port),
+         "--lease", str(lease)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def spawn_worker(base, url, name, seed, fault_p, env):
+    wenv = dict(env)
+    # client-side chaos: drops (transients the retry policy clears) and
+    # stalls on the control-plane seams only — the workload itself
+    # stays clean so the distributed verdicts equal the single-process
+    # reference run
+    wenv["JEPSEN_FAULTS"] = (
+        f"seed={seed},p={fault_p},kinds=oom|stall,stall_s=0.02,"
+        "sites=fleet.claim|fleet.heartbeat|fleet.complete")
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu", "--store-dir", base,
+         "fleet", "work", "--coordinator", url, "--name", name,
+         "--poll", "0.1"],
+        env=wenv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--cells", type=int, default=24)
+    ap.add_argument("--fault-p", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--lease", type=float, default=2.0)
+    ap.add_argument("--time-limit", type=float, default=0.4,
+                    help="seconds of workload per cell")
+    ap.add_argument("--store", default=None,
+                    help="store dir (default: a temp dir)")
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 smoke: the 12-cell x 3-worker "
+                         "acceptance config, no SIGSTOP round")
+    args = ap.parse_args()
+    if args.fast:
+        args.workers, args.cells = 3, 12
+        args.fault_p = max(args.fault_p, 0.15)
+    base = args.store or tempfile.mkdtemp(prefix="fleet-soak-")
+    spec = {"name": "fleetsoak", "workloads": ["set"],
+            "seeds": list(range(args.cells)),
+            "opts": {"time-limit": args.time_limit}}
+    spec_path = os.path.join(base, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    port = free_port()
+    url = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # server-side chaos too: the coordinator's own endpoints 503/stall
+    env["JEPSEN_FAULTS"] = (
+        f"seed={args.seed + 999},p={args.fault_p / 2},"
+        "kinds=oom|stall,stall_s=0.02,"
+        "sites=fleet.claim|fleet.heartbeat|fleet.complete")
+    t0 = time.time()
+    failures = []
+    coord = spawn_coordinator(base, spec_path, port, args.lease, env)
+    workers = {}
+    try:
+        wait_status(url, lambda s: True, 60, "coordinator up")
+        for i in range(args.workers):
+            workers[f"w{i}"] = spawn_worker(
+                base, url, f"w{i}", args.seed * 1000 + i, args.fault_p,
+                env)
+
+        # -- nemesis 1: kill -9 a worker while it holds a lease -------
+        def holding(s, names):
+            alive = [w for w in names if workers[w].poll() is None]
+            for lease in s.get("leases") or []:
+                if lease["worker"] in alive:
+                    return lease["worker"]
+            return None
+
+        requeued = False
+        for attempt in range(2):
+            names = list(workers)
+            s = wait_status(url, lambda s: holding(s, names), 60,
+                            "a worker holding a lease")
+            victim = holding(s, names)
+            workers[victim].send_signal(signal.SIGKILL)
+            workers[victim].wait()
+            print(f"killed -9 worker {victim} mid-lease")
+            # replacement keeps the fleet >= workers-1 strong
+            sub = f"{victim}r{attempt}"
+            workers[sub] = spawn_worker(
+                base, url, sub, args.seed * 1000 + 50 + attempt,
+                args.fault_p, env)
+            try:
+                wait_status(
+                    url, lambda s: (s["counts"]["requeues"] > 0
+                                    or s["finished"]),
+                    3 * args.lease + 30, "lease expiry requeue")
+            except TimeoutError:
+                continue
+            requeued = True
+            break
+        if not requeued:
+            failures.append("no lease-expiry requeue observed after "
+                            "2 worker kills")
+
+        # -- nemesis 2 (full mode): SIGSTOP a worker past its lease ---
+        zombie = None
+        if not args.fast:
+            names = list(workers)
+            s = wait_status(url, lambda s: holding(s, names), 60,
+                            "a worker to freeze")
+            zombie = holding(s, names)
+            workers[zombie].send_signal(signal.SIGSTOP)
+            print(f"SIGSTOP worker {zombie} (partition one worker)")
+            time.sleep(2.5 * args.lease)
+            workers[zombie].send_signal(signal.SIGCONT)
+            print(f"SIGCONT worker {zombie} — its completion is now "
+                  "a zombie's")
+
+        # -- nemesis 3: kill -9 the coordinator + restart -------------
+        wait_status(url, lambda s: s["done"] >= max(2, args.cells // 6),
+                    120, "some cells done before coordinator kill")
+        coord.send_signal(signal.SIGKILL)
+        coord.wait()
+        print("killed -9 coordinator mid-campaign")
+        # independent replay of the dead coordinator's ledger: the
+        # restarted process must reach this exact state
+        from jepsen_tpu.fleet import WorkQueue, fleet_path
+
+        frozen = os.path.join(base, "ledger-at-kill.jsonl")
+        shutil.copy(fleet_path("fleetsoak", base), frozen)
+        expect_digest = WorkQueue(frozen).digest()
+        time.sleep(0.5)
+        coord = spawn_coordinator(base, spec_path, port, args.lease,
+                                  env)
+        s = wait_status(url, lambda s: True, 60,
+                        "coordinator restart")
+        if s["boot-digest"] != expect_digest:
+            failures.append(
+                f"replay digest mismatch after coordinator kill -9: "
+                f"boot {s['boot-digest']} != replayed {expect_digest}")
+        else:
+            print(f"coordinator replayed to identical state "
+                  f"(digest {expect_digest})")
+
+        # -- drain ----------------------------------------------------
+        final = wait_status(url, lambda s: s["finished"], 300,
+                            "campaign finished")
+        print(f"campaign finished: {final['done']}/{final['total']} "
+              f"cells, {final['counts']['requeues']} requeues, "
+              f"{final['counts']['duplicates']} duplicate completions "
+              "discarded")
+        for w, p in workers.items():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.terminate()
+    finally:
+        for p in list(workers.values()) + [coord]:
+            if p.poll() is None:
+                p.kill()
+
+    # -- acceptance: exactly one attributable verdict per cell --------
+    from jepsen_tpu import campaign
+    from jepsen_tpu.campaign import core as ccore
+    from jepsen_tpu.campaign.index import Index
+    from jepsen_tpu.campaign.plan import expand
+
+    idx = Index(ccore.index_path("fleetsoak", base))
+    per_run = {}
+    for rec in idx.records:
+        if "valid?" in rec:
+            per_run[rec["run"]] = per_run.get(rec["run"], 0) + 1
+    spec_ids = {rs.run_id for rs in expand(spec)}
+    missing = spec_ids - set(per_run)
+    extra = {r: n for r, n in per_run.items() if n != 1}
+    if missing:
+        failures.append(f"{len(missing)} cell(s) LOST: "
+                        f"{sorted(missing)[:3]}...")
+    if extra:
+        failures.append(f"cells with != 1 record (duplicated): {extra}")
+    unattributed = [r for rec in idx.records
+                    if (r := rec.get("run")) and rec.get("valid?")
+                    not in (True, False, "unknown")]
+    if unattributed:
+        failures.append(f"unattributable verdicts: {unattributed}")
+
+    # -- acceptance: distributed == single-process on verdict keys ----
+    ref_base = tempfile.mkdtemp(prefix="fleet-soak-ref-")
+    ref = campaign.run_campaign(spec, ref_base, workers=2)
+    ref_verdicts = {r["key"]: r["valid?"] for r in ref["rows"]}
+    got_verdicts = {rec["key"]: rec["valid?"]
+                    for rec in idx.latest_by_run().values()}
+    if ref_verdicts != got_verdicts:
+        diff = {k: (got_verdicts.get(k), ref_verdicts.get(k))
+                for k in set(ref_verdicts) | set(got_verdicts)
+                if got_verdicts.get(k) != ref_verdicts.get(k)}
+        failures.append(f"distributed != single-process verdicts: "
+                        f"{diff}")
+
+    wall = time.time() - t0
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        print(f"fleet soak FAILED in {wall:.1f}s (store: {base})",
+              file=sys.stderr)
+        return 1
+    print(f"fleet soak OK: {args.cells} cells x {args.workers} workers "
+          f"under chaos (worker kill -9, coordinator kill -9 + "
+          f"restart{', zombie freeze' if zombie else ''}) — exactly "
+          f"one verdict per cell, distributed == single-process, "
+          f"in {wall:.1f}s")
+    if args.store is None:
+        shutil.rmtree(base, ignore_errors=True)
+        shutil.rmtree(ref_base, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

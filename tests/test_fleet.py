@@ -1,0 +1,560 @@
+"""fleet/ — the fault-tolerant multi-host control plane (ISSUE 9).
+
+Covers the tentpole contracts:
+
+- the **leased work queue**: enqueue/claim/renew/complete/requeue over
+  an fsync'd jsonl ledger; lease expiry requeues; at-most-once verdict
+  records (zombie double-completions discarded, idempotent resends
+  acked); a replayed ledger reaches the identical state digest; torn
+  trailing lines tolerated and healed writer-side only;
+- the **HTTP control plane** end to end: real coordinator + real
+  workers over a real socket, every cell exactly one attributable
+  record, the distributed index equal to a single-process
+  `run_campaign` on verdict keys, finished fleets resuming with 0
+  cells executed;
+- the **shared heartbeat writer**: the scheduler's file path and the
+  coordinator's HTTP-push path render the same ``/campaign/<n>/live``
+  shape, and `run_campaign` with a coordinator URL pushes instead of
+  writing locally;
+- the **chaos acceptance** (`scripts/soak_fleet.py --fast`): 12 cells
+  x 3 worker subprocesses under seeded control-plane drops/stalls, a
+  worker kill -9, and a coordinator kill -9 + digest-pinned restart.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import store, web
+from jepsen_tpu.campaign import core as ccore
+from jepsen_tpu.campaign.index import Index
+from jepsen_tpu.campaign.plan import expand
+from jepsen_tpu.fleet import (
+    FleetCoordinator,
+    FleetWorker,
+    WorkQueue,
+    fleet_path,
+    record_digest,
+)
+
+SPEC = {"name": "fl", "workloads": ["set"], "seeds": [0, 1, 2, 3, 4, 5],
+        "opts": {"time-limit": 0.15}}
+
+
+def _post(url, path, doc, timeout=10):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _get(url, path, timeout=10):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.read().decode()
+
+
+# ---------------------------------------------------------------- queue
+
+def _spec(run, device=False):
+    return {"run_id": run, "campaign": "q", "workload": "set",
+            "seed": 0, "opts": {}, "fault": None,
+            "fault_label": "nofault", "workload_label": "set",
+            "device": device}
+
+
+def test_queue_lifecycle(tmp_path):
+    q = WorkQueue(str(tmp_path / "q.jsonl"))
+    assert q.enqueue(_spec("r1"))
+    assert q.enqueue(_spec("r2"))
+    assert not q.enqueue(_spec("r1"))  # idempotent on run id
+    spec, deadline = q.claim("w1", lease_s=5.0, now=100.0)
+    assert spec["run_id"] == "r1" and deadline == 105.0  # FIFO
+    # only the holder renews
+    assert q.renew("r1", "w1", 5.0, now=102.0)
+    assert not q.renew("r1", "w2", 5.0, now=102.0)
+    assert not q.renew("r2", "w1", 5.0)  # unclaimed
+    # a fresh lease survives expiry sweeps until its deadline
+    assert q.expire(now=106.0) == []
+    assert q.expire(now=108.0) == ["r1"]
+    assert q.cells["r1"]["state"] == "queued"
+    # release = voluntary requeue (the SIGTERM drain)
+    q.claim("w2", lease_s=5.0, now=110.0)
+    assert q.release("r1", "w2")
+    assert not q.release("r1", "w2")  # no longer held
+    assert q.counts()["requeues"] == 2
+
+
+def test_queue_device_capability_filter(tmp_path):
+    q = WorkQueue(str(tmp_path / "q.jsonl"))
+    q.enqueue(_spec("dev", device=True))
+    q.enqueue(_spec("host"))
+    spec, _ = q.claim("w0", lease_s=5.0, device_ok=False)
+    assert spec["run_id"] == "host"  # device cell skipped
+    spec, _ = q.claim("w1", lease_s=5.0, device_ok=True)
+    assert spec["run_id"] == "dev"
+
+
+def test_queue_at_most_once_completion(tmp_path):
+    q = WorkQueue(str(tmp_path / "q.jsonl"))
+    q.enqueue(_spec("r1"))
+    q.claim("w1", lease_s=0.1, now=0.0)
+    q.expire(now=1.0)  # w1's lease lapses
+    q.claim("w2", lease_s=5.0, now=1.0)
+    rec2 = {"run": "r1", "valid?": True, "wall_s": 0.2}
+    assert q.complete("r1", "w2", rec2) == "accepted"
+    # w2 resending the identical record (lost ack) is idempotent
+    assert q.complete("r1", "w2", dict(rec2)) == "already"
+    # the zombie's different record is discarded + counted
+    assert q.complete("r1", "w1",
+                      {"run": "r1", "valid?": True,
+                       "wall_s": 0.9}) == "duplicate"
+    assert q.complete("nope", "w1", rec2) == "unknown"
+    c = q.counts()
+    assert c["done"] == 1 and c["duplicates"] == 1
+    assert q.cells["r1"]["record"]["wall_s"] == 0.2  # first wins
+    assert record_digest(rec2) != record_digest({"run": "r1",
+                                                 "valid?": True,
+                                                 "wall_s": 0.9})
+
+
+def test_queue_replay_reaches_identical_state(tmp_path):
+    path = str(tmp_path / "q.jsonl")
+    q = WorkQueue(path)
+    for i in range(5):
+        q.enqueue(_spec(f"r{i}"))
+    q.claim("w1", lease_s=0.1, now=0.0)
+    q.claim("w2", lease_s=9.0, now=0.0)
+    q.expire(now=5.0)  # w1 requeued, w2 still holds
+    q.complete("r1", "w2", {"valid?": False})
+    q.complete("r1", "w9", {"valid?": True})  # duplicate
+    q.claim("w3", lease_s=9.0, now=6.0)
+    replayed = WorkQueue(path)
+    assert replayed.digest() == q.digest()
+    assert replayed.counts() == q.counts()
+    # replay preserves claim order too: next claim picks the same cell
+    a = q.claim("wx", lease_s=1.0, now=7.0)[0]["run_id"]
+    b = replayed.claim("wx", lease_s=1.0, now=7.0)[0]["run_id"]
+    assert a == b
+
+
+def test_queue_torn_tail_tolerated_and_healed(tmp_path):
+    path = str(tmp_path / "q.jsonl")
+    q = WorkQueue(path)
+    q.enqueue(_spec("r1"))
+    q.claim("w1", lease_s=5.0)
+    digest = q.digest()
+    with open(path, "a") as f:
+        f.write('{"ev": "complete", "run": "r1", "wor')  # kill -9 debris
+    size_with_debris = os.path.getsize(path)
+    # read-only replay drops the torn line, does NOT truncate the file
+    seen = WorkQueue(path)
+    assert seen.digest() == digest
+    assert os.path.getsize(path) == size_with_debris
+    # the next WRITER heals before appending: no fused line, state sane
+    seen.complete("r1", "w1", {"valid?": True})
+    again = WorkQueue(path)
+    assert again.cells["r1"]["state"] == "done"
+    assert again.digest() == seen.digest()
+
+
+# ------------------------------------------------- transient classifier
+
+def test_is_transient_http():
+    import urllib.error
+
+    from jepsen_tpu.resilience import DeadlineExceeded, is_transient_http
+    from jepsen_tpu.resilience.faults import FaultInjected
+
+    assert is_transient_http(ConnectionRefusedError(111, "refused"))
+    assert is_transient_http(TimeoutError())
+    assert is_transient_http(
+        urllib.error.URLError(OSError("unreachable")))
+    e503 = urllib.error.HTTPError("u", 503, "busy", {}, None)
+    e404 = urllib.error.HTTPError("u", 404, "nope", {}, None)
+    assert is_transient_http(e503)
+    assert not is_transient_http(e404)
+    assert is_transient_http(FaultInjected("oom", "fleet.claim", 0))
+    assert not is_transient_http(DeadlineExceeded("x"))
+    assert not is_transient_http(ValueError("bug"))
+
+
+# --------------------------------------------- HTTP end to end (real IO)
+
+@pytest.fixture(scope="module")
+def fleet_run(tmp_path_factory):
+    """One 6-cell campaign run by 2 in-process FleetWorkers against a
+    real coordinator over a real socket."""
+    base = str(tmp_path_factory.mktemp("fleet"))
+    coord = FleetCoordinator(SPEC, base, lease_s=5.0)
+    srv = web.serve(port=0, base=base, background=True, fleet=coord)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    ws = [FleetWorker(url, base, name=f"w{i}", poll_s=0.05)
+          for i in range(2)]
+    ts = [threading.Thread(target=w.run, daemon=True) for w in ws]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in ts), "workers wedged"
+    yield base, url, coord, ws
+    srv.server_close()
+    coord.close()
+
+
+def test_fleet_every_cell_exactly_one_record(fleet_run):
+    base, url, coord, ws = fleet_run
+    idx = Index(ccore.index_path("fl", base))
+    per_run = {}
+    for rec in idx.records:
+        assert rec["valid?"] in (True, False, "unknown")
+        per_run[rec["run"]] = per_run.get(rec["run"], 0) + 1
+    assert per_run == {rs.run_id: 1 for rs in expand(SPEC)}
+    assert sum(w.cells_done for w in ws) == 6
+    # every record names its executor
+    assert all(rec.get("fleet-worker") in ("w0", "w1")
+               for rec in idx.records)
+
+
+def test_fleet_matches_single_process_campaign(fleet_run, tmp_path):
+    base, *_ = fleet_run
+    from jepsen_tpu import campaign
+
+    ref = campaign.run_campaign(SPEC, str(tmp_path), workers=2)
+    ref_verdicts = {r["key"]: r["valid?"] for r in ref["rows"]}
+    idx = Index(ccore.index_path("fl", base))
+    got = {rec["key"]: rec["valid?"]
+           for rec in idx.latest_by_run().values()}
+    assert got == ref_verdicts
+
+
+def test_fleet_status_and_page(fleet_run):
+    base, url, coord, _ws = fleet_run
+    s = json.loads(_get(url, "/fleet/status"))
+    assert s["finished"] is True and s["done"] == 6
+    assert s["counts"]["done"] == 6 and s["counts"]["queued"] == 0
+    assert s["digest"] and s["boot-digest"]
+    assert set(s["workers"]) == {"w0", "w1"}
+    page = _get(url, "/fleet")
+    assert "fleet — fl" in page and "w0" in page
+    # the index page links the fleet dashboard
+    assert 'href="/fleet"' in _get(url, "/")
+
+
+def test_fleet_metrics_gauges(fleet_run):
+    base, url, *_ = fleet_run
+    body = _get(url, "/metrics")
+    assert "jepsen_fleet_workers_alive" in body
+    assert 'jepsen_fleet_cells{state="done"} 6' in body
+    assert "jepsen_fleet_leases_active 0" in body
+
+
+def test_fleet_live_page_renders_coordinator_heartbeat(fleet_run):
+    """Satellite: the coordinator's Heartbeat writer produces the same
+    live.json shape the single-process scheduler writes — the
+    /campaign/<n>/live dashboard renders it unchanged."""
+    base, url, *_ = fleet_run
+    doc = json.load(open(ccore.live_path("fl", base)))
+    assert doc["finished"] is True and doc["done"] == 6
+    page = _get(url, "/campaign/fl/live")
+    assert "finished" in page and "6/6 runs done" in page
+
+
+def test_fleet_finished_campaign_resumes_zero(fleet_run):
+    base, url, *_ = fleet_run
+    # a fresh coordinator over the finished store replays to done
+    c2 = FleetCoordinator(SPEC, base, lease_s=5.0)
+    assert c2.finished
+    assert c2.queue.counts()["queued"] == 0
+    code, r = c2.claim({"worker": "late"})
+    assert code == 200 and r["spec"] is None and r["finished"]
+    # and single-process resume parity: run_campaign executes 0 cells
+    from jepsen_tpu import campaign
+
+    summary = campaign.run_campaign(SPEC, base, workers=2)
+    assert summary["executed"] == 0 and summary["skipped"] == 6
+
+
+def test_fleet_lease_expiry_requeue_and_zombie_discard(tmp_path):
+    """Worker death mid-run, end to end: a ghost claims a cell and
+    stops renewing; the lease lapses, the cell requeues and completes
+    on a live worker; the ghost's eventual completion is discarded as
+    a duplicate (at-most-once verdicts)."""
+    base = str(tmp_path)
+    spec = dict(SPEC, name="fl-ghost", seeds=[0, 1, 2])
+    coord = FleetCoordinator(spec, base, lease_s=0.6)
+    srv = web.serve(port=0, base=base, background=True, fleet=coord)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        ghost = _post(url, "/fleet/claim", {"worker": "ghost"})
+        run = ghost["spec"]["run_id"]
+        time.sleep(0.7)  # the ghost never renews: lease lapses
+        w = FleetWorker(url, base, name="alive", poll_s=0.05)
+        t = threading.Thread(target=w.run, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        s = json.loads(_get(url, "/fleet/status"))
+        assert s["finished"] and s["counts"]["requeues"] >= 1
+        idx = Index(ccore.index_path("fl-ghost", base))
+        assert {r.run_id for r in expand(spec)} == \
+            {rec["run"] for rec in idx.records}
+        # the zombie wakes up and uploads its stale verdict: discarded
+        r = _post(url, "/fleet/complete",
+                  {"worker": "ghost", "run": run,
+                   "record": {"run": run, "valid?": True,
+                              "wall_s": 99.0}})
+        assert r == {"ok": False, "duplicate": True}
+        assert json.loads(_get(url, "/fleet/status"))[
+            "counts"]["duplicates"] == 1
+        assert len([rec for rec in Index(
+            ccore.index_path("fl-ghost", base)).records
+            if rec["run"] == run]) == 1  # still exactly one record
+    finally:
+        srv.server_close()
+        coord.close()
+
+
+def test_coordinator_reconciles_index_from_ledger(tmp_path):
+    """Crash between the queue's complete event (the commit point) and
+    the index append: boot re-derives the missing index record from
+    the ledger's own copy — no cell lost, none doubled."""
+    base = str(tmp_path)
+    spec = dict(SPEC, name="fl-rec", seeds=[0, 1])
+    ids = [rs.run_id for rs in expand(spec)]
+    q = WorkQueue(fleet_path("fl-rec", base))
+    for rs in expand(spec):
+        q.enqueue(rs.to_dict())
+    q.claim("w1", lease_s=9.0)
+    rec = {"run": ids[0], "key": "set|nofault|s0", "valid?": True,
+           "wall_s": 0.1}
+    assert q.complete(ids[0], "w1", rec) == "accepted"
+    # ...and the process dies HERE, before the index append
+    pre = WorkQueue(fleet_path("fl-rec", base)).digest()
+    coord = FleetCoordinator(spec, base, lease_s=5.0)
+    assert coord.boot_digest == pre  # replay is digest-pinned
+    idx = Index(ccore.index_path("fl-rec", base))
+    recs = [r for r in idx.records if r["run"] == ids[0]]
+    assert len(recs) == 1
+    assert recs[0]["valid?"] is True
+    assert recs[0]["fleet-worker"] == "w1"
+    # a second boot does not double the reconciled record
+    FleetCoordinator(spec, base, lease_s=5.0)
+    assert len([r for r in Index(ccore.index_path("fl-rec", base))
+                .records if r["run"] == ids[0]]) == 1
+
+
+# --------------------------------------- heartbeat sharing (satellite)
+
+def test_scheduler_and_fleet_heartbeats_share_one_shape(tmp_path):
+    """Both writers — the scheduler's file-only Heartbeat and the
+    coordinator's HTTP-fed one — must render on /campaign/<n>/live."""
+    from jepsen_tpu.telemetry import Heartbeat
+
+    base = str(tmp_path)
+    # scheduler shape: written straight to the file (the fallback path)
+    hb = Heartbeat(ccore.live_path("filecamp", base),
+                   campaign="filecamp", total=4)
+    hb.worker("campaign-worker-0", {"run": "r-file", "workload": "set",
+                                    "fault": "nofault", "seed": 0,
+                                    "slot": None})
+    coord = FleetCoordinator(dict(SPEC, name="fl-hb", seeds=[0]), base,
+                             lease_s=5.0)
+    srv = web.serve(port=0, base=base, background=True, fleet=coord)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        page = _get(url, "/campaign/filecamp/live")
+        assert "r-file" in page and "campaign-worker-0" in page
+        # coordinator shape: the same state pushed over HTTP
+        _post(url, "/fleet/heartbeat",
+              {"worker": "remote-w", "state": {
+                  "run": "r-http", "workload": "set",
+                  "fault": "nofault", "seed": 1, "slot": None}})
+        page = _get(url, "/campaign/fl-hb/live")
+        assert "r-http" in page and "remote-w" in page
+    finally:
+        srv.server_close()
+        coord.close()
+
+
+def test_run_campaign_pushes_heartbeat_to_coordinator(tmp_path):
+    """`run_campaign` with a coordinator URL (spec opts) pushes its
+    heartbeat over HTTP: the live.json lands in the COORDINATOR's
+    store via its single writer, not in the campaign's own store."""
+    from jepsen_tpu import campaign
+
+    coord_base = str(tmp_path / "coord")
+    camp_base = str(tmp_path / "camp")
+    coord = FleetCoordinator(dict(SPEC, name="fl-push", seeds=[0]),
+                             coord_base, lease_s=5.0)
+    srv = web.serve(port=0, base=coord_base, background=True,
+                    fleet=coord)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        spec = {"name": "pushed", "workloads": ["noop"], "seeds": [0],
+                "opts": {"coordinator": url}}
+        summary = campaign.run_campaign(spec, camp_base, workers=1)
+        assert summary["executed"] == 1
+        # pushed, not written locally
+        assert not os.path.exists(ccore.live_path("pushed", camp_base))
+        doc = json.load(open(ccore.live_path("pushed", coord_base)))
+        assert doc["campaign"] == "pushed"
+        assert doc["finished"] is True
+        assert doc["total"] == 1 and doc["done"] == 1
+        page = _get(url, "/campaign/pushed/live")
+        assert "finished" in page and "1/1 runs done" in page
+    finally:
+        srv.server_close()
+        coord.close()
+
+
+def test_http_heartbeat_never_raises_without_a_coordinator():
+    from jepsen_tpu.telemetry import HttpHeartbeat
+
+    hb = HttpHeartbeat("http://127.0.0.1:1", campaign="x", total=2,
+                       timeout_s=0.2)  # nothing listens on port 1
+    hb.worker("w", {"run": "r"})
+    hb.record_done("r", True)
+    hb.close()  # all best-effort no-ops
+
+
+def test_http_heartbeat_backs_off_after_failure(monkeypatch):
+    """Review regression: heartbeats are posted synchronously from the
+    scheduler's worker threads, so an unreachable coordinator must
+    cost ONE timeout per cooldown window, not one per cell
+    transition."""
+    import urllib.request
+
+    from jepsen_tpu.telemetry import HttpHeartbeat
+
+    calls = []
+
+    def dying(*a, **kw):
+        calls.append(1)
+        raise OSError("unreachable")
+
+    monkeypatch.setattr(urllib.request, "urlopen", dying)
+    hb = HttpHeartbeat("http://coord:1", campaign="x", backoff_s=60.0)
+    assert len(calls) == 1  # the init push tried and armed the backoff
+    for i in range(10):
+        hb.worker("w", {"run": f"r{i}"})
+        hb.record_done(f"r{i}", True)
+    assert len(calls) == 1  # every update inside the cooldown skipped
+    hb._down_until = 0.0  # window over: the next push tries again
+    hb.worker("w", None)
+    assert len(calls) == 2
+
+
+def test_coordinator_close_and_touch_scoped_to_own_fleet(tmp_path):
+    """Review regressions: (a) a pushed campaign's scheduler slot
+    names must not register as fleet workers (the workers-alive view
+    would over-count); (b) coordinator close() must not mark OTHER
+    campaigns' pushed heartbeats finished while they still run."""
+    base = str(tmp_path)
+    spec = dict(SPEC, name="fl-scope", seeds=[0])
+    run_id = expand(spec)[0].run_id
+    coord = FleetCoordinator(spec, base, lease_s=5.0)
+    # a remote run_campaign pushes through the heartbeat sink
+    coord.heartbeat({"campaign": "other", "total": 3,
+                     "worker": "campaign-worker-0",
+                     "state": {"run": "r-other", "slot": 0}})
+    code, s = coord.status()
+    assert "campaign-worker-0" not in s["workers"]  # not a fleet worker
+    # ...but its state still reaches the other campaign's live.json
+    doc = json.load(open(ccore.live_path("other", base)))
+    assert doc["workers"]["campaign-worker-0"]["run"] == "r-other"
+    # a real fleet worker registers via claim and finishes the fleet
+    code, r = coord.claim({"worker": "real-w"})
+    assert code == 200 and r["spec"]["run_id"] == run_id
+    code, _ = coord.complete({"worker": "real-w", "run": run_id,
+                              "record": {"run": run_id, "key": "k",
+                                         "valid?": True}})
+    assert code == 200 and coord.finished
+    assert "real-w" in coord.status()[1]["workers"]
+    coord.close()
+    own = json.load(open(ccore.live_path("fl-scope", base)))
+    assert own["finished"] is True
+    other = json.load(open(ccore.live_path("other", base)))
+    assert other["finished"] is False  # still that campaign's to close
+
+
+# ------------------------------------------------- warehouse satellite
+
+def test_warehouse_ingests_fleet_ledger(tmp_path):
+    from jepsen_tpu.telemetry import warehouse as wmod
+
+    base = str(tmp_path)
+    q = WorkQueue(fleet_path("wf", base))
+    for i in range(3):
+        q.enqueue(_spec(f"r{i}"))
+    q.claim("hostA", lease_s=0.1, now=0.0)
+    q.expire(now=1.0)  # hostA requeues
+    q.claim("hostB", lease_s=9.0, now=1.0)
+    q.complete("r0", "hostB", {"valid?": True})
+    q.complete("r0", "hostA", {"valid?": True})  # zombie duplicate
+    wh = wmod.open_or_create(base)
+    stats = wh.ingest_store(base)
+    assert stats["fleet-events"] == 8
+    roll = wh.fleet_worker_rollup("fleet/wf.jsonl")
+    # "which host's cells requeue most": hostA leads
+    assert roll[0]["worker"] == "hostA" and roll[0]["requeues"] == 1
+    assert roll[0]["duplicates"] == 1
+    by = {r["worker"]: r for r in roll}
+    assert by["hostB"]["completes"] == 1 and by["hostB"]["claims"] == 1
+    # incremental: unchanged ledger is a no-op; appends ingest alone
+    assert wh.ingest_store(base)["fleet-events"] == 0
+    q.complete("r1", "hostB", {"valid?": False})
+    assert wh.ingest_store(base)["fleet-events"] == 1
+    # cli obs sql can answer it
+    cols, rows = wh.query(
+        "SELECT worker FROM fleet_worker_rollup "
+        "ORDER BY requeues DESC LIMIT 1")
+    assert rows == [("hostA",)]
+    # a healed/rewritten (shrunken) ledger wipes + re-ingests
+    path = fleet_path("wf", base)
+    lines = open(path).readlines()
+    with open(path, "w") as f:
+        f.writelines(lines[:4])
+    wh.ingest_fleet_ledger(path, base)
+    assert wh.counts()["fleet_events"] == 4
+    wh.close()
+
+
+def test_store_tests_skips_fleet_subtree(tmp_path):
+    base = str(tmp_path)
+    os.makedirs(os.path.join(base, "fleet"))
+    with open(os.path.join(base, "fleet", "x.jsonl"), "w") as f:
+        f.write("{}\n")
+    os.makedirs(os.path.join(base, "a-test", "t1"))
+    assert [os.path.basename(os.path.dirname(d))
+            for d in store.tests(base=base)] == ["a-test"]
+
+
+# ------------------------------------------- chaos acceptance (tier 1)
+
+def test_fleet_soak_fast_chaos_acceptance():
+    """The ISSUE 9 acceptance pin, end to end in subprocesses: a
+    12-cell campaign run by 3 workers under seeded control-plane chaos
+    (drops + stalls on claim/heartbeat/complete, both sides), one
+    worker kill -9 (lease-expiry requeue), one coordinator kill -9 +
+    restart (ledger replay digest-pinned against an independent
+    replay) — exactly one attributable verdict per cell, and the
+    distributed result set equals a single-process run_campaign on
+    verdict keys."""
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "soak_fleet.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, script, "--fast"],
+                          capture_output=True, text=True, timeout=280,
+                          env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fleet soak OK" in proc.stdout
+    assert "replayed to identical state" in proc.stdout
+    assert "killed -9 worker" in proc.stdout
+    assert "killed -9 coordinator" in proc.stdout

@@ -304,7 +304,7 @@ def test_run_dir_ingest_digest_noop_and_missing_artifacts(tmp_path):
     # unchanged store: full no-op
     assert wh.ingest_store(str(tmp_path)) == \
         {"ledgers": 0, "records": 0, "runs": 0, "events": 0,
-         "sessions": 0}
+         "sessions": 0, "fleet-events": 0}
     c = wh.counts()
     assert c["runs"] == 2 and c["witnesses"] == 1
     assert c["run_spans"] == 2   # run + check:la (telemetric run only)
@@ -398,7 +398,7 @@ def test_rebuild_from_torn_partial_store(tmp_path):
     # ... and a plain re-ingest on top is a no-op
     assert wh.ingest_store(str(tmp_path)) == \
         {"ledgers": 1, "records": 0, "runs": 0, "events": 0,
-         "sessions": 0}
+         "sessions": 0, "fleet-events": 0}
 
 
 def test_event_ingest_rotation_resets_and_since_filter(tmp_path):
@@ -423,11 +423,17 @@ def test_event_ingest_rotation_resets_and_since_filter(tmp_path):
     evs = wh.events_since(d, str(tmp_path))
     ticks = [e["i"] for e in evs if e.get("ev") == "tick"]
     assert ticks == list(range(18))
-    cut = [e for e in evs if e.get("ev") == "tick"][9]["t"]
+    tick_evs = [e for e in evs if e.get("ev") == "tick"]
+    cut = tick_evs[9]["t"]
     since = [e.get("i") for e in
              wh.events_since(d, str(tmp_path), since=cut)
              if e.get("ev") == "tick"]
-    assert since == list(range(9, 18))
+    # compare against the same filter applied in python: two ticks
+    # emitted within one timestamp-rounding quantum share a t, so the
+    # cut may legitimately include a neighbor — the pin is that the
+    # warehouse filter matches the scan semantics, not the clock
+    assert since == [e["i"] for e in tick_evs if e["t"] >= cut]
+    assert 9 in since and 17 in since and 0 not in since
 
 
 def test_event_ingest_new_session_regrow_not_spliced(tmp_path):
@@ -699,6 +705,16 @@ def _golden_exposition(base):
     sw = reg.histogram("verifier-sweep-s", (0.001, 0.01, 0.1, 1.0, 10.0))
     for v in (0.005, 0.02, 0.02, 0.3):
         sw.observe(v)
+    # fleet gauges (ISSUE 9 satellite): the coordinator's control-plane
+    # view — workers alive by heartbeat freshness, active leases, cells
+    # by state, requeue/duplicate counters attributed per worker
+    reg.gauge("fleet-workers-alive").set(3)
+    reg.gauge("fleet-leases-active").set(2)
+    for state, n in (("queued", 4), ("claimed", 2), ("done", 6)):
+        reg.gauge("fleet-cells", state=state).set(n)
+    reg.counter("fleet-requeues", worker="w1",
+                reason="lease-expired").inc(2)
+    reg.counter("fleet-duplicate-completions", worker="w1").inc(1)
     cdir = os.path.join(str(base), "campaigns")
     os.makedirs(cdir, exist_ok=True)
     with open(os.path.join(cdir, "soak.live.json"), "w") as f:
