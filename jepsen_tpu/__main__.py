@@ -17,29 +17,44 @@ from .generator import core as g
 
 def _wl(name: str, opts: Dict[str, Any]):
     from .workloads import (append, bank, causal, linearizable_register,
-                            long_fork, queue, sets, wr)
+                            long_fork, queue, session, sets, wr,
+                            write_skew)
     from .workloads.mem import MemClient, MemStore
 
     rng = random.Random(opts.get("seed"))
+    # per-op client latency (seconds): campaign specs use it to pace
+    # the unbounded in-memory cluster so nemesis windows actually
+    # overlap a bounded op count
+    lat = float(opts.get("client-latency") or 0.0)
     if name == "append":
-        return append.workload(rng=rng), MemClient()
+        return append.workload(rng=rng), MemClient(latency=lat)
     if name == "wr":
-        return wr.workload(rng=rng), MemClient(txn_kind="rw-register")
+        return wr.workload(rng=rng), MemClient(txn_kind="rw-register",
+                                               latency=lat)
     if name == "lin-register":
-        return (linearizable_register.workload(rng=rng), MemClient())
+        return (linearizable_register.workload(rng=rng),
+                MemClient(latency=lat))
     if name == "bank":
         wl = bank.workload(rng=rng)
         s = MemStore()
         s.accounts = dict(wl["accounts"])
-        return wl, MemClient(s)
+        return wl, MemClient(s, latency=lat)
     if name == "long-fork":
-        return long_fork.workload(rng=rng), MemClient(txn_kind="rw-register")
+        return (long_fork.workload(rng=rng),
+                MemClient(txn_kind="rw-register", latency=lat))
     if name == "set":
-        return sets.workload(rng=rng), MemClient()
+        return sets.workload(rng=rng), MemClient(latency=lat)
     if name == "queue":
-        return queue.workload(rng=rng), MemClient()
+        return queue.workload(rng=rng), MemClient(latency=lat)
     if name == "causal":
-        return causal.workload(rng=rng), MemClient(txn_kind="rw-register")
+        return (causal.workload(rng=rng),
+                MemClient(txn_kind="rw-register", latency=lat))
+    if name == "write-skew":
+        return (write_skew.workload(rng=rng),
+                MemClient(txn_kind="rw-register", latency=lat))
+    if name == "session":
+        return (session.workload(rng=rng),
+                MemClient(txn_kind="rw-register", latency=lat))
     raise ValueError(f"unknown workload {name!r}")
 
 
@@ -72,7 +87,7 @@ def _demo_test(name: str):
 
 DEMOS = {n: _demo_test(n) for n in
          ("append", "wr", "lin-register", "bank", "long-fork", "set",
-          "queue", "causal")}
+          "queue", "causal", "write-skew", "session")}
 
 if __name__ == "__main__":
     cli.main(cli.test_all_cmd(DEMOS, prog="python -m jepsen_tpu"))

@@ -39,10 +39,12 @@ __all__ = ["RunSpec", "expand", "load_spec", "spec_digest",
            "build_test", "register_workload", "DEVICE_WORKLOADS"]
 
 #: workload names whose checkers dispatch to the device pipelines (elle
-#: list-append/rw-register, knossos device WGL) — the scheduler
-#: serializes these through device slots; host-only checkers run freely
+#: list-append/rw-register, knossos device WGL, the invariants family)
+#: — the scheduler serializes these through device slots; host-only
+#: checkers run freely
 DEVICE_WORKLOADS = frozenset({
     "append", "wr", "causal", "long-fork", "lin-register", "queue",
+    "bank", "write-skew", "session",
 })
 
 #: extension point: name -> builder(opts_dict) -> test map (db suites
@@ -180,10 +182,30 @@ def _wl_label(w: dict) -> str:
     return w["name"] + (f"-{_digest(w['opts'], 4)}" if w["opts"] else "")
 
 
+def known_workloads() -> List[str]:
+    """Every workload name a spec entry may resolve to: registered
+    builders, ``"noop"``, and the demo registry."""
+    from jepsen_tpu.__main__ import DEMOS
+
+    return sorted(set(_EXTRA_WORKLOADS) | {"noop"} | set(DEMOS))
+
+
 def expand(spec: Union[str, dict]) -> List[RunSpec]:
     """Expand a campaign spec into its RunSpec list (workload-major,
-    then fault, then seed — deterministic)."""
+    then fault, then seed — deterministic).
+
+    Workload names are validated here, at plan time: an unknown entry
+    raises a ValueError naming the bad workload and listing every
+    registered one, instead of surfacing as a bare resolution error
+    mid-campaign."""
     spec = load_spec(spec)
+    known = known_workloads()
+    for w in spec["workloads"]:
+        if w["name"] not in known:
+            raise ValueError(
+                f"unknown workload {w['name']!r} in campaign spec "
+                f"{spec['name']!r}; registered workloads: "
+                f"{', '.join(known)}")
     name = spec["name"]
     base_opts = spec["opts"]
     out: List[RunSpec] = []
@@ -211,6 +233,31 @@ def expand(spec: Union[str, dict]) -> List[RunSpec]:
 # RunSpec -> runnable test map
 # ---------------------------------------------------------------------------
 
+def _nemesis_for(opts: Dict[str, Any], seed: int, nodes, client):
+    """Build the combined nemesis package a cell's opts request.
+
+    ``opts["nemesis"]`` is a dict (``{"faults": ["skew"], "interval":
+    0.2, ...}``) or a bare fault-name string/list; seeded from the
+    cell's seed so schedules replay deterministically."""
+    spec = opts.get("nemesis")
+    if not spec:
+        return None
+    import random as _random
+
+    from jepsen_tpu.nemesis import combined
+
+    if isinstance(spec, str):
+        spec = {"faults": [spec]}
+    elif isinstance(spec, (list, tuple)):
+        spec = {"faults": list(spec)}
+    pkg_opts = dict(spec)
+    pkg_opts.setdefault("faults", [])
+    pkg_opts.setdefault("interval", 0.25)
+    pkg_opts.setdefault("nodes", list(nodes))
+    pkg_opts["rng"] = _random.Random(seed)
+    pkg_opts.setdefault("client", client)
+    return combined.nemesis_package(pkg_opts)
+
 def build_test(rs: RunSpec, base: str) -> dict:
     """Build the `core.run`-able test map for one campaign cell.
 
@@ -234,22 +281,38 @@ def build_test(rs: RunSpec, base: str) -> dict:
         from jepsen_tpu.__main__ import _wl
 
         wl, client = _wl(rs.workload, {**opts, "seed": rs.seed})
+        nodes = list(opts.get("nodes") or ["n1", "n2", "n3"])
         gen = g.clients(wl["generator"])
         if opts.get("ops"):
             gen = g.limit(int(opts["ops"]), gen)
+        # nemesis schedules (opts "nemesis": {"faults": [...], ...})
+        # compose BEFORE the time limit: the package generators are
+        # unbounded cycles, and the wall clock must bound the whole
+        # interleaving, not just the client half
+        pkg = _nemesis_for(opts, rs.seed, nodes, client)
+        if pkg is not None and pkg.get("generator") is not None:
+            gen = g.any_gen(gen, g.nemesis(pkg["generator"]))
         tl = opts.get("time-limit", 1.0)
         if tl:
             gen = g.time_limit(float(tl), gen)
         t = jcore.noop_test(
             name=name,
-            nodes=list(opts.get("nodes") or ["n1", "n2", "n3"]),
+            nodes=nodes,
             concurrency=int(opts.get("concurrency", 4)),
             client=client, generator=gen, checker=wl["checker"])
         for k, v in wl.items():
             if k not in ("generator", "checker", "final-generator"):
                 t.setdefault(k, v)
+        finals = []
         if "final-generator" in wl:
-            t["final-generator"] = wl["final-generator"]
+            finals.append(wl["final-generator"])
+        if pkg is not None:
+            t["nemesis"] = pkg["nemesis"]
+            if pkg.get("final_generator"):
+                finals.append(g.nemesis(pkg["final_generator"]))
+        if finals:
+            t["final-generator"] = finals[0] if len(finals) == 1 \
+                else finals
     t["store-dir"] = base
     t["seed"] = rs.seed
     t["campaign"] = rs.campaign

@@ -1,0 +1,170 @@
+"""Bank invariants: total balance + snapshot reads, vectorized.
+
+The reference's `jepsen/tests/bank.clj` checker as whole-history array
+reductions over the SoA packing (:func:`packed.pack_bank`):
+
+- **total balance**: every committed whole-state read must sum to the
+  initial total (under snapshot isolation a read observes one atomic
+  snapshot; transfers conserve money, so any other sum is read skew);
+- **negative balances**: flagged unless the workload allows them
+  (`negative-balances-ok`).
+
+Both checks are one pass over the ``[n_reads, n_accounts]`` balance
+matrix: row sums, sign tests, boolean reductions.  The **device path**
+runs that pass as jnp reductions dispatched through
+`resilience.device_call` (site ``invariants.bank``) with retry /
+deadline / fault-plan semantics; a persistent device failure degrades
+to the **host numpy oracle twin** (`host_verdict` — the exact same
+arithmetic, the reference the device path is differentially pinned
+against) with ``"degraded": "host-fallback"`` stamped, the same
+contract the elle checkers follow.
+
+Result shape matches the elle family (``valid?`` / ``anomaly-types`` /
+``anomalies``) and keeps the legacy bank keys (``bad-reads`` /
+``bad-read-count`` / ``read-count``) the workload tests and perf plots
+already consume.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.checkers.invariants import packed as packed_mod
+from jepsen_tpu.checkers.invariants.packed import PackedBank
+
+WRONG_TOTAL = "bank-wrong-total"
+NEGATIVE = "bank-negative-balance"
+
+SITE = "invariants.bank"
+
+
+def resolve_total(test: Optional[dict], pb: PackedBank,
+                  total: Optional[int] = None) -> Optional[int]:
+    """The expected conserved total: explicit arg > test map
+    ``total-amount`` > sum of the test's initial ``accounts`` > the
+    modal read sum (so a single anomalous read can't become the
+    baseline)."""
+    if total is not None:
+        return int(total)
+    t = (test or {}).get("total-amount")
+    if t is not None:
+        return int(t)
+    accounts = (test or {}).get("accounts")
+    if isinstance(accounts, dict) and accounts:
+        return int(sum(accounts.values()))
+    if pb.n_reads:
+        sums = pb.balances.sum(axis=1)
+        vals, counts = np.unique(sums, return_counts=True)
+        return int(vals[np.argmax(counts)])
+    return None
+
+
+def _reduce_host(balances: np.ndarray, total: int, negative_ok: bool):
+    """The one reduction both paths implement: (row sums, wrong-total
+    mask, negative mask)."""
+    sums = balances.sum(axis=1)
+    wrong = sums != total
+    neg = (balances < 0).any(axis=1) if not negative_ok \
+        else np.zeros(len(balances), bool)
+    return sums, wrong, neg
+
+
+def _reduce_device(balances: np.ndarray, total: int, negative_ok: bool):
+    import jax.numpy as jnp
+
+    b = jnp.asarray(balances)
+    sums = b.sum(axis=1)
+    wrong = sums != total
+    neg = (b < 0).any(axis=1) if not negative_ok \
+        else jnp.zeros(b.shape[0], bool)
+    return (np.asarray(sums), np.asarray(wrong), np.asarray(neg))
+
+
+def host_verdict(pb: PackedBank, total: int, negative_ok: bool,
+                 max_reported: int = 8) -> Dict[str, Any]:
+    """The exact host oracle twin — numpy only, no jax import."""
+    sums, wrong, neg = _reduce_host(pb.balances, total, negative_ok)
+    return _render(pb, total, sums, wrong, neg, max_reported)
+
+
+def _render(pb: PackedBank, total: int, sums, wrong, neg,
+            max_reported: int) -> Dict[str, Any]:
+    found: Dict[str, list] = {}
+    bad = wrong | neg
+    bad_reads = []
+    for i in np.nonzero(bad)[0][:max_reported]:
+        entry = {
+            "op-index": int(pb.read_op_index[i]),
+            "process": int(pb.read_process[i]),
+            "total": int(sums[i]),
+            "expected-total": int(total),
+            "negative": [pb.accounts[j]
+                         for j in np.nonzero(pb.balances[i] < 0)[0]],
+        }
+        bad_reads.append(entry)
+        if wrong[i]:
+            found.setdefault(WRONG_TOTAL, []).append(entry)
+        if neg[i]:
+            found.setdefault(NEGATIVE, []).append(entry)
+    return {
+        "valid?": not bool(bad.any()),
+        "anomaly-types": sorted(found),
+        "anomalies": found,
+        "read-count": pb.n_reads,
+        "bad-read-count": int(bad.sum()),
+        "bad-reads": bad_reads,
+        "expected-total": int(total),
+    }
+
+
+def check(history, test: Optional[dict] = None, *,
+          negative_balances_ok: bool = False,
+          total: Optional[int] = None,
+          use_device: bool = True,
+          max_reported: int = 8,
+          deadline=None, plan=None, policy=None) -> Dict[str, Any]:
+    """Check a bank history.  Accepts a History / op list / PackedBank.
+
+    Device path first (guarded, retried, deadline-polled); persistent
+    failure degrades to the host twin with the standard stamp.
+    ``use_device=False`` IS the host twin — the two must agree
+    verdict-for-verdict (pinned by tests/test_invariants.py)."""
+    from jepsen_tpu import resilience
+
+    ph = telemetry.phases()
+    pb = history if isinstance(history, PackedBank) else None
+    if pb is None:
+        ph.start("invariants.pack", device=False)
+        pb = packed_mod.pack_bank(
+            history, accounts=((test or {}).get("accounts") or {}).keys()
+            or None)
+    t = resolve_total(test, pb, total)
+    if not pb.n_reads or t is None:
+        ph.end()
+        return {"valid?": "unknown", "read-count": pb.n_reads,
+                "anomaly-types": [], "anomalies": {}, "bad-reads": []}
+    if deadline is not None:
+        deadline.check(SITE)
+    if not use_device:
+        ph.start("invariants.check", device=False, reads=pb.n_reads)
+        res = host_verdict(pb, t, negative_balances_ok, max_reported)
+        ph.end()
+        return res
+    ph.start("invariants.check", device=True, reads=pb.n_reads)
+    try:
+        (sums, wrong, neg), degraded = resilience.with_fallback(
+            SITE,
+            lambda: _reduce_device(pb.balances, t, negative_balances_ok),
+            lambda: _reduce_host(pb.balances, t, negative_balances_ok),
+            deadline=deadline, plan=plan, policy=policy, test=test)
+    except resilience.DeadlineExceeded:
+        ph.end()
+        return resilience.deadline_result(checker="bank")
+    res = _render(pb, t, sums, wrong, neg, max_reported)
+    if degraded:
+        res["degraded"] = degraded
+    ph.end()
+    return res

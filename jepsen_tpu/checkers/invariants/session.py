@@ -1,0 +1,278 @@
+"""Session guarantees as vectorized per-process passes.
+
+Monotonic reads / monotonic writes / read-your-writes /
+writes-follow-reads over rw-register-shaped histories, checked against
+the per-key version orders the shared packed core derives
+(:func:`packed.infer_rw`): every committed external read / write
+becomes one event row ``(process, key, seq, is_write, rank)`` where
+``rank`` is the version's position in its key's chain, and each
+guarantee is a segmented comparison against the LAST prior event of
+the relevant type in the same ``(process, key)`` segment —
+
+    monotonic-reads      read rank  < last prior read rank
+    read-your-writes     read rank  < last prior write rank
+    monotonic-writes     write rank < last prior write rank
+    writes-follow-reads  write rank < last prior read rank
+
+"last prior X" is one encoded cumulative max (position-dominant
+encoding, the `_seg_inclusive_max` trick), so the whole pass is a
+handful of array ops: sort, cummax, compare.  The **device path** runs
+the cummax + comparisons on jnp (``jax.lax.cummax``) behind
+`resilience.device_call` (site ``invariants.session``); the **host
+oracle twin** is the identical numpy, pinned equal verdict-for-verdict.
+
+Exactness first: rank comparison is only definite on keys whose
+version graph is a simple chain (`RwInference.chain_ok`).  Histories
+with branched/cyclic keys — or cross-key read-then-write dependencies,
+which need the obligation walker — fall back to the exact DAG walker
+(`checkers.elle.sessions.check`), the same degradation rule the elle
+family uses (an oracle that cannot look must say so, never silently
+validate)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.checkers.elle import consistency
+from jepsen_tpu.checkers.elle.sessions import GUARANTEES
+from jepsen_tpu.checkers.invariants import packed as packed_mod
+from jepsen_tpu.checkers.invariants.packed import RwInference
+from jepsen_tpu.history.soa import MOP_APPEND, TXN_OK, PackedTxns
+
+SITE = "invariants.session"
+
+_SUFFIX = "-violation"
+
+
+def _session_events(p: PackedTxns, inf: RwInference):
+    """Flatten committed reads/writes to (proc, key, seq, is_write,
+    rank) rows sorted session-major.  Returns None when any event's
+    rank is unknown or its key is not chain-shaped — the walker owns
+    those histories."""
+    ok = p.txn_type == TXN_OK
+    V = p.n_vals
+    # writes: committed append mops, in mop order
+    kind = p.mop_kind.astype(np.int64)
+    mtxn = p.mop_txn.astype(np.int64)
+    w_sel = np.nonzero((kind == MOP_APPEND) & ok[mtxn])[0]
+    # reads: the inference's external reads from committed txns
+    r_txn = inf.ext_read_txn
+    r_val = inf.ext_read_val
+    r_mop = inf.ext_read_mop
+
+    ev_txn = np.concatenate([mtxn[w_sel], r_txn]).astype(np.int64)
+    ev_mop = np.concatenate([w_sel, r_mop]).astype(np.int64)
+    ev_val = np.concatenate([p.mop_val.astype(np.int64)[w_sel],
+                             r_val]).astype(np.int64)
+    ev_write = np.concatenate([np.ones(len(w_sel), bool),
+                               np.zeros(len(r_txn), bool)])
+    if not len(ev_txn):
+        return (np.zeros(0, np.int64),) * 5
+    ev_key = p.mop_key.astype(np.int64)[ev_mop]
+    if not inf.chain_ok[np.unique(ev_key)].all():
+        return None
+    rank = inf.chain_rank[ev_val]
+    if (rank < 0).any():
+        return None
+    proc = p.txn_process.astype(np.int64)[ev_txn]
+    inv = p.txn_invoke_pos.astype(np.int64)[ev_txn]
+    # session order: invoke position, then mop order within the txn
+    order = np.lexsort((ev_mop, inv, ev_key, proc))
+    return (proc[order], ev_key[order], ev_write[order], rank[order],
+            ev_txn[order])
+
+
+def _cross_key_deps(p: PackedTxns) -> bool:
+    """Does any SESSION write a key after touching another key?  That
+    is exactly when the DAG walker registers cross-key obligations
+    (writes-follow-reads / monotonic-writes propagation) the same-key
+    vectorized pass cannot see — such histories fall back to the
+    walker (exactness first).  Sessions that only read many keys, or
+    write within one key, never register obligations and stay on the
+    vectorized path."""
+    ok = p.txn_type == TXN_OK
+    kind = p.mop_kind.astype(np.int64)
+    mtxn = p.mop_txn.astype(np.int64)
+    mkey = p.mop_key.astype(np.int64)
+    sel = ok[mtxn]
+    if not sel.any():
+        return False
+    t, k, w = mtxn[sel], mkey[sel], (kind[sel] == MOP_APPEND)
+    proc = p.txn_process.astype(np.int64)[t]
+    inv = p.txn_invoke_pos.astype(np.int64)[t]
+    pos = np.arange(len(t))
+    order = np.lexsort((pos, inv, proc))
+    touched: Dict[int, set] = {}
+    for i in order.tolist():
+        pr, key = int(proc[i]), int(k[i])
+        seen = touched.setdefault(pr, set())
+        if w[i] and (seen - {key}):
+            return True
+        seen.add(key)
+    return False
+
+
+def _viol_masks(seg_id: np.ndarray, is_write: np.ndarray,
+                rank: np.ndarray):
+    """Backend-generic violation masks.  Returns run(xp) computing the
+    four masks via a 1-based-position cummax ("latest matching event so
+    far") plus a segment-start comparison — the encoding stays within
+    the event count, so jax's default int32 can't overflow even on
+    million-event histories."""
+    n = len(seg_id)
+    # per-row first index of its own (process, key) segment
+    new = np.concatenate([[True], seg_id[1:] != seg_id[:-1]]) \
+        if n else np.zeros(0, bool)
+    seg_start_np = np.maximum.accumulate(
+        np.where(new, np.arange(n), 0)) if n else np.zeros(0, np.int64)
+
+    def run(xp):
+        w = xp.asarray(is_write)
+        r = xp.asarray(rank)
+        pos1 = xp.arange(1, n + 1)
+        seg_start = xp.asarray(seg_start_np)
+
+        def last_prior(of_write):
+            # cummax of (1-based position where the event matches)
+            # gives the latest matching event at-or-before each row;
+            # the exclusive shift makes it strictly prior, and a match
+            # from an earlier (process, key) segment is rejected by
+            # the segment-start comparison
+            match = w if of_write else ~w
+            enc = xp.where(match, pos1, 0)
+            cm = _cummax(xp, enc)
+            prior = xp.concatenate([cm[:1] * 0, cm[:-1]])
+            has = (prior > 0) & ((prior - 1) >= seg_start)
+            prank = r[xp.clip(prior - 1, 0, max(n - 1, 0))]
+            return has, prank
+
+        has_r, last_r = last_prior(False)
+        has_w, last_w = last_prior(True)
+        # mask order == sessions.GUARANTEES order
+        return (
+            (~w) & has_r & (r < last_r),   # monotonic-reads
+            w & has_w & (r < last_w),      # monotonic-writes
+            (~w) & has_w & (r < last_w),   # read-your-writes
+            w & has_r & (r < last_r),      # writes-follow-reads
+        )
+
+    return run
+
+
+def _cummax(xp, a):
+    if xp is np:
+        return np.maximum.accumulate(a)
+    from jax import lax
+
+    return lax.cummax(a, axis=0)
+
+
+def check(history, guarantees: Sequence[str] = GUARANTEES,
+          use_device: bool = True, max_reported: int = 8,
+          deadline=None, plan=None, policy=None,
+          test: Optional[dict] = None) -> Dict[str, Any]:
+    """Check session guarantees.  Accepts a History / op list /
+    PackedTxns (rw-register packing).  Result shape matches the elle
+    checkers; anomalies use the lattice's ``<guarantee>-violation``
+    tokens."""
+    from jepsen_tpu import resilience
+
+    ph = telemetry.phases()
+    op_level = None if isinstance(history, PackedTxns) else history
+    if op_level is None:
+        p = history
+    else:
+        ph.start("invariants.pack", device=False)
+        p = packed_mod.pack_rw(history)
+    if p.n_txns == 0 or not (p.txn_type == TXN_OK).any():
+        ph.end()
+        return {"valid?": "unknown", "anomaly-types": [], "anomalies": {},
+                "not": [], "also-not": []}
+
+    ph.start("invariants.infer", device=False, txns=p.n_txns)
+    inf = packed_mod.infer_rw(p)
+    ev = _session_events(p, inf)
+    want = set(guarantees)
+
+    if ev is None or _cross_key_deps(p):
+        # branched versions / cross-key obligations: the exact DAG
+        # walker owns the verdict (op-level input required)
+        ph.end()
+        return _walker_fallback(op_level, want)
+
+    proc, key, is_write, rank, ev_txn = ev
+    seg = np.zeros(len(proc), np.int64)
+    if len(proc):
+        new = np.concatenate([[True], (proc[1:] != proc[:-1]) |
+                              (key[1:] != key[:-1])])
+        seg = np.cumsum(new) - 1
+    run = _viol_masks(seg, is_write, rank)
+    ph.start("invariants.check", device=use_device, events=len(proc))
+    degraded = None
+    try:
+        if use_device and len(proc):
+            def dev():
+                import jax.numpy as jnp
+
+                return tuple(np.asarray(m) for m in run(jnp))
+
+            masks, degraded = resilience.with_fallback(
+                SITE, dev, lambda: run(np), deadline=deadline,
+                plan=plan, policy=policy, test=test)
+        else:
+            masks = run(np) if len(proc) else (np.zeros(0, bool),) * 4
+    except resilience.DeadlineExceeded:
+        ph.end()
+        return resilience.deadline_result(checker="session")
+    ph.end()
+
+    found: Dict[str, List[dict]] = {}
+    orig = p.txn_orig_index
+    for g, mask in zip(GUARANTEES, masks):
+        if g not in want:
+            continue
+        hits = np.nonzero(np.asarray(mask))[0]
+        if not len(hits):
+            continue
+        lst = found.setdefault(g + _SUFFIX, [])
+        for i in hits[:max_reported]:
+            lst.append({
+                "process": int(proc[i]),
+                "op": int(orig[ev_txn[i]]),
+                "key": p.key_names[int(key[i])],
+                "rank": int(rank[i]),
+                "kind": "write" if is_write[i] else "read",
+            })
+
+    anomaly_types = sorted(found)
+    boundary = consistency.friendly_boundary(anomaly_types)
+    res: Dict[str, Any] = {
+        "valid?": not found,
+        "anomaly-types": anomaly_types,
+        "anomalies": found,
+        "not": boundary["not"],
+        "also-not": boundary["also-not"],
+        "events": int(len(proc)),
+    }
+    if degraded:
+        res["degraded"] = degraded
+    return res
+
+
+def _walker_fallback(op_level, want) -> Dict[str, Any]:
+    from jepsen_tpu.checkers.elle import coverage, sessions
+
+    if op_level is None:
+        # packed-only input: the walker needs the op-level view —
+        # degrade rather than silently validate
+        return coverage.apply_unchecked(
+            {"valid?": True, "anomaly-types": [], "anomalies": {},
+             "not": [], "also-not": [],
+             "fallback": "walker-needs-op-history"},
+            sorted(g + _SUFFIX for g in want))
+    res = sessions.check(op_level, guarantees=sorted(want))
+    res["fallback"] = "dag-walker"
+    return res

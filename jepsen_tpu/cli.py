@@ -322,6 +322,8 @@ def campaign_cmd(opts: argparse.Namespace) -> int:
 
     try:
         spec = campaign.load_spec(opts.spec)
+        campaign.expand(spec)  # plan-time validation: an unknown
+        # workload fails HERE with the registered list, not mid-fleet
     except (OSError, ValueError) as e:
         print(f"campaign: bad spec {opts.spec!r}: {e}", file=sys.stderr)
         return 2

@@ -245,6 +245,10 @@ def _shrink_run(test, hist, run_dir, chk, confirm_chk, tel,
         meta = {
             "source-digest": source_digest,
             "source-ops": len(hist),
+            # the surviving fault-window set (nemesis-schedule ddmin):
+            # every window still in the witness, with its op indices —
+            # digest-stable at any worker count like the ops themselves
+            "fault-windows": getattr(reducer, "windows_meta", []),
             "valid?": final.get("valid?"),
             "anomaly-types": sorted(final.get("anomaly-types") or ()),
             "target": target,

@@ -41,7 +41,25 @@ __all__ = ["resolve_checker", "is_device_checker", "host_equivalent",
 #: pipelines — probes of these serialize through DeviceSlots
 DEVICE_CHECKER_NAMES = frozenset({
     "list-append", "rw-register", "Linearizable", "QueueChecker",
+    "bank", "long-fork", "write-skew", "session",
 })
+
+#: workload-kind (stamped into test maps by the workload bundles) ->
+#: (workloads submodule, checker class): the declarative dispatch for
+#: stored runs whose checker object didn't survive serialization
+_KIND_CHECKERS = {
+    "bank": ("bank", "BankChecker"),
+    "long-fork": ("long_fork", "LongForkChecker"),
+    "write-skew": ("write_skew", "WriteSkewChecker"),
+    "session": ("session", "SessionChecker"),
+}
+
+
+def _wl_checker(mod: str, cls: str):
+    import importlib
+
+    m = importlib.import_module(f"jepsen_tpu.workloads.{mod}")
+    return getattr(m, cls)()
 
 
 def resolve_checker(test: Optional[dict], history: History
@@ -50,14 +68,30 @@ def resolve_checker(test: Optional[dict], history: History
 
     Stored tests persist checker objects only as ``"§obj"``
     placeholders, so re-checking needs a fresh instance.  A live
-    checker on the test map wins; otherwise the history's own shape
-    decides (the same dispatch the workloads encode): list-append txns
-    → the elle list-append pipeline, rw-register txns → rw-register,
-    read/write/cas registers → knossos linearizability."""
+    checker on the test map wins; then the test's ``workload-kind``
+    stamp (the invariants workloads carry one); otherwise the
+    history's own shape decides (the same dispatch the workloads
+    encode): list-append txns → the elle list-append pipeline,
+    rw-register txns → rw-register, read/write/cas registers → knossos
+    linearizability, transfer/whole-state-read ops → bank."""
     chk = (test or {}).get("checker")
     if chk is not None and hasattr(chk, "check"):
         return chk
+    kind = (test or {}).get("workload-kind")
+    if kind in _KIND_CHECKERS:
+        return _wl_checker(*_KIND_CHECKERS[kind])
+    # shape scan: distinctive markers (transfer ops, dict-valued
+    # snapshot reads, txn mop kinds) decide immediately; bare register
+    # reads only RECORD register shape — a bank history whose first
+    # client op happens to be a read must still reach its transfer ops
+    register_seen = False
     for op in history:
+        if not op.is_client_op():
+            continue
+        if op.f == "transfer":
+            return _wl_checker(*_KIND_CHECKERS["bank"])
+        if op.f == "read" and isinstance(op.value, dict):
+            return _wl_checker(*_KIND_CHECKERS["bank"])
         if op.f == "txn" and isinstance(op.value, (list, tuple)):
             for m in op.value:
                 if not (isinstance(m, (list, tuple)) and m):
@@ -70,8 +104,12 @@ def resolve_checker(test: Optional[dict], history: History
                     from jepsen_tpu.workloads.wr import WrChecker
 
                     return WrChecker()
-        if op.f in ("read", "write", "cas") and op.is_client_op():
+        if op.f in ("write", "cas"):
             return checker_api.Linearizable()
+        if op.f == "read":
+            register_seen = True
+    if register_seen:
+        return checker_api.Linearizable()
     raise ValueError(
         "cannot infer a checker from this history's op shapes; "
         "pass one explicitly (shrink(..., checker=...))")
@@ -122,6 +160,40 @@ def host_equivalent(chk: checker_api.Checker
                 deadline=(opts or {}).get("deadline"))
 
         return checker_api.FnChecker(rw_fn, "rw-register-host")
+    if _name(chk) == "bank":
+        # the invariants checkers' use_device=False path IS their host
+        # oracle twin (same arrays, numpy instead of jnp) — probing
+        # through it skips the per-candidate device dispatch
+        from jepsen_tpu.checkers.invariants import bank as inv_bank
+
+        neg_ok = bool(getattr(chk, "negative_ok", False))
+
+        def bank_fn(test, history, opts):
+            return inv_bank.check(history, test, use_device=False,
+                                  negative_balances_ok=neg_ok,
+                                  deadline=(opts or {}).get("deadline"))
+
+        return checker_api.FnChecker(bank_fn, "bank-host")
+    if _name(chk) in ("long-fork", "write-skew"):
+        from jepsen_tpu.checkers.invariants import predicate
+
+        def pred_fn(test, history, opts):
+            return predicate.check(history, use_device=False,
+                                   deadline=(opts or {}).get("deadline"))
+
+        return checker_api.FnChecker(pred_fn, _name(chk) + "-host")
+    if _name(chk) == "session":
+        from jepsen_tpu.checkers.invariants import session as inv_sess
+
+        guarantees = getattr(chk, "guarantees", None)
+
+        def sess_fn(test, history, opts):
+            kw = {"guarantees": guarantees} if guarantees else {}
+            return inv_sess.check(history, use_device=False,
+                                  deadline=(opts or {}).get("deadline"),
+                                  **kw)
+
+        return checker_api.FnChecker(sess_fn, "session-host")
     return None
 
 

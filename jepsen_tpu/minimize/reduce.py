@@ -39,7 +39,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from jepsen_tpu.history.ops import History, Op
 
 __all__ = ["Unit", "units_of", "build_history", "unit_keys",
-           "drop_key", "Reducer"]
+           "drop_key", "Reducer", "is_nemesis_unit", "fault_windows",
+           "window_descriptors"]
+
+#: the interpreter's nemesis thread id — fault ops carry it as their
+#: process (generator/context.NEMESIS_THREAD)
+NEMESIS_PROCESS = "nemesis"
 
 #: mop kinds whose middle element is a key (list-append + rw-register
 #: transactional values: ["append" k v] / ["w" k v] / ["r" k v-or-nil])
@@ -143,6 +148,82 @@ def drop_key(units: Sequence[Unit], key: Any) -> List[Unit]:
     return out
 
 
+# -- fault windows ----------------------------------------------------------
+
+def is_nemesis_unit(u: Unit) -> bool:
+    return u.process == NEMESIS_PROCESS
+
+
+_STOP_PREFIXES = ("stop", "heal", "resume", "fast", "reset")
+
+
+def _win_suffix(f: str) -> str:
+    """The fault family a start/stop f belongs to: 'start-skew' and
+    'stop-skew' share suffix 'skew', so interleaved windows from
+    composed packages pair up correctly."""
+    for pre in ("start-", "stop-", "heal-", "resume-", "reset-"):
+        if f.startswith(pre):
+            return f[len(pre):]
+    return f
+
+
+def fault_windows(nem_units: Sequence[Unit]) -> List[List[int]]:
+    """Group nemesis units into fault *windows* (indices into
+    `nem_units`, deterministic order).
+
+    Heuristic mirrors `perf.nemesis_intervals`, suffix-aware: a
+    start-like f opens a window; a stop/heal-like f closes the open
+    window of the SAME fault family (suffix after the start-/stop-
+    prefix), falling back to the most recent open window — so composed
+    packages' interleaved windows (start-skew, start-partition,
+    stop-skew, stop-partition) pair correctly.  One-shot faults
+    (``leave-node``, ``bump-clock``, ...) join the most recent open
+    window, or stand alone outside any."""
+    wins: List[List[int]] = []
+    open_wins: List[tuple] = []  # (suffix, window) in open order
+    for i, u in enumerate(nem_units):
+        f = str(u.ops[0].f or "")
+        is_stop = f.startswith(_STOP_PREFIXES)
+        if is_stop:
+            sfx = _win_suffix(f)
+            hit = next((j for j in range(len(open_wins) - 1, -1, -1)
+                        if open_wins[j][0] == sfx),
+                       len(open_wins) - 1 if open_wins else None)
+            if hit is None:
+                wins.append([i])  # orphan heal: its own window
+            else:
+                _, w = open_wins.pop(hit)
+                w.append(i)
+                wins.append(w)
+        elif f.startswith("start"):
+            open_wins.append((_win_suffix(f), [i]))
+        elif open_wins:
+            open_wins[-1][1].append(i)
+        else:
+            wins.append([i])  # one-shot fault
+    wins.extend(w for _, w in open_wins)  # still open at history end
+    return wins
+
+
+def window_descriptors(nem_units: Sequence[Unit],
+                       wins: Sequence[List[int]]) -> List[dict]:
+    """The witness-meta shape for a window set: per window, its
+    opening f, the original op indices it spans, and the index span."""
+    out = []
+    for w in wins:
+        ops = [op.index for i in w for op in nem_units[i].ops]
+        out.append({
+            "f": str(nem_units[w[0]].ops[0].f),
+            "ops": sorted(ops),
+            "span": [min(ops), max(ops)],
+        })
+    return out
+
+
+def _merge(client: Sequence[Unit], nem: Sequence[Unit]) -> List[Unit]:
+    return sorted([*client, *nem], key=lambda u: u.order)
+
+
 # -- the reducer ------------------------------------------------------------
 
 @dataclass
@@ -176,6 +257,12 @@ class Reducer:
                ) -> List[bool]:
         self.rounds += 1
         self.probes += len(candidates)
+        # client-phase candidates carry the CURRENT fault schedule
+        # along, so fault-sensitive checkers see the same windows in
+        # every probe; the fault-windows phase builds its own merges
+        nem = getattr(self, "_nemesis", None)
+        if nem and phase != "fault-windows":
+            candidates = [_merge(c, nem) for c in candidates]
         return self.probe_batch(phase, candidates)
 
     def _note(self, phase: str, n_cand: int, units: Sequence[Unit],
@@ -274,10 +361,69 @@ class Reducer:
             n = min(len(units), 2 * n)
         return units
 
+    # -- phase 4: fault windows ---------------------------------------------
+
+    def reduce_fault_windows(self, client: List[Unit]) -> List[Unit]:
+        """Shrink the nemesis schedule alongside the ops: one parallel
+        probe round asks, per fault window, whether dropping it still
+        reproduces; a window survives only if it is reproduction-
+        necessary (fault-sensitive checkers) or it OVERLAPS the minimal
+        client ops — the fault the anomaly actually lives inside stays
+        as attribution, every other window goes.  A final combined
+        probe guards against window-interaction effects (failure keeps
+        the whole schedule — conservative, never unsound).  Selection
+        is canonical-order deterministic: same history + verdicts →
+        same surviving window set at any worker count."""
+        nem = list(getattr(self, "_nemesis", ()) or ())
+        self.windows_meta: List[dict] = []
+        if not nem:
+            return client
+        wins = fault_windows(nem)
+        droppable = [False] * len(wins)
+        if self._budget_left():
+            cands = []
+            for w in wins:
+                drop = set(w)
+                cands.append(_merge(client,
+                                    [u for i, u in enumerate(nem)
+                                     if i not in drop]))
+            droppable = self._probe("fault-windows", cands)
+        lo = min((u.order for u in client), default=0)
+        hi = max((max(op.index for op in u.ops) for u in client),
+                 default=0)
+        keep: List[List[int]] = []
+        for w, drop_ok in zip(wins, droppable):
+            ops = [op.index for i in w for op in nem[i].ops]
+            overlaps = min(ops) <= hi and max(ops) >= lo
+            if not drop_ok or overlaps:
+                keep.append(w)
+        kept = [nem[i] for w in keep for i in w]
+        improved = len(kept) < len(nem)
+        if improved:
+            # the combined interaction guard is mandatory: two windows
+            # individually droppable may not be JOINTLY droppable, so
+            # an exhausted budget keeps the whole schedule rather than
+            # shipping an unconfirmed multi-window drop
+            if self._budget_left() and \
+                    self._probe("fault-windows",
+                                [_merge(client, kept)])[0]:
+                pass
+            else:
+                kept, keep, improved = nem, wins, False
+        self._note("fault-windows", len(wins), _merge(client, kept),
+                   improved)
+        self._nemesis = kept
+        self.windows_meta = window_descriptors(nem, keep)
+        return _merge(client, kept)
+
     def run(self, units: List[Unit]) -> List[Unit]:
-        units = self.drop_processes(units)
-        units = self.project_keys(units)
-        return self.ddmin(units)
+        client = [u for u in units if not is_nemesis_unit(u)]
+        self._nemesis: List[Unit] = [u for u in units
+                                     if is_nemesis_unit(u)]
+        client = self.drop_processes(client)
+        client = self.project_keys(client)
+        client = self.ddmin(client)
+        return self.reduce_fault_windows(client)
 
 
 def _split(xs: List[Unit], n: int) -> List[List[Unit]]:

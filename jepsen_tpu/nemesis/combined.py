@@ -253,10 +253,75 @@ def traffic_package(opts: dict) -> Optional[dict]:
     }
 
 
+# ------------------------------------------------------------- sim skew
+
+def skew_package(opts: dict) -> Optional[dict]:
+    """Clock-skew package for the in-process sim cluster: drives
+    :class:`~jepsen_tpu.nemesis.sim.SimClockSkewNemesis` on an
+    interval schedule (skew -> hold -> heal), FAKETIME-spec'd offsets
+    in the op values.  Fault key ``"skew"`` (the real-cluster
+    ``"clock"`` package stays separate — it needs nodes)."""
+    if "skew" not in opts.get("faults", ()):
+        return None
+    from jepsen_tpu.nemesis.sim import SimClockSkewNemesis
+
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    rng = opts.get("rng") or _random
+    return {
+        "nemesis": SimClockSkewNemesis(
+            rng if isinstance(rng, _random.Random) else None),
+        "generator": gen.cycle([gen.sleep(interval),
+                                {"f": "start-skew", "value": None},
+                                gen.sleep(interval),
+                                {"f": "stop-skew", "value": None}]),
+        "final_generator": {"f": "stop-skew", "value": None},
+        "perf": {"name": "skew", "start": {"start-skew"},
+                 "stop": {"stop-skew"}, "fs": set()},
+    }
+
+
+# ------------------------------------------------------- sim membership
+
+def membership_package(opts: dict) -> Optional[dict]:
+    """Membership-change package for the sim cluster: a
+    :class:`~jepsen_tpu.nemesis.membership.MembershipNemesis` over
+    :class:`~jepsen_tpu.nemesis.sim.SimMembershipState` (join/leave
+    against the store's member set).  Fault key ``"membership"``.
+    A db suite supplies its own state via ``opts["membership_state"]``."""
+    if "membership" not in opts.get("faults", ()):
+        return None
+    from jepsen_tpu.nemesis.membership import (MembershipNemesis,
+                                               possible_op)
+    from jepsen_tpu.nemesis.sim import SimMembershipState
+
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    state = opts.get("membership_state") or SimMembershipState(
+        opts.get("nodes") or ["n1", "n2", "n3"])
+    nem = MembershipNemesis(
+        state,
+        converge_timeout_s=opts.get("membership_timeout_s", 5.0),
+        poll_interval_s=opts.get("membership_poll_s", 0.05))
+
+    def next_change(test, ctx):
+        op = possible_op(state, test)
+        return op or {"f": "membership-view", "value": None}
+
+    return {
+        "nemesis": nem,
+        "generator": gen.cycle([gen.sleep(interval),
+                                gen.once(next_change)]),
+        "final_generator": None,
+        "perf": {"name": "membership",
+                 "start": {"leave-node", "join-node"},
+                 "stop": set(), "fs": {"membership-view"}},
+    }
+
+
 # ---------------------------------------------------------------- compose
 
 PACKAGE_FNS = [partition_package, kill_package, pause_package,
-               clock_package, file_package, traffic_package]
+               clock_package, file_package, traffic_package,
+               skew_package, membership_package]
 
 
 def _fs_of(pkg: dict) -> set:

@@ -105,6 +105,40 @@ def _verdict_cell(v: Any, error: Any = None, degraded: Any = None,
             f"{_verdict_badges(v, error, degraded, deadline)}</td>")
 
 
+def _model_anomaly_html(e: Any) -> str:
+    """Model-specific witness evidence (the invariants family): bank
+    bad-reads, long-fork/write-skew pairs, and session violations get
+    readable renderings; anything unrecognized falls back to JSON."""
+    if not isinstance(e, dict):
+        return f"<pre>{html.escape(json.dumps(e, indent=1))}</pre>"
+    if "why" in e:  # long-fork / write-skew carry their own sentence
+        extra = ""
+        if e.get("keys") is not None:
+            extra = f" <code>keys={html.escape(json.dumps(e['keys']))}</code>"
+        return (f"<li>{html.escape(str(e['why']))}{extra}</li>")
+    if "expected-total" in e:  # bank bad-read
+        neg = (f"; negative balances on accounts "
+               f"{html.escape(json.dumps(e['negative']))}"
+               if e.get("negative") else "")
+        return (f"<li>read at op {e.get('op-index')} (process "
+                f"{e.get('process')}) summed to <b>{e.get('total')}</b>, "
+                f"expected <b>{e.get('expected-total')}</b>{neg}</li>")
+    if "key" in e and "process" in e and ("rank" in e or "read" in e
+                                          or "wrote" in e):
+        # session-guarantee violation (vectorized or walker entry)
+        what = e.get("kind") or ("write" if "wrote" in e else "read")
+        detail = ", ".join(
+            f"{k}={json.dumps(e[k])}" for k in
+            ("read", "wrote", "rank", "after-reading", "after-writing",
+             "cross-key-dependency", "cross-key-prior-write")
+            if k in e)
+        return (f"<li>process {e.get('process')}, op {e.get('op')}: "
+                f"{html.escape(what)} of key "
+                f"<code>{html.escape(json.dumps(e.get('key')))}</code> "
+                f"broke the guarantee ({html.escape(detail)})</li>")
+    return f"<pre>{html.escape(json.dumps(e, indent=1))}</pre>"
+
+
 class _Handler(BaseHTTPRequestHandler):
     base: str = store.BASE  # overridden per-server
     verifier = None         # VerifierService when served with --ingest
@@ -387,13 +421,24 @@ td, th {{ border: 1px solid #bbb; padding: 3px 8px; }}
         anom_html = []
         for name, entries in sorted((w.get("anomalies") or {}).items()):
             anom_html.append(f"<h3><code>{html.escape(name)}</code></h3>")
+            items: list = []  # consecutive <li> fragments -> one <ul>
+
+            def flush_items():
+                if items:
+                    anom_html.append(f"<ul>{''.join(items)}</ul>")
+                    items.clear()
+
             for e in entries if isinstance(entries, list) else []:
                 cyc = e.get("cycle") if isinstance(e, dict) else None
                 if not cyc:
-                    anom_html.append(
-                        f"<pre>{html.escape(json.dumps(e, indent=1))}"
-                        "</pre>")
+                    frag = _model_anomaly_html(e)
+                    if frag.startswith("<li>"):
+                        items.append(frag)
+                    else:
+                        flush_items()
+                        anom_html.append(frag)
                     continue
+                flush_items()
                 steps = []
                 for edge in cyc:
                     why = edge.get("why") or json.dumps(
@@ -402,6 +447,23 @@ td, th {{ border: 1px solid #bbb; padding: 3px 8px; }}
                         f"<li><b>{html.escape(str(edge.get('rel')))}"
                         f"</b> — {html.escape(str(why))}</li>")
                 anom_html.append(f"<ol>{''.join(steps)}</ol>")
+            flush_items()
+        windows_html = ""
+        fw = w.get("fault-windows") or []
+        if fw:
+            rows = "".join(
+                f"<tr><td><code>{html.escape(str(win.get('f')))}</code>"
+                f"</td><td>{win.get('span', ['?', '?'])[0]}&ndash;"
+                f"{win.get('span', ['?', '?'])[1]}</td>"
+                f"<td>{len(win.get('ops') or ())} ops</td></tr>"
+                for win in fw)
+            windows_html = (
+                "<h2>surviving fault windows</h2>"
+                "<p>the nemesis-schedule ddmin kept these windows "
+                "(reproduction-necessary or overlapping the witness "
+                "ops); spans are source-history op indices</p>"
+                f"<table><tr><th>fault</th><th>span</th><th>ops</th>"
+                f"</tr>{rows}</table>")
         quant = " ".join(
             f"{k.replace('_', ' ')}={w[k]}" for k in
             ("probe_p50_s", "probe_p95_s") if w.get(k) is not None)
@@ -422,8 +484,9 @@ anomalies: <code>{html.escape(", ".join(w.get("anomaly-types") or ()))}
 &middot; digest <code>{html.escape(str(w.get("digest")))}</code></p>
 <table><tr><th>#</th><th>process</th><th>type</th><th>f</th>
 <th>value</th><th>error</th></tr>{"".join(op_rows)}</table>
-<h2>explained cycle</h2>
-{"".join(anom_html) or "<p>(no cycle edges reported)</p>"}
+<h2>evidence</h2>
+{"".join(anom_html) or "<p>(no anomaly evidence reported)</p>"}
+{windows_html}
 <p><a href="/files/{quote(rel)}/witness.json">witness.json</a> &middot;
 <a href="/files/{quote(rel)}/witness.jsonl">witness.jsonl</a></p>
 </body></html>"""

@@ -12,10 +12,7 @@ from __future__ import annotations
 import random
 from typing import Any, Dict, Optional
 
-import numpy as np
-
 from ..checkers import api as checker_api
-from ..history.ops import OK
 
 
 class _BankGen:
@@ -41,9 +38,12 @@ def gen(**opts) -> Any:
 
 
 class BankChecker(checker_api.Checker):
-    """Total-balance invariant over all reads (vectorised: reads become a
-    dense [n_reads, n_accounts] matrix; row sums and sign checks are one
-    numpy pass — the same shape the device fold would use).
+    """Total-balance + snapshot-read invariants, delegated to the
+    vectorized invariants family (`checkers/invariants/bank.py`): the
+    reads become a dense [n_reads, n_accounts] matrix whose row sums /
+    sign checks run as one array reduction — on the device path
+    (guarded by `resilience.device_call`, host-numpy fallback) or the
+    exact host twin.
 
     Reference `bank/checker`: :bad-reads = reads with wrong total or
     (unless negative-balances?) any negative balance."""
@@ -51,42 +51,16 @@ class BankChecker(checker_api.Checker):
     def __init__(self, *, negative_balances_ok: bool = False):
         self.negative_ok = negative_balances_ok
 
+    def name(self) -> str:
+        return "bank"
+
     def check(self, test, history, opts=None):
-        total = test.get("total-amount")
-        if total is None:
-            accounts = test.get("accounts")
-            if isinstance(accounts, dict) and accounts:
-                total = sum(accounts.values())
-        reads = [op for op in history
-                 if op.type == OK and op.f == "read"
-                 and isinstance(op.value, dict)]
-        if not reads:
-            return {"valid?": "unknown", "read-count": 0}
-        accts = sorted({a for op in reads for a in op.value})
-        mat = np.array([[op.value.get(a, 0) for a in accts] for op in reads],
-                       dtype=np.int64)
-        sums = mat.sum(axis=1)
-        if total is None:
-            # no configured total: use the modal sum, so a single
-            # anomalous read can't become the baseline
-            vals, counts = np.unique(sums, return_counts=True)
-            total = int(vals[np.argmax(counts)])
-        wrong_total = sums != total
-        negative = (mat < 0).any(axis=1) if not self.negative_ok \
-            else np.zeros(len(reads), dtype=bool)
-        bad = wrong_total | negative
-        bad_reads = [
-            {"op-index": reads[i].index, "total": int(sums[i]),
-             "expected-total": total,
-             "negative": [accts[j] for j in np.nonzero(mat[i] < 0)[0]]}
-            for i in np.nonzero(bad)[0][:8]
-        ]
-        return {
-            "valid?": not bad.any(),
-            "read-count": len(reads),
-            "bad-read-count": int(bad.sum()),
-            "bad-reads": bad_reads,
-        }
+        from ..checkers.invariants import bank as inv_bank
+
+        return inv_bank.check(
+            history, test,
+            negative_balances_ok=self.negative_ok,
+            deadline=(opts or {}).get("deadline"))
 
 
 def workload(*, n_accounts: int = 8, total: int = 80, max_transfer: int = 5,

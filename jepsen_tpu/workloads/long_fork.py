@@ -12,11 +12,9 @@ fork** is two reads that order two writes oppositely:
 from __future__ import annotations
 
 import random
-from itertools import combinations
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, List, Optional
 
 from ..checkers import api as checker_api
-from ..history.ops import OK
 
 
 class _LongForkGen:
@@ -49,53 +47,29 @@ def gen(**opts) -> Any:
 
 
 class LongForkChecker(checker_api.Checker):
-    """Finds long-fork read pairs (reference `long-fork/checker`).
+    """Finds long-fork read pairs (reference `long-fork/checker`),
+    delegated to the vectorized predicate checker
+    (`checkers/invariants/predicate.py`): group reads become boolean
+    observed/absent matrices and fork pairs fall out of a handful of
+    matrix reductions (device path guarded by `resilience.device_call`,
+    exact host twin), then the elle graph machinery confirms each fork
+    as a G-nonadjacent / G2-item cycle with per-edge evidence."""
 
-    For each pair of committed group reads over the same keys, and each
-    pair of written keys (k1, k2) both covered: if read A has k1 written
-    and k2 missing while read B has k2 written and k1 missing, the two
-    reads disagree on the write order — G2 long fork."""
+    def name(self) -> str:
+        return "long-fork"
 
     def check(self, test, history, opts=None):
-        reads: List[Any] = []
-        for op in history:
-            if op.type != OK or op.f != "txn":
-                continue
-            mops = op.value or []
-            if mops and all(m[0] == "r" for m in mops):
-                reads.append(op)
-        if not reads:
+        from ..checkers.invariants import predicate
+
+        res = predicate.check(history,
+                              deadline=(opts or {}).get("deadline"))
+        if res.get("valid?") != "unknown" and not res.get("read-count"):
             return {"valid?": "unknown", "read-count": 0}
-        forks = []
-        # Bucket reads by their key set first: reads over different key
-        # groups can never witness a fork together, so pairing is
-        # O(sum per-group n^2), not O(total-reads^2).
-        buckets: Dict[frozenset, List[int]] = {}
-        obs = [{m[1]: m[2] for m in op.value} for op in reads]
-        for i, o in enumerate(obs):
-            buckets.setdefault(frozenset(o), []).append(i)
-        pairs = (p for idxs in buckets.values()
-                 for p in combinations(idxs, 2))
-        for ia, ib in pairs:
-            a, b = reads[ia], reads[ib]
-            shared = [k for k in obs[ia] if k in obs[ib]]
-            for k1, k2 in combinations(shared, 2):
-                a1, a2 = obs[ia][k1], obs[ia][k2]
-                b1, b2 = obs[ib][k1], obs[ib][k2]
-                if a1 is not None and a2 is None \
-                        and b1 is None and b2 is not None:
-                    forks.append({"reads": [a.index, b.index],
-                                  "keys": [k1, k2]})
-                elif a1 is None and a2 is not None \
-                        and b1 is not None and b2 is None:
-                    forks.append({"reads": [a.index, b.index],
-                                  "keys": [k2, k1]})
-        return {
-            "valid?": not forks,
-            "read-count": len(reads),
-            "long-forks": forks[:8],
-            "fork-count": len(forks),
-        }
+        # legacy keys the workload tests / perf plots consume
+        res["long-forks"] = [
+            {"reads": e["reads"], "keys": e["keys"]}
+            for e in res.get("anomalies", {}).get("long-fork", ())]
+        return res
 
 
 def workload(*, group_size: int = 3,
@@ -103,4 +77,5 @@ def workload(*, group_size: int = 3,
     return {
         "generator": gen(group_size=group_size, rng=rng),
         "checker": LongForkChecker(),
+        "workload-kind": "long-fork",
     }

@@ -28,7 +28,19 @@ from jepsen_tpu.client import Client
 
 
 class MemStore:
-    """The 'cluster': a lock-protected shared state."""
+    """The 'cluster': a lock-protected shared state.
+
+    Fault surfaces (driven by the sim nemeses in `nemesis/sim.py`):
+
+    - **clock skew** (`start_skew` / `stop_skew`): while skewed, reads
+      observe a *torn* state — a seeded per-key/account mix of a
+      snapshot taken at skew start and the live state, which is what a
+      snapshot read assembled from nodes with disagreeing clocks looks
+      like.  Writes always apply to the live state, so bank totals stop
+      summing and register reads go stale — real, checker-visible
+      anomalies.
+    - **membership** (`members` set): when tracked (non-None), clients
+      bound to a node outside the set fail ops cleanly."""
 
     def __init__(self):
         self.lock = threading.Lock()
@@ -37,6 +49,40 @@ class MemStore:
         self.set_elems: set = set()
         self.queue: List[Any] = []
         self.accounts: Dict[Any, int] = {}
+        self.members: Optional[set] = None  # None = not tracked
+        self._skew: Optional[dict] = None   # snapshot state while skewed
+
+    # ---- clock-skew surface ---------------------------------------------
+    def start_skew(self, salt: float = 0.0) -> None:
+        """Snapshot the state and enter skewed-read mode.  `salt` seeds
+        which half of each read comes from the past."""
+        with self.lock:
+            self._skew = {
+                "kv": dict(self.kv),
+                "lists": {k: list(v) for k, v in self.lists.items()},
+                "accounts": dict(self.accounts),
+                "rng": random.Random(salt),
+            }
+
+    def stop_skew(self) -> None:
+        with self.lock:
+            self._skew = None
+
+    def _torn(self, live: Dict[Any, Any], snap: Dict[Any, Any]
+              ) -> Dict[Any, Any]:
+        """A read mixing snapshot and live values per key (call with
+        the lock held).  Seeded per call: roughly half of the keys come
+        from the past."""
+        rng = self._skew["rng"]
+        keys = sorted(set(live) | set(snap), key=repr)
+        out = {}
+        for k in keys:
+            src = snap if rng.random() < 0.5 else live
+            if k in src:
+                out[k] = src[k]
+            elif k in live:
+                out[k] = live[k]
+        return out
 
 
 class MemClient(Client):
@@ -59,7 +105,14 @@ class MemClient(Client):
         self.txn_kind = txn_kind  # "list-append" | "rw-register"
 
     def open(self, test, node):
-        return self  # connectionless; all "nodes" share the store
+        # connectionless — all "nodes" share the store — but each
+        # worker's handle remembers its node so membership changes can
+        # reject ops routed to a removed node
+        import copy
+
+        c = copy.copy(self)
+        c.node = node
+        return c
 
     def invoke(self, test, op):
         if self.latency:
@@ -67,6 +120,10 @@ class MemClient(Client):
         if self.fail_p and self.rng.random() < self.fail_p:
             return dict(op, type="fail", error="simulated-abort")
         s = self.store
+        members = s.members
+        if members is not None and getattr(self, "node", None) is not None \
+                and self.node not in members:
+            return dict(op, type="fail", error="node-removed")
         f = op["f"]
         v = op.get("value")
         with s.lock:
@@ -116,11 +173,18 @@ class MemClient(Client):
         if workload == "set":
             return sorted(s.set_elems)
         if workload == "bank":
+            if s._skew is not None:
+                # a "snapshot" read assembled under skewed clocks:
+                # part past, part present — totals stop conserving
+                return s._torn(s.accounts, s._skew["accounts"])
             return dict(s.accounts)
+        if s._skew is not None and s._skew["rng"].random() < 0.5:
+            return s._skew["kv"].get("x")
         return s.kv.get("x")
 
     def _apply_txn(self, mops):
         s = self.store
+        skew = s._skew
         out = []
         for mop in mops:
             kind, k, v = mop[0], mop[1], mop[2] if len(mop) > 2 else None
@@ -128,10 +192,13 @@ class MemClient(Client):
                 s.lists.setdefault(k, []).append(v)
                 out.append(["append", k, v])
             elif kind == "r":
+                stale = skew is not None and skew["rng"].random() < 0.5
                 if self.txn_kind == "rw-register":
-                    out.append(["r", k, s.kv.get(k)])
+                    src = skew["kv"] if stale else s.kv
+                    out.append(["r", k, src.get(k)])
                 else:
-                    out.append(["r", k, list(s.lists.get(k, []))])
+                    src = skew["lists"] if stale else s.lists
+                    out.append(["r", k, list(src.get(k, []))])
             elif kind == "w":
                 s.kv[k] = v
                 out.append(["w", k, v])

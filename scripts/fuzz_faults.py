@@ -158,6 +158,38 @@ def run_one(seed: int, p: float, deadline_s: float) -> dict:
     row["injected"] += len(p1.injected) + len(p3.injected)
     row["client-infos"] = row.get("client-infos", 0) + len(crashed)
 
+    # --- invariants workloads under sim nemeses (ISSUE 10 satellite) ---
+    # bank / long-fork campaign cells under the clock-skew or
+    # membership nemesis, with checker-seam chaos on top: every run
+    # must terminate with an attributable verdict — and a skewed bank
+    # run that goes invalid must be invalid for the right reason
+    import tempfile as _tf
+
+    from jepsen_tpu.campaign.plan import RunSpec, build_test
+
+    nem = {"faults": ["skew"] if seed % 2 else ["membership"],
+           "interval": 0.08}
+    for wlname in ("bank", "long-fork"):
+        rs = RunSpec(
+            run_id=f"fuzz-{wlname}-s{seed}", campaign="fuzz-inv",
+            workload=wlname, seed=seed,
+            opts={"time-limit": 0.4, "concurrency": 3, "nemesis": nem})
+        t = build_test(rs, _tf.mkdtemp(prefix="fuzz-inv-"))
+        t["faults"] = {"seed": seed + 6, "p": p, "kinds": "oom|xla"}
+        done = jcore.run(t)
+        res = done.get("results") or {}
+        assert "valid?" in res, f"{wlname}+{nem['faults'][0]}: no verdict"
+        if res["valid?"] == "unknown":
+            assert res.get("error"), \
+                f"{wlname}: unattributed unknown ({res})"
+            row["unknown"] += 1
+        elif res["valid?"] is False:
+            assert res.get("anomaly-types"), \
+                f"{wlname}: invalid with no anomaly attribution ({res})"
+        if res.get("degraded"):
+            row["degraded"] += 1
+        row["nemesis-runs"] = row.get("nemesis-runs", 0) + 1
+
     # --- flight recorder under chaos (ISSUE 5 satellite) ---------------
     # every faulted / deadline-killed TELEMETRIC run must still leave a
     # well-formed (tail-truncated at worst) events.jsonl: parseable,

@@ -1,0 +1,789 @@
+"""checkers/invariants/ — the vectorized consistency-model family.
+
+Completeness is pinned by seeded anomaly corpora (ISSUE 10 acceptance):
+every injected anomaly class (balance violation, write-skew pair,
+long-fork split, session-guarantee break) must be detected by its
+checker, clean control histories must verify valid, and the device
+path's verdict must equal the host oracle twin's on every corpus entry.
+Plus: the fault-window ddmin, the sim nemeses, campaign plan
+validation, and the models-matrix flywheel smoke.
+"""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.invariants import bank as inv_bank
+from jepsen_tpu.checkers.invariants import packed as inv_packed
+from jepsen_tpu.checkers.invariants import predicate as inv_pred
+from jepsen_tpu.checkers.invariants import session as inv_sess
+from jepsen_tpu.history.ops import INVOKE, OK, History, Op
+
+SEEDS = [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# corpus builders: valid histories + surgical injectors
+# ---------------------------------------------------------------------------
+
+def bank_history(n_ops=60, n_accounts=4, balance=10, seed=0) -> History:
+    """Serial bank history: transfers conserve, reads snapshot."""
+    rng = random.Random(seed)
+    accounts = {i: balance for i in range(n_accounts)}
+    ops = []
+    for i in range(n_ops):
+        p = rng.randrange(3)
+        if rng.random() < 0.5:
+            ops.append(Op(type=INVOKE, process=p, f="read", value=None))
+            ops.append(Op(type=OK, process=p, f="read",
+                          value=dict(accounts)))
+        else:
+            frm, to = rng.sample(range(n_accounts), 2)
+            amt = 1 + rng.randrange(4)
+            v = {"from": frm, "to": to, "amount": amt}
+            ops.append(Op(type=INVOKE, process=p, f="transfer", value=v))
+            if accounts[frm] >= amt:
+                accounts[frm] -= amt
+                accounts[to] += amt
+                ops.append(Op(type=OK, process=p, f="transfer", value=v))
+            else:
+                ops.append(Op(type="fail", process=p, f="transfer",
+                              value=v, error="insufficient"))
+    return History(ops)
+
+
+def inject_bank_wrong_total(h: History, seed=0) -> History:
+    rng = random.Random(seed)
+    reads = [op for op in h.ops if op.type == OK and op.f == "read"]
+    op = reads[rng.randrange(len(reads))]
+    a = sorted(op.value)[0]
+    op.value[a] += 3  # breaks conservation, stays non-negative
+    return h
+
+def inject_bank_negative(h: History, seed=0) -> History:
+    rng = random.Random(seed)
+    reads = [op for op in h.ops if op.type == OK and op.f == "read"]
+    op = reads[rng.randrange(len(reads))]
+    a, b = sorted(op.value)[:2]
+    shift = op.value[a] + 5
+    op.value[a] -= shift  # negative, but the TOTAL is conserved
+    op.value[b] += shift
+    return h
+
+
+def lf_history(groups=3, group_size=3, n_reads=12, seed=0) -> History:
+    """Serial long-fork history: each key written once, group reads
+    observe the committed prefix."""
+    rng = random.Random(seed)
+    ops = []
+    written = {}
+    keys = list(range(groups * group_size))
+    to_write = list(keys)
+    rng.shuffle(to_write)
+    p = 0
+
+    def group_read():
+        g = rng.randrange(groups)
+        ks = range(g * group_size, (g + 1) * group_size)
+        mops = [["r", k, written.get(k)] for k in ks]
+        inv = [["r", k, None] for k in ks]
+        return inv, mops
+
+    reads_done = 0
+    while to_write or reads_done < n_reads:
+        p = (p + 1) % 4
+        if to_write and (reads_done >= n_reads or rng.random() < 0.5):
+            k = to_write.pop()
+            ops.append(Op(type=INVOKE, process=p, f="txn",
+                          value=[["w", k, k]]))
+            ops.append(Op(type=OK, process=p, f="txn",
+                          value=[["w", k, k]]))
+            written[k] = k
+        else:
+            inv, mops = group_read()
+            ops.append(Op(type=INVOKE, process=p, f="txn", value=inv))
+            ops.append(Op(type=OK, process=p, f="txn", value=mops))
+            reads_done += 1
+    return History(ops)
+
+
+def inject_long_fork(h: History) -> History:
+    """Split two reads of one group: read A forgets k2, read B forgets
+    k1 — the two now order the writes oppositely."""
+    reads = [op for op in h.ops
+             if op.type == OK and op.f == "txn"
+             and all(m[0] == "r" for m in (op.value or []))]
+    for ia in range(len(reads)):
+        for ib in range(ia + 1, len(reads)):
+            a, b = reads[ia], reads[ib]
+            ka = {m[1] for m in a.value}
+            if ka != {m[1] for m in b.value}:
+                continue
+            obs_a = {m[1] for m in a.value if m[2] is not None}
+            obs_b = {m[1] for m in b.value if m[2] is not None}
+            both = sorted(obs_a & obs_b)
+            if len(both) < 2:
+                continue
+            k1, k2 = both[:2]
+            for m in a.value:
+                if m[1] == k2:
+                    m[2] = None
+            for m in b.value:
+                if m[1] == k1:
+                    m[2] = None
+            return h
+    raise AssertionError("corpus has no injectable read pair")
+
+
+def ws_history(pairs=2, n_txns=20, seed=0) -> History:
+    """Serial write-skew-workload history (valid): read the pair,
+    write one key."""
+    rng = random.Random(seed)
+    kv = {}
+    ops = []
+    val = 0
+    for i in range(n_txns):
+        p = rng.randrange(3)
+        g = rng.randrange(pairs)
+        k1, k2 = 2 * g, 2 * g + 1
+        inv = [["r", k1, None], ["r", k2, None]]
+        mops = [["r", k1, kv.get(k1)], ["r", k2, kv.get(k2)]]
+        if rng.random() < 0.8:
+            w = rng.choice((k1, k2))
+            inv.append(["w", w, val])
+            mops.append(["w", w, val])
+            kv[w] = val
+            val += 1
+        ops.append(Op(type=INVOKE, process=p, f="txn", value=inv))
+        ops.append(Op(type=OK, process=p, f="txn", value=mops))
+    return History(ops)
+
+
+def inject_write_skew(h: History) -> History:
+    """Rewrite two updating txns of one pair into the classic skew:
+    both read the same pre-state, each writes a different key."""
+    upd = [op for op in h.ops if op.type == OK and op.f == "txn"
+           and any(m[0] == "w" for m in op.value)]
+    for ia in range(len(upd)):
+        for ib in range(ia + 1, len(upd)):
+            a, b = upd[ia], upd[ib]
+            ga = {m[1] // 2 for m in a.value}
+            gb = {m[1] // 2 for m in b.value}
+            if len(ga) == 1 and ga == gb:
+                g = next(iter(ga))
+                k1, k2 = 2 * g, 2 * g + 1
+                # pre-state: what the FIRST txn read
+                pre = {m[1]: m[2] for m in a.value if m[0] == "r"}
+                wa = next(m for m in a.value if m[0] == "w")
+                wb = next(m for m in b.value if m[0] == "w")
+                if wa[1] == wb[1]:
+                    wb[1] = k2 if wa[1] == k1 else k1
+                # both read the identical pre-state (so each misses
+                # the other's write), write different keys
+                for m in b.value:
+                    if m[0] == "r":
+                        m[2] = pre[m[1]]
+                # later reads must not re-anchor b's write after a's:
+                # drop b's written value from any later read
+                for op in h.ops:
+                    if op is a or op is b or op.type != OK \
+                            or op.f != "txn":
+                        continue
+                    for m in op.value:
+                        if m[0] == "r" and m[1] == wb[1] \
+                                and m[2] == wb[2]:
+                            m[2] = pre.get(m[1])
+                return h
+    raise AssertionError("corpus has no injectable txn pair")
+
+
+def sess_history(n_keys=3, n_txns=30, seed=0, pin_keys=False) -> History:
+    """Serial session history: rmw chains + reads (valid).
+
+    ``pin_keys=True`` gives every process its own key (single-key
+    sessions — the shape the vectorized pass owns; multi-key WRITER
+    sessions register cross-key obligations and route to the exact DAG
+    walker)."""
+    rng = random.Random(seed)
+    kv = {}
+    ops = []
+    val = 0
+    for i in range(n_txns):
+        p = rng.randrange(3)
+        k = p % n_keys if pin_keys else rng.randrange(n_keys)
+        if rng.random() < 0.6:
+            mops = [["r", k, kv.get(k)], ["w", k, val]]
+            inv = [["r", k, None], ["w", k, val]]
+            kv[k] = val
+            val += 1
+        else:
+            mops = [["r", k, kv.get(k)]]
+            inv = [["r", k, None]]
+        ops.append(Op(type=INVOKE, process=p, f="txn", value=inv))
+        ops.append(Op(type=OK, process=p, f="txn", value=mops))
+    return History(ops)
+
+
+def inject_session_break(h: History) -> History:
+    """Make one process's LATER read of a key observe an EARLIER
+    version it had already read past (monotonic-reads break)."""
+    per_proc = {}
+    for op in h.ops:
+        if op.type == OK and op.f == "txn":
+            for m in op.value:
+                if m[0] == "r" and m[2] is not None:
+                    per_proc.setdefault((op.process, m[1]),
+                                        []).append((op, m))
+    for (p, k), evs in sorted(per_proc.items(), key=repr):
+        if len(evs) >= 2:
+            prior_val = evs[-2][1][2]
+            last_op, last_m = evs[-1]
+            # rewind the session's LAST read to the initial state —
+            # strictly earlier than the prior read's version — inside
+            # a pure-read txn (so no other chain is disturbed)
+            if prior_val is not None and len(last_op.value) == 1:
+                last_m[2] = None
+                return h
+    raise AssertionError("corpus has no injectable session pair")
+
+
+# ---------------------------------------------------------------------------
+# completeness: every injected class detected; clean controls valid;
+# device verdict == host oracle twin, verdict-for-verdict
+# ---------------------------------------------------------------------------
+
+def _pin_device_host(check_fn, h, **kw):
+    dev = check_fn(h, use_device=True, **kw)
+    host = check_fn(h, use_device=False, **kw)
+    assert dev["valid?"] == host["valid?"], (dev, host)
+    assert dev["anomaly-types"] == host["anomaly-types"], (dev, host)
+    return dev
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_bank_clean_and_injected(seed):
+    t = {"total-amount": 40}
+    clean = bank_history(seed=seed)
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_bank.check(
+            h, t, use_device=use_device), clean)
+    assert dev["valid?"] is True
+
+    bad = inject_bank_wrong_total(bank_history(seed=seed), seed)
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_bank.check(
+            h, t, use_device=use_device), bad)
+    assert dev["valid?"] is False
+    assert "bank-wrong-total" in dev["anomaly-types"]
+    assert dev["bad-reads"][0]["expected-total"] == 40
+
+    neg = inject_bank_negative(bank_history(seed=seed), seed)
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_bank.check(
+            h, t, use_device=use_device), neg)
+    assert dev["valid?"] is False
+    assert dev["anomaly-types"] == ["bank-negative-balance"]
+    # the negative-balances-ok workload variant accepts it
+    ok = inv_bank.check(neg, t, negative_balances_ok=True)
+    assert ok["valid?"] is True
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_long_fork_clean_and_injected(seed):
+    clean = lf_history(seed=seed)
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_pred.check(
+            h, use_device=use_device), clean)
+    assert dev["valid?"] is True, dev
+
+    forked = inject_long_fork(lf_history(seed=seed))
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_pred.check(
+            h, use_device=use_device), forked)
+    assert dev["valid?"] is False
+    assert "long-fork" in dev["anomaly-types"]
+    wit = dev["anomalies"]["long-fork"][0]
+    assert len(wit["reads"]) == 2 and len(wit["keys"]) == 2
+    assert "why" in wit
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_write_skew_clean_and_injected(seed):
+    clean = ws_history(seed=seed)
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_pred.check(
+            h, use_device=use_device), clean)
+    assert dev["valid?"] is True, dev
+
+    skewed = inject_write_skew(ws_history(seed=seed))
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_pred.check(
+            h, use_device=use_device), skewed)
+    assert dev["valid?"] is False
+    assert "write-skew" in dev["anomaly-types"], dev
+    # the graph confirmation reports the G2 cycle with edge evidence
+    cyc_names = [n for n in dev["anomaly-types"]
+                 if n in ("G2-item", "G-nonadjacent", "G-single")]
+    assert cyc_names, dev
+    cyc = dev["anomalies"][cyc_names[0]][0]["cycle"]
+    assert any("why" in e for e in cyc)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_session_clean_and_injected(seed):
+    clean = sess_history(seed=seed, pin_keys=True)
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_sess.check(
+            h, use_device=use_device), clean)
+    assert dev["valid?"] is True, dev
+    assert not dev.get("fallback")  # single-key rmw chains vectorize
+
+    broken = inject_session_break(sess_history(seed=seed,
+                                               pin_keys=True))
+    dev = _pin_device_host(
+        lambda h, use_device, **kw: inv_sess.check(
+            h, use_device=use_device), broken)
+    assert dev["valid?"] is False
+    assert not dev.get("fallback")
+    assert "monotonic-reads-violation" in dev["anomaly-types"], dev
+
+
+def test_session_agrees_with_dag_walker():
+    """On single-key-session histories the vectorized pass and the
+    exact DAG walker must agree on the anomaly set."""
+    from jepsen_tpu.checkers.elle import sessions as walker
+
+    for seed in SEEDS:
+        broken = inject_session_break(sess_history(seed=seed,
+                                                   pin_keys=True))
+        vec = inv_sess.check(broken, use_device=False)
+        assert not vec.get("fallback")
+        ref = walker.check(broken)
+        assert vec["valid?"] == ref["valid?"]
+        assert vec["anomaly-types"] == ref["anomaly-types"]
+
+
+def test_session_cross_key_sessions_use_walker():
+    """Multi-key WRITER sessions register cross-key obligations only
+    the DAG walker checks — those histories must route to it, and the
+    verdict must equal the walker's by construction."""
+    from jepsen_tpu.checkers.elle import sessions as walker
+
+    broken = inject_session_break(sess_history(seed=0))
+    res = inv_sess.check(broken)
+    assert res.get("fallback") == "dag-walker"
+    ref = walker.check(broken)
+    assert res["valid?"] == ref["valid?"]
+    assert res["anomaly-types"] == ref["anomaly-types"]
+
+
+def test_session_branched_falls_back_to_walker():
+    ops = []
+
+    def txn(p, filled):
+        ops.append(Op(type=INVOKE, process=p, f="txn",
+                      value=[[m[0], m[1],
+                              None if m[0] == "r" else m[2]]
+                             for m in filled]))
+        ops.append(Op(type=OK, process=p, f="txn", value=filled))
+
+    txn(0, [["r", 0, None], ["w", 0, 1]])
+    txn(0, [["w", 0, 2]])  # blind write: init branches
+    res = inv_sess.check(History(ops))
+    assert res.get("fallback") == "dag-walker"
+
+
+def test_long_fork_vectorized_matches_pairwise_oracle():
+    """The bucketed matrix pass against the quadratic reference scan,
+    over seeded corpora (clean + injected)."""
+    for seed in SEEDS:
+        for h in (lf_history(seed=seed),
+                  inject_long_fork(lf_history(seed=seed))):
+            vec, n_reads, _ = inv_pred.long_forks(
+                inv_packed.pack_rw(h), use_device=False)
+            ref = inv_pred.oracle_long_forks(h)
+            assert bool(vec) == bool(ref), (vec, ref)
+            assert n_reads > 0
+            # every vectorized fork names a key pair the oracle also
+            # implicates (witness choice may differ)
+            ref_keys = {frozenset(f["keys"]) for f in ref}
+            for f in vec:
+                assert frozenset(f["keys"]) in ref_keys
+
+
+# ---------------------------------------------------------------------------
+# resilience: guarded device seam + deadline contract
+# ---------------------------------------------------------------------------
+
+def test_bank_device_fault_degrades_to_host():
+    from jepsen_tpu.resilience import FaultPlan, RetryPolicy
+
+    t = {"total-amount": 40}
+    bad = inject_bank_wrong_total(bank_history(seed=1), 1)
+    plan = FaultPlan(seed=3, persistent=("invariants.bank",),
+                     kinds=("oom",))
+    res = inv_bank.check(bad, t, plan=plan,
+                         policy=RetryPolicy(max_attempts=2,
+                                            base_delay_s=0.0, seed=0))
+    assert res["valid?"] is False
+    assert res.get("degraded") == "host-fallback"
+    assert "bank-wrong-total" in res["anomaly-types"]
+
+
+def test_predicate_deadline_returns_attributable_unknown():
+    from jepsen_tpu.resilience import Deadline
+
+    h = inject_long_fork(lf_history(seed=0))
+    res = inv_pred.check(h, deadline=Deadline(0.0))
+    assert res["valid?"] == "unknown"
+    assert "deadline" in str(res.get("error"))
+
+
+# ---------------------------------------------------------------------------
+# packed core
+# ---------------------------------------------------------------------------
+
+def test_pack_bank_shapes():
+    h = bank_history(n_ops=30, seed=2)
+    pb = inv_packed.pack_bank(h)
+    assert pb.balances.shape == (pb.n_reads, pb.n_accounts)
+    assert pb.n_reads > 0 and pb.n_accounts == 4
+    # committed reads only; every row sums to the conserved total
+    assert (pb.balances.sum(axis=1) == 40).all()
+    assert len(pb.tr_type) > 0
+
+
+def test_infer_rw_chain_ranks():
+    h = sess_history(seed=0)
+    p = inv_packed.pack_rw(h)
+    inf = inv_packed.infer_rw(p)
+    assert inf.chain_ok.all()
+    # ranks: init is 0, written versions positive, per key contiguous
+    V = p.n_vals
+    assert (inf.chain_rank[V:] == 0).all()
+    assert (inf.chain_rank[:V] > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# fault-window ddmin
+# ---------------------------------------------------------------------------
+
+def _nem(f, idx):
+    return [Op(type=INVOKE, process="nemesis", f=f, value=None),
+            Op(type="info", process="nemesis", f=f, value=None)]
+
+
+def _windowed_bank_history():
+    """Three skew windows; the bad read sits inside the SECOND."""
+    ops = []
+
+    def read(p, v):
+        ops.append(Op(type=INVOKE, process=p, f="read", value=None))
+        ops.append(Op(type=OK, process=p, f="read", value=dict(v)))
+
+    good = {0: 10, 1: 10}
+    ops += _nem("start-skew", 0) + _nem("stop-skew", 0)   # window 1
+    read(0, good)
+    ops += _nem("start-skew", 0)                          # window 2
+    read(1, {0: 10, 1: 7})                                # bad read
+    ops += _nem("stop-skew", 0)
+    read(0, good)
+    ops += _nem("start-skew", 0) + _nem("stop-skew", 0)   # window 3
+    read(2, good)
+    return History(ops)
+
+
+def test_fault_window_ddmin_keeps_overlapping_window(tmp_path):
+    from jepsen_tpu import minimize
+    from jepsen_tpu.workloads.bank import BankChecker
+
+    h = _windowed_bank_history()
+    test = {"name": "win", "store-dir": str(tmp_path / "s"),
+            "history": h, "checker": BankChecker(),
+            "total-amount": 20, "workload-kind": "bank"}
+    s1 = minimize.shrink(dict(test), workers=1, force=True)
+    assert s1["valid?"] is False
+    wins = s1["fault-windows"]
+    assert len(wins) == 1, wins  # only the overlapping window survives
+    assert wins[0]["f"] == "start-skew"
+    nem_ops = [op for op in s1["witness-history"]
+               if op.process == "nemesis"]
+    assert len(nem_ops) == 4  # start pair + stop pair
+    # digest-stable at any worker count, windows included
+    s3 = minimize.shrink(dict(test), workers=3, force=True)
+    assert s3["digest"] == s1["digest"]
+    assert s3["fault-windows"] == wins
+
+
+def test_fault_windows_grouping():
+    from jepsen_tpu.minimize import reduce as reduce_mod
+
+    h = _windowed_bank_history()
+    units = reduce_mod.units_of(h)
+    nem = [u for u in units if reduce_mod.is_nemesis_unit(u)]
+    wins = reduce_mod.fault_windows(nem)
+    assert len(wins) == 3
+    desc = reduce_mod.window_descriptors(nem, wins)
+    assert all(d["f"] == "start-skew" for d in desc)
+    assert all(d["span"][0] < d["span"][1] for d in desc)
+
+
+def test_one_shot_faults_are_own_windows():
+    from jepsen_tpu.minimize import reduce as reduce_mod
+
+    ops = (_nem("leave-node", 0) + _nem("join-node", 0)
+           + _nem("start-skew", 0) + _nem("bump-clock", 0)
+           + _nem("stop-skew", 0) + _nem("leave-node", 0))
+    units = reduce_mod.units_of(History(ops))
+    wins = reduce_mod.fault_windows(units)
+    # leave, join, [start..bump..stop], leave
+    assert [len(w) for w in wins] == [1, 1, 3, 1]
+
+
+def test_interleaved_package_windows_pair_by_family():
+    """Composed packages interleave: stop-skew must close start-skew,
+    not the partition window opened in between."""
+    from jepsen_tpu.minimize import reduce as reduce_mod
+
+    ops = (_nem("start-skew", 0) + _nem("start-partition", 0)
+           + _nem("stop-skew", 0) + _nem("stop-partition", 0))
+    units = reduce_mod.units_of(History(ops))
+    wins = reduce_mod.fault_windows(units)
+    desc = reduce_mod.window_descriptors(units, wins)
+    fams = sorted((d["f"], len(w)) for d, w in zip(desc, wins))
+    assert fams == [("start-partition", 2), ("start-skew", 2)]
+    # and a bare stop with no family match still closes the most
+    # recent open window rather than orphaning
+    ops = _nem("start-skew", 0) + _nem("fast", 0)
+    units = reduce_mod.units_of(History(ops))
+    assert [len(w) for w in reduce_mod.fault_windows(units)] == [2]
+
+
+# ---------------------------------------------------------------------------
+# sim nemeses
+# ---------------------------------------------------------------------------
+
+def test_sim_skew_nemesis_tears_bank_reads():
+    from jepsen_tpu.nemesis.sim import SimClockSkewNemesis
+    from jepsen_tpu.workloads.mem import MemClient, MemStore
+
+    s = MemStore()
+    s.accounts = {0: 10, 1: 10}
+    c = MemClient(s).open({"nodes": ["n1"]}, "n1")
+    t = {"client": c, "workload-kind": "bank", "nodes": ["n1"]}
+    nem = SimClockSkewNemesis(random.Random(0))
+    comp = nem.invoke(t, {"f": "start-skew", "value": None,
+                          "type": "invoke"})
+    assert comp["type"] == "info"
+    assert "faketime" in comp["value"]  # FAKETIME-spec'd offset
+    # move money, then read under skew: some reads tear
+    for i in range(6):
+        c.invoke(t, {"f": "transfer",
+                     "value": {"from": 0, "to": 1, "amount": 2}})
+    sums = {sum(c.invoke(t, {"f": "read", "value": None})["value"]
+                .values()) for _ in range(16)}
+    assert any(x != 20 for x in sums), sums
+    nem.invoke(t, {"f": "stop-skew", "value": None, "type": "invoke"})
+    assert sum(c.invoke(t, {"f": "read", "value": None})["value"]
+               .values()) == 20
+
+
+def test_sim_membership_removed_node_fails_cleanly():
+    from jepsen_tpu.nemesis.membership import MembershipNemesis
+    from jepsen_tpu.nemesis.sim import SimMembershipState
+    from jepsen_tpu.workloads.mem import MemClient, MemStore
+
+    s = MemStore()
+    nodes = ["n1", "n2"]
+    c1 = MemClient(s).open({}, "n1")
+    c2 = MemClient(s).open({}, "n2")
+    t = {"client": c1, "nodes": nodes}
+    nem = MembershipNemesis(SimMembershipState(nodes),
+                            converge_timeout_s=2.0,
+                            poll_interval_s=0.01).setup(t)
+    comp = nem.invoke(t, {"f": "leave-node", "value": "n2",
+                          "type": "invoke"})
+    assert comp["type"] == "ok" and comp["value"]["converged"]
+    r = c2.invoke(t, {"f": "txn", "value": [["r", 0, None]]})
+    assert r["type"] == "fail" and r["error"] == "node-removed"
+    assert c1.invoke(t, {"f": "txn",
+                         "value": [["r", 0, None]]})["type"] == "ok"
+    # rejoin heals
+    comp = nem.invoke(t, {"f": "join-node", "value": "n2",
+                          "type": "invoke"})
+    assert comp["type"] == "ok"
+    assert c2.invoke(t, {"f": "txn",
+                         "value": [["r", 0, None]]})["type"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# campaign plan validation (the bare-resolution-error fix)
+# ---------------------------------------------------------------------------
+
+def test_expand_names_unknown_workload():
+    from jepsen_tpu.campaign import plan as plan_mod
+
+    with pytest.raises(ValueError) as ei:
+        plan_mod.expand({"name": "x", "workloads": ["bankk"],
+                         "seeds": [0]})
+    msg = str(ei.value)
+    assert "bankk" in msg
+    assert "registered workloads" in msg
+    assert "bank" in msg and "noop" in msg  # the list is actually there
+
+
+def test_cli_campaign_rejects_unknown_workload(tmp_path, capsys):
+    """The CLI surfaces plan-time validation as a clean exit-2 error
+    naming the workload — not a mid-fleet traceback."""
+    from jepsen_tpu import cli
+
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"name": "bad", "workloads": ["bankk"],
+                             "seeds": [0]}))
+    rc = cli.run(cli.single_test_cmd(lambda o: o),
+                 ["--store-dir", str(tmp_path), "campaign", "run",
+                  str(p)])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "bankk" in err and "registered workloads" in err
+
+
+def test_registered_workloads_pass_validation():
+    from jepsen_tpu.campaign import plan as plan_mod
+
+    plan_mod.register_workload("inv-test-wl", lambda o: {})
+    try:
+        specs = plan_mod.expand({"name": "x",
+                                 "workloads": ["inv-test-wl"],
+                                 "seeds": [0]})
+        assert len(specs) == 1
+    finally:
+        plan_mod._EXTRA_WORKLOADS.pop("inv-test-wl", None)
+
+
+def test_new_workloads_classified_device():
+    from jepsen_tpu.campaign import plan as plan_mod
+
+    specs = plan_mod.expand({"name": "x",
+                             "workloads": ["bank", "write-skew",
+                                           "session", "long-fork"],
+                             "seeds": [0]})
+    assert all(rs.device for rs in specs)
+
+
+# ---------------------------------------------------------------------------
+# the flywheel, end to end: models-matrix campaign -> invalid cell ->
+# auto-shrink -> fault-window-minimized witness -> web witness page
+# ---------------------------------------------------------------------------
+
+SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "specs", "models-matrix.json")
+
+
+@pytest.fixture(scope="module")
+def models_matrix_store(tmp_path_factory):
+    from jepsen_tpu import cli
+
+    base = str(tmp_path_factory.mktemp("models"))
+    rc = cli.run(cli.single_test_cmd(lambda o: o),
+                 ["--store-dir", base, "campaign", "run", SPEC_PATH,
+                  "--workers", "2"])
+    return base, rc
+
+
+def test_models_matrix_campaign_smoke(models_matrix_store):
+    from jepsen_tpu.campaign import core as ccore
+    from jepsen_tpu.campaign import plan as plan_mod
+    from jepsen_tpu.campaign.index import Index
+
+    base, rc = models_matrix_store
+    assert rc == 1  # invalid cells exist, and that's the exit contract
+    spec = plan_mod.load_spec(SPEC_PATH)
+    idx = Index(ccore.index_path(spec["name"], base))
+    specs = plan_mod.expand(spec)
+    assert idx.completed_ids() == {rs.run_id for rs in specs}
+    by_label = {}
+    for rec in idx.records:
+        by_label.setdefault(rec["workload"], []).append(rec)
+        assert rec["valid?"] in (True, False, "unknown")
+    # the bank-under-skew cells produce real invalid histories with
+    # auto-shrunk witnesses whose fault windows are recorded
+    bank_skew = [r for r in by_label.get("bank-skew", ())
+                 if r["valid?"] is False]
+    assert bank_skew, by_label.get("bank-skew")
+    wit = bank_skew[0].get("witness")
+    assert wit and wit.get("ops"), wit
+    assert "bank-wrong-total" in (wit.get("anomaly-types") or ())
+
+
+def test_models_matrix_witness_page_and_windows(models_matrix_store):
+    import urllib.request
+
+    from jepsen_tpu import web
+    from jepsen_tpu.campaign import core as ccore
+    from jepsen_tpu.campaign import plan as plan_mod
+    from jepsen_tpu.campaign.index import Index
+    from jepsen_tpu.minimize import load_witness
+
+    base, _ = models_matrix_store
+    spec = plan_mod.load_spec(SPEC_PATH)
+    idx = Index(ccore.index_path(spec["name"], base))
+    rec = next(r for r in idx.records
+               if r["workload"] == "bank-skew" and r["valid?"] is False
+               and (r.get("witness") or {}).get("ops"))
+    d = os.path.join(base, rec["dir"])
+    w = load_witness(d)
+    assert w is not None
+    assert w.get("fault-windows") is not None  # meta records the set
+    srv = web.serve(port=0, base=base, background=True)
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/run/{rec['dir']}/witness",
+                timeout=10) as resp:
+            body = resp.read().decode()
+        assert "minimal witness" in body
+        assert "expected" in body  # the bank bad-read rendering
+        if w.get("fault-windows"):
+            assert "surviving fault windows" in body
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_models_matrix_gate_applies_to_checker_spans(
+        models_matrix_store, tmp_path):
+    """`cli obs gate` evaluates the new checker spans: with only one
+    generation it must exit 2 (cannot evaluate) with a reason — the
+    applicability contract — and after a second generation it
+    evaluates to a real verdict (0 or 1, never a crash)."""
+    from jepsen_tpu import cli
+    from jepsen_tpu.campaign import core as ccore
+
+    base, _ = models_matrix_store
+    rc = cli.run(cli.single_test_cmd(lambda o: o),
+                 ["--store-dir", base, "obs", "ingest"])
+    assert rc == 0
+    rc = cli.run(cli.single_test_cmd(lambda o: o),
+                 ["--store-dir", base, "obs", "gate",
+                  "--campaign", "models-matrix",
+                  "--span", "check:bank", "--min-runs", "2"])
+    assert rc == 2  # one generation: cannot evaluate, never silent
+    # second generation (shrink off: the spans under test are the
+    # checkers'), then the gate has a real before/after to compare
+    spec = json.load(open(SPEC_PATH))
+    spec["opts"].pop("shrink", None)
+    p2 = tmp_path / "gen2.json"
+    p2.write_text(json.dumps(spec))
+    rc = cli.run(cli.single_test_cmd(lambda o: o),
+                 ["--store-dir", base, "campaign", "run", str(p2),
+                  "--workers", "2", "--rerun"])
+    assert rc in (0, 1)
+    rc = cli.run(cli.single_test_cmd(lambda o: o),
+                 ["--store-dir", base, "obs", "gate",
+                  "--campaign", "models-matrix",
+                  "--span", "check:bank", "--min-runs", "2"])
+    assert rc in (0, 1)
