@@ -142,6 +142,12 @@ def execute_run(rs: RunSpec, base: str) -> Dict[str, Any]:
         "wall_s": round(time.monotonic() - t0, 3),
         "spans": _spans_from_dir(d),
     }
+    if rs.opts.get("nemesis-windows"):
+        # the installed window set's identity: what the soak compares
+        # between a fleet-distributed cell and its single-process twin,
+        # straight off the index record
+        rec["windows-digest"] = plan_mod.windows_digest(
+            rs.opts["nemesis-windows"])
     if rec["valid?"] is False and rs.opts.get("shrink"):
         rec["witness"] = _auto_shrink(rs, done, d)
     return rec
@@ -173,10 +179,21 @@ def _auto_shrink(rs: RunSpec, done: dict, d: str) -> Optional[dict]:
         return {"error": f"{type(e).__name__}: {e}"}
     if s.get("error"):
         return {"error": s["error"]}
-    return {"ops": s.get("ops"), "source-ops": s.get("source-ops"),
-            "digest": s.get("digest"),
-            "anomaly-types": s.get("anomaly-types"),
-            "probes": s.get("probes"), "cached": bool(s.get("cached"))}
+    out = {"ops": s.get("ops"), "source-ops": s.get("source-ops"),
+           "digest": s.get("digest"),
+           "anomaly-types": s.get("anomaly-types"),
+           "probes": s.get("probes"), "cached": bool(s.get("cached"))}
+    fw = s.get("fault-windows")
+    if fw:
+        # the surviving window identities ride the index record too, so
+        # cross-host witness comparisons (distributed vs single-process
+        # of the same spec + seed) need only the campaign ledger — the
+        # full descriptors stay in witness.json
+        out["fault-windows"] = [
+            {k: w.get(k) for k in ("f", "pos", "digest", "fault",
+                                   "host", "kept") if w.get(k)
+             is not None} for w in fw]
+    return out
 
 
 def summarize(spec: Union[str, dict], base: Optional[str] = None,

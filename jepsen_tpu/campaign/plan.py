@@ -36,7 +36,8 @@ from typing import Any, Callable, Dict, List, Optional, Union
 from jepsen_tpu.resilience import faults as faults_mod
 
 __all__ = ["RunSpec", "expand", "load_spec", "spec_digest",
-           "build_test", "register_workload", "DEVICE_WORKLOADS"]
+           "build_test", "register_workload", "DEVICE_WORKLOADS",
+           "schedule_windows", "windows_digest"]
 
 #: workload names whose checkers dispatch to the device pipelines (elle
 #: list-append/rw-register, knossos device WGL, the invariants family)
@@ -132,7 +133,116 @@ def load_spec(spec: Union[str, dict]) -> dict:
     seeds = out.get("seeds") or [0]
     out["seeds"] = _uniq([int(s) for s in seeds])
     out["opts"] = dict(out.get("opts") or {})
+    out["nemesis-schedule"] = _norm_schedule(out.get("nemesis-schedule"))
+    if out["nemesis-schedule"] is None:
+        out.pop("nemesis-schedule")
     return out
+
+
+def _norm_schedule(s: Union[None, dict]) -> Optional[dict]:
+    """Normalize + validate the campaign-level ``"nemesis-schedule"``
+    block (ISSUE 11 tentpole): generation-scoped fault windows every
+    cell of generation *g* (= the seed axis) experiences identically,
+    whether the campaign runs single-process or distributed over a
+    fleet.  Keys:
+
+        faults    list of window-able fault families (validated against
+                  `nemesis.combined.WINDOW_FAULTS`)
+        windows   int, windows per generation (round-robin over faults)
+        interval  float s, nominal gap before/between windows
+        duration  float s, how long each window stays open
+        seed      int, the schedule seed — combined with the generation
+                  so each generation draws its own (replayable) layout
+        plan      optional resilience FaultPlan spec template; each
+                  generation installs it with a generation-derived seed
+                  (see `resilience.faults.seeded_for`)
+    """
+    if not s:
+        return None
+    if not isinstance(s, dict):
+        raise ValueError('"nemesis-schedule" must be a dict, got '
+                         f"{type(s).__name__}")
+    from jepsen_tpu.nemesis.combined import WINDOW_FAULTS
+
+    faults = s.get("faults")
+    if isinstance(faults, str):
+        faults = [faults]
+    faults = [str(f) for f in (faults or ())]
+    if not faults:
+        raise ValueError('"nemesis-schedule" needs a non-empty '
+                         '"faults" list')
+    unknown = [f for f in faults if f not in WINDOW_FAULTS]
+    if unknown:
+        raise ValueError(
+            f"unknown nemesis-schedule fault(s) {unknown}; window-able "
+            f"families: {sorted(WINDOW_FAULTS)}")
+    out = {
+        "faults": faults,
+        "windows": max(1, int(s.get("windows", 1))),
+        "interval": float(s.get("interval", 0.25)),
+        "duration": float(s.get("duration", s.get("interval", 0.25))),
+        "seed": int(s.get("seed", 0)),
+    }
+    if out["interval"] < 0 or out["duration"] < 0:
+        # a negative duration would sort a window's heal BEFORE its
+        # start — fail at plan time like every other spec error
+        raise ValueError(
+            '"nemesis-schedule" interval/duration must be >= 0 (got '
+            f"interval={out['interval']}, duration={out['duration']})")
+    plan = faults_mod.parse_spec(s.get("plan"))
+    if plan is not None:
+        faults_mod.FaultPlan.from_spec(plan)  # raises on bad specs
+        out["plan"] = plan
+    return out
+
+
+def schedule_windows(spec: Union[str, dict], generation: int
+                     ) -> List[dict]:
+    """Expand a campaign's nemesis schedule into generation *g*'s
+    synchronized window assignments — the pure function both the
+    single-process `run_campaign` (via `expand`) and the fleet
+    coordinator's claim broadcast evaluate, so every host's cell for a
+    generation installs the identical seeded window set.
+
+    Each descriptor: ``{"pos", "fault", "at_s", "dur_s", "digest"}``
+    — ``pos`` is the schedule position, ``at_s``/``dur_s`` the window's
+    offset/length relative to workload start, and ``digest`` the
+    window's schedule-shape identity (spec + generation + position;
+    deliberately host-free, so distributed and single-process runs of
+    the same spec agree on it)."""
+    if isinstance(spec, dict) and "faults" in spec \
+            and "workloads" not in spec:
+        sched = _norm_schedule(spec)
+    else:
+        sched = load_spec(spec).get("nemesis-schedule")
+    if not sched:
+        return []
+    import random as _random
+
+    rng = _random.Random(f"nemesis-schedule|{sched['seed']}|{generation}")
+    wins: List[dict] = []
+    t = 0.0
+    for pos in range(sched["windows"]):
+        fault = sched["faults"][pos % len(sched["faults"])]
+        t += sched["interval"] * rng.uniform(0.5, 1.5)
+        w = {"pos": pos, "fault": fault, "at_s": round(t, 4),
+             "dur_s": round(sched["duration"], 4)}
+        w["digest"] = _digest({"schedule": {k: sched[k] for k in
+                                            ("faults", "windows",
+                                             "interval", "duration",
+                                             "seed")},
+                               "gen": int(generation), **w}, 12)
+        wins.append(w)
+        t += sched["duration"]
+    return wins
+
+
+def windows_digest(wins: Optional[List[dict]]) -> str:
+    """One digest over a window set — what workers report as their
+    installed-window identity and the dashboard compares for desync."""
+    if not wins:
+        return ""
+    return _digest([w.get("digest") for w in wins], 12)
 
 
 def _uniq(xs: list) -> list:
@@ -208,6 +318,10 @@ def expand(spec: Union[str, dict]) -> List[RunSpec]:
                 f"{', '.join(known)}")
     name = spec["name"]
     base_opts = spec["opts"]
+    sched = spec.get("nemesis-schedule")
+    # one window set per generation, shared by every cell of that seed
+    sched_wins = {s: schedule_windows(sched, s)
+                  for s in spec["seeds"]} if sched else {}
     out: List[RunSpec] = []
     for w in spec["workloads"]:
         wl_label = _wl_label(w)
@@ -216,14 +330,27 @@ def expand(spec: Union[str, dict]) -> List[RunSpec]:
             f_label = fp["label"] if fp else "nofault"
             f_spec = fp["spec"] if fp else None
             for seed in spec["seeds"]:
+                cell_opts = dict(merged)
+                if sched:
+                    # the campaign-level nemesis schedule: every cell
+                    # of generation g (= the seed axis) carries the
+                    # same seeded window set, so the single-process
+                    # and fleet-distributed expansions of one spec are
+                    # chaos-equivalent cell for cell
+                    cell_opts.setdefault(
+                        "nemesis-windows", sched_wins[seed])
+                    if sched.get("plan") is not None:
+                        cell_opts.setdefault(
+                            "nemesis-plan",
+                            faults_mod.seeded_for(sched["plan"], seed))
                 cell = {"campaign": name, "workload": w, "fault": f_spec,
-                        "seed": seed, "opts": merged}
+                        "seed": seed, "opts": cell_opts}
                 rid = f"{wl_label}-{f_label}-s{seed}-{_digest(cell)}"
                 out.append(RunSpec(
                     run_id=rid, campaign=name, workload=w["name"],
                     seed=seed, fault=f_spec, fault_label=f_label,
-                    workload_label=wl_label, opts=dict(merged),
-                    device=bool(merged.get(
+                    workload_label=wl_label, opts=dict(cell_opts),
+                    device=bool(cell_opts.get(
                         "device", w["name"] in DEVICE_WORKLOADS)),
                 ))
     return out
@@ -258,6 +385,34 @@ def _nemesis_for(opts: Dict[str, Any], seed: int, nodes, client):
     pkg_opts.setdefault("client", client)
     return combined.nemesis_package(pkg_opts)
 
+
+def _schedule_pkg_for(opts: Dict[str, Any], nodes, client):
+    """Build the campaign-schedule nemesis package for a cell carrying
+    ``opts["nemesis-windows"]`` (injected by `expand`, or installed by
+    a fleet worker from its claim response).  Seeded from the window
+    set's own digest, so two hosts handed the same window set run the
+    identical fault schedule; the executing host's identity
+    (``opts["_fleet-host"]``, the fleet worker name, else the
+    hostname) is stamped onto every window op for the cross-host
+    ddmin's host attribution."""
+    wins = opts.get("nemesis-windows")
+    if not wins:
+        return None
+    import random as _random
+    import socket as _socket
+
+    from jepsen_tpu.nemesis import combined
+
+    host = str(opts.get("_fleet-host") or _socket.gethostname())
+    return combined.schedule_package({
+        "windows": wins,
+        "nodes": list(nodes),
+        "rng": _random.Random(f"sched|{windows_digest(wins)}"),
+        "host": host,
+        "client": client,
+    })
+
+
 def build_test(rs: RunSpec, base: str) -> dict:
     """Build the `core.run`-able test map for one campaign cell.
 
@@ -288,8 +443,17 @@ def build_test(rs: RunSpec, base: str) -> dict:
         # nemesis schedules (opts "nemesis": {"faults": [...], ...})
         # compose BEFORE the time limit: the package generators are
         # unbounded cycles, and the wall clock must bound the whole
-        # interleaving, not just the client half
-        pkg = _nemesis_for(opts, rs.seed, nodes, client)
+        # interleaving, not just the client half.  A campaign-level
+        # window schedule (opts "nemesis-windows") composes alongside
+        # any per-cell nemesis.
+        pkgs = [p for p in (_nemesis_for(opts, rs.seed, nodes, client),
+                            _schedule_pkg_for(opts, nodes, client)) if p]
+        pkg = None
+        if pkgs:
+            from jepsen_tpu.nemesis import combined
+
+            pkg = pkgs[0] if len(pkgs) == 1 \
+                else combined.compose_packages(pkgs)
         if pkg is not None and pkg.get("generator") is not None:
             gen = g.any_gen(gen, g.nemesis(pkg["generator"]))
         tl = opts.get("time-limit", 1.0)
@@ -323,4 +487,9 @@ def build_test(rs: RunSpec, base: str) -> dict:
         t["checker-time-limit"] = float(opts["checker-time-limit"])
     if rs.fault is not None:
         t["faults"] = rs.fault
+    elif opts.get("nemesis-plan") is not None:
+        # the schedule's generation-seeded resilience plan: installed
+        # only when the cell's own fault axis is empty (an explicit
+        # fault entry always wins)
+        t["faults"] = dict(opts["nemesis-plan"])
     return t

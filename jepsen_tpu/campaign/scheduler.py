@@ -176,10 +176,19 @@ class Scheduler:
                 hb = self.heartbeat
                 wname = threading.current_thread().name
                 if hb is not None:
-                    hb.worker(wname, {
+                    st = {
                         "run": rs.run_id, "workload": rs.workload_label,
                         "fault": rs.fault_label, "seed": rs.seed,
-                        "slot": slot})
+                        "slot": slot}
+                    if rs.opts.get("nemesis-windows"):
+                        # parity with fleet workers: the live dashboard
+                        # shows which window set a local worker runs
+                        from jepsen_tpu.campaign.plan import \
+                            windows_digest
+
+                        st["windows-digest"] = windows_digest(
+                            rs.opts["nemesis-windows"])
+                    hb.worker(wname, st)
                 try:
                     rec = self._run_one(rs, execute, slot)
                 finally:

@@ -412,7 +412,9 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
             return 2
         worker = FleetWorker(opts.coordinator, base, name=opts.name,
                              device_slots=opts.device_slots,
-                             poll_s=opts.poll)
+                             backend=opts.backend, mesh=opts.mesh,
+                             poll_s=opts.poll,
+                             claim_budget_s=opts.claim_budget)
         # SIGTERM drains gracefully: finish the in-flight cell, release
         # unstarted claims, exit — the lease protocol covers kill -9
         try:
@@ -447,10 +449,30 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
               f"duplicates discarded")
         print(f"digest: {s.get('digest')}  boot: {s.get('boot-digest')}")
         for w, d in sorted((s.get("workers") or {}).items()):
-            print(f"  worker {w}: host={d.get('host')} "
-                  f"slots={d.get('device-slots')} "
-                  f"seen {d.get('age-s')}s ago "
-                  f"({'alive' if d.get('alive') else 'silent'})")
+            line = (f"  worker {w}: host={d.get('host')} "
+                    f"slots={d.get('device-slots')} "
+                    f"seen {d.get('age-s')}s ago "
+                    f"({'alive' if d.get('alive') else 'silent'})")
+            wd = d.get("windows")
+            if wd:
+                open_ = ",".join(str(o.get("pos"))
+                                 for o in wd.get("open") or ()) or "-"
+                line += (f" windows[gen {wd.get('gen')}] "
+                         f"{wd.get('digest')} open={open_}"
+                         f"{'' if wd.get('synced') else ' DESYNCED'}")
+            print(line)
+        sched = s.get("nemesis-schedule")
+        if sched:
+            print(f"nemesis schedule: {sched.get('windows')} "
+                  f"window(s)/gen over {'|'.join(sched.get('faults'))}")
+            gens = sched.get("gens") or {}
+            digests = sched.get("digest-by-gen") or {}
+            for g in sorted(gens, key=lambda x: int(x)):
+                wins = " ".join(
+                    f"[{w.get('pos')}:{w.get('fault')}@"
+                    f"{w.get('at_s')}s+{w.get('dur_s')}s]"
+                    for w in gens[g])
+                print(f"  gen {g}: {digests.get(g)} {wins}")
         return 0
     print(f"fleet: unknown action {opts.action!r}", file=sys.stderr)
     return 2
@@ -756,6 +778,16 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                           "claims host-only cells")
     pfl.add_argument("--poll", type=float, default=0.5,
                      help="idle claim poll interval seconds (work)")
+    pfl.add_argument("--backend", default=None,
+                     help="advertised device backend capability "
+                          "(work): device cells whose opts pin a "
+                          '"backend" land only on matching workers')
+    pfl.add_argument("--mesh", default=None,
+                     help='advertised mesh shape, e.g. "2x2" (work)')
+    pfl.add_argument("--claim-budget", type=float, default=120.0,
+                     help="seconds of seeded-jittered backoff a worker "
+                          "spends riding out claim outages before "
+                          "giving up (work)")
 
     def dispatch(opts: argparse.Namespace) -> int:
         if opts.cmd == "test":

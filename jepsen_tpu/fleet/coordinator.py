@@ -91,6 +91,22 @@ class FleetCoordinator:
             if run_deadline_s and \
                     rs.opts.get("checker-time-limit") is None:
                 rs.opts["checker-time-limit"] = run_deadline_s
+        #: the campaign-level nemesis schedule (ISSUE 11): per
+        #: generation g (= the seed axis), the synchronized window set
+        #: every host's cell installs.  Expanded once here — the same
+        #: pure function `expand` evaluates, so the sets broadcast over
+        #: claim equal the ones already baked into the cell opts.
+        self.sched = self.spec.get("nemesis-schedule")
+        self._windows_by_gen: Dict[int, list] = {}
+        self._windows_digests: Dict[int, str] = {}
+        if self.sched:
+            for g in self.spec["seeds"]:
+                # pass the normalized block, not the whole spec — the
+                # spec path would re-run load_spec once per seed
+                wins = plan_mod.schedule_windows(self.sched, g)
+                self._windows_by_gen[int(g)] = wins
+                self._windows_digests[int(g)] = \
+                    plan_mod.windows_digest(wins)
         self.idx = Index(ccore.index_path(self.name, self.base))
         spec_ids = {rs.run_id for rs in self.specs}
         self._done_ids = self.idx.completed_ids() & spec_ids
@@ -187,6 +203,7 @@ class FleetCoordinator:
             self.workers[worker] = {
                 "host": body.get("host"),
                 "backend": body.get("backend"),
+                "mesh": body.get("mesh"),
                 "device-slots": int(body.get("device-slots", 1)),
                 "registered": round(time.time(), 3),
                 "last-seen": round(time.time(), 3),
@@ -194,7 +211,8 @@ class FleetCoordinator:
         self._update_gauges()
         return 200, {"ok": True, "campaign": self.name,
                      "lease-s": self.lease_s,
-                     "total": len(self.specs)}
+                     "total": len(self.specs),
+                     "nemesis-schedule": bool(self.sched)}
 
     def claim(self, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         return self._guarded("fleet.claim", self._claim, body)
@@ -206,14 +224,27 @@ class FleetCoordinator:
         caps = self._touch(worker)
         spec, deadline = self.queue.claim(
             worker, lease_s=self.lease_s,
-            device_ok=caps.get("device-slots", 1) > 0)
+            device_ok=caps.get("device-slots", 1) > 0, caps=caps)
         self._update_gauges()
         if spec is None:
             c = self.queue.counts()
             return 200, {"spec": None, "finished": self.finished,
                          "queued": c["queued"], "claimed": c["claimed"]}
-        return 200, {"spec": spec, "lease-s": self.lease_s,
-                     "deadline": deadline}
+        out = {"spec": spec, "lease-s": self.lease_s,
+               "deadline": deadline}
+        if self.sched:
+            # the window broadcast: the claim response is the
+            # AUTHORITATIVE carrier of the cell generation's
+            # synchronized window set — a worker that missed every
+            # heartbeat tick still installs the correct seeded windows
+            # from here, before execute_run
+            g = int(spec.get("seed", 0))
+            out["windows"] = {
+                "gen": g,
+                "set": self._windows_by_gen.get(g, []),
+                "digest": self._windows_digests.get(g, ""),
+            }
+        return 200, out
 
     def heartbeat(self, body: Dict[str, Any]
                   ) -> Tuple[int, Dict[str, Any]]:
@@ -244,6 +275,29 @@ class FleetCoordinator:
                 self._touch(str(worker))
             if "state" in body:
                 hb.worker(str(worker), body.get("state"))
+        out: Dict[str, Any] = {"ok": True, "lease-s": self.lease_s}
+        wins = body.get("windows")
+        if worker is not None and "windows" in body and wins is None:
+            with self._lock:  # cell done: the worker's windows retire
+                if str(worker) in self.workers:
+                    self.workers[str(worker)].pop("windows", None)
+        if worker is not None and isinstance(wins, dict):
+            # window open/close ticks (ISSUE 11): lease renewal doubles
+            # as chaos clock sync — the worker reports its installed
+            # window digest + currently-open positions, the coordinator
+            # records them (the /fleet dashboard's desync view) and
+            # echoes the authoritative digest for that generation so a
+            # desynced worker can see it immediately
+            with self._lock:
+                if str(worker) in self.workers:
+                    self.workers[str(worker)]["windows"] = dict(
+                        wins, ts=round(time.time(), 3))
+            try:
+                g = int(wins.get("gen"))
+            except (TypeError, ValueError):
+                g = None
+            if g is not None and g in self._windows_digests:
+                out["windows-digest"] = self._windows_digests[g]
         done = body.get("done")
         if isinstance(done, dict):
             hb.record_done(done.get("run"), done.get("valid?"))
@@ -256,7 +310,8 @@ class FleetCoordinator:
         if body.get("finished"):
             hb.close()
         self._update_gauges()
-        return 200, {"ok": True, "lost": lost, "lease-s": self.lease_s}
+        out["lost"] = lost
+        return 200, out
 
     def complete(self, body: Dict[str, Any]
                  ) -> Tuple[int, Dict[str, Any]]:
@@ -309,16 +364,27 @@ class FleetCoordinator:
         self.queue.expire()
         now = time.time()
         with self._lock:
-            workers = {
-                w: {"host": c.get("host"), "backend": c.get("backend"),
-                    "device-slots": c.get("device-slots"),
-                    "age-s": round(now - c["last-seen"], 3),
-                    "alive": now - c["last-seen"] <=
-                    ALIVE_LEASES * self.lease_s}
-                for w, c in self.workers.items()}
+            workers = {}
+            for w, c in self.workers.items():
+                row = {"host": c.get("host"),
+                       "backend": c.get("backend"),
+                       "mesh": c.get("mesh"),
+                       "device-slots": c.get("device-slots"),
+                       "age-s": round(now - c["last-seen"], 3),
+                       "alive": now - c["last-seen"] <=
+                       ALIVE_LEASES * self.lease_s}
+                wins = c.get("windows")
+                if isinstance(wins, dict):
+                    g = wins.get("gen")
+                    auth = self._windows_digests.get(
+                        int(g)) if isinstance(g, int) else None
+                    row["windows"] = dict(
+                        wins, synced=(auth is not None and
+                                      wins.get("digest") == auth))
+                workers[w] = row
             done = len(self._done_ids)
         self._update_gauges()
-        return 200, {
+        out = {
             "campaign": self.name,
             "gen": self.gen,
             "spec-digest": self.spec_digest,
@@ -332,6 +398,16 @@ class FleetCoordinator:
             "lease-s": self.lease_s,
             "workers": workers,
         }
+        if self.sched:
+            out["nemesis-schedule"] = {
+                "faults": self.sched["faults"],
+                "windows": self.sched["windows"],
+                "digest-by-gen": {str(g): d for g, d in
+                                  sorted(self._windows_digests.items())},
+                "gens": {str(g): w for g, w in
+                         sorted(self._windows_by_gen.items())},
+            }
+        return 200, out
 
     # -- internals -----------------------------------------------------------
 
@@ -340,7 +416,8 @@ class FleetCoordinator:
         default capabilities (register is polite, not mandatory)."""
         with self._lock:
             caps = self.workers.setdefault(worker, {
-                "host": None, "backend": None, "device-slots": 1,
+                "host": None, "backend": None, "mesh": None,
+                "device-slots": 1,
                 "registered": round(time.time(), 3),
                 "last-seen": round(time.time(), 3)})
             caps["last-seen"] = round(time.time(), 3)
@@ -374,6 +451,24 @@ class FleetCoordinator:
             reg.gauge("fleet-leases-active").set(c["claimed"])
             for state in ("queued", "claimed", "done"):
                 reg.gauge("fleet-cells", state=state).set(c[state])
+            if self.sched:
+                # chaos visibility: currently-open windows across the
+                # fleet, by fault family, from the workers' heartbeat
+                # ticks (stale workers excluded by liveness)
+                open_by_fault = {f: 0 for f in self.sched["faults"]}
+                with self._lock:
+                    for cw in self.workers.values():
+                        if now - cw["last-seen"] > \
+                                ALIVE_LEASES * self.lease_s:
+                            continue
+                        wins = cw.get("windows")
+                        for o in (wins or {}).get("open") or ():
+                            f = str((o or {}).get("fault"))
+                            if f in open_by_fault:
+                                open_by_fault[f] += 1
+                for f, n in open_by_fault.items():
+                    reg.gauge("fleet-nemesis-windows-active",
+                              campaign=self.name, fault=f).set(n)
         except Exception:  # noqa: BLE001 — observability only
             logger.debug("fleet gauge update failed", exc_info=True)
 

@@ -61,6 +61,39 @@ def record_digest(record: Dict[str, Any]) -> str:
     ).hexdigest()[:16]
 
 
+def _norm_mesh(m: Any) -> Optional[Tuple[int, ...]]:
+    """Normalize a mesh-shape capability/requirement ("2x2", [2, 2],
+    (2, 2)) to a comparable tuple; None when unspecified."""
+    if m is None or m == "":
+        return None
+    if isinstance(m, str):
+        m = [p for p in m.replace("x", ",").split(",") if p.strip()]
+    try:
+        return tuple(int(p) for p in m)
+    except (TypeError, ValueError):
+        return None
+
+
+def _caps_match(spec: Dict[str, Any], caps: Optional[Dict[str, Any]]
+                ) -> bool:
+    """Worker-affine placement predicate: does this worker's advertised
+    capability set satisfy a device cell's requirements?  A cell pins
+    requirements via opts ``"backend"`` (e.g. ``"tpu"``) and/or
+    ``"mesh"`` (device mesh shape); an unpinned cell matches everyone,
+    an unadvertised capability fails a pinned one."""
+    opts = spec.get("opts") or {}
+    need_backend = opts.get("backend")
+    if need_backend:
+        have = str((caps or {}).get("backend") or "")
+        if have.lower() != str(need_backend).lower():
+            return False
+    need_mesh = _norm_mesh(opts.get("mesh"))
+    if need_mesh is not None:
+        if _norm_mesh((caps or {}).get("mesh")) != need_mesh:
+            return False
+    return True
+
+
 def _count(name: str, **labels: Any) -> None:
     """Bump a fleet counter on the live registry.  Applied during
     replay too, so a restarted coordinator's counters equal the ledger
@@ -133,6 +166,10 @@ class WorkQueue:
                 "claims": 0, "requeues": 0,
                 "completed_by": None, "record": None,
                 "record_digest": None,
+                # in-memory only (not digested, not replayed): when the
+                # first affinity deferral parked this cell — the
+                # starvation-fallback clock
+                "_deferred_at": None,
             }
             self._order.append(run)
             return
@@ -149,6 +186,7 @@ class WorkQueue:
                 cell["deadline"] = ev.get("deadline")
         elif k == "requeue":
             cell.update(state="queued", worker=None, deadline=None)
+            cell["_deferred_at"] = None  # affinity clock restarts
             cell["requeues"] += 1
             self.requeues += 1
             _count("fleet-requeues", worker=ev.get("worker") or "?",
@@ -195,12 +233,22 @@ class WorkQueue:
             return True
 
     def claim(self, worker: str, *, lease_s: float,
-              device_ok: bool = True, now: Optional[float] = None
+              device_ok: bool = True,
+              caps: Optional[Dict[str, Any]] = None,
+              now: Optional[float] = None
               ) -> Tuple[Optional[Dict[str, Any]], Optional[float]]:
         """Claim the first queued cell this worker can run; returns
         ``(spec, lease_deadline)`` or ``(None, None)``.  Expired leases
         are requeued first (opportunistic — the coordinator has no
-        background reaper thread to crash)."""
+        background reaper thread to crash).
+
+        Placement is **worker-affine** (ISSUE 11): a device-classified
+        cell whose opts pin a ``"backend"`` or ``"mesh"`` shape is
+        skipped by workers whose registered `caps` don't match — each
+        skip counts on ``fleet-affinity-deferrals{worker}`` — until the
+        cell has been deferred for longer than one lease, after which
+        ANY device-capable worker may take it (starvation-safe
+        fallback: affinity is a preference, never a deadlock)."""
         now = time.time() if now is None else now
         with self._lock:
             self._expire_locked(now)
@@ -208,11 +256,24 @@ class WorkQueue:
                 cell = self.cells[run]
                 if cell["state"] != "queued":
                     continue
-                if cell["spec"].get("device") and not device_ok:
-                    continue
+                if cell["spec"].get("device"):
+                    if not device_ok:
+                        continue
+                    if not _caps_match(cell["spec"], caps):
+                        first = cell["_deferred_at"]
+                        if first is None:
+                            cell["_deferred_at"] = first = now
+                        if now - first <= float(lease_s):
+                            _count("fleet-affinity-deferrals",
+                                   worker=worker)
+                            continue
+                        # starved past a lease: any capable worker runs
+                        # it — a fleet with no matching worker must
+                        # still finish
                 deadline = round(now + float(lease_s), 3)
                 self._event({"ev": "claim", "run": run, "worker": worker,
                              "deadline": deadline})
+                cell["_deferred_at"] = None
                 return dict(cell["spec"]), deadline
             return None, None
 

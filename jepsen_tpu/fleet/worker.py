@@ -33,11 +33,12 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import socket
 import threading
 import time
 import urllib.request
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from jepsen_tpu import resilience, store
 from jepsen_tpu.campaign.plan import RunSpec
@@ -55,18 +56,28 @@ class FleetWorker:
 
     def __init__(self, coordinator: str, base: Optional[str] = None, *,
                  name: Optional[str] = None, device_slots: int = 1,
-                 backend: Optional[str] = None, poll_s: float = 0.5,
+                 backend: Optional[str] = None, mesh: Any = None,
+                 poll_s: float = 0.5,
                  lease_s: float = 15.0,
                  retry: Optional[RetryPolicy] = None,
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0,
+                 claim_budget_s: float = 120.0):
         self.url = coordinator.rstrip("/")
         self.base = base or store.BASE
         self.name = name or f"{socket.gethostname()}-{os.getpid()}"
         self.device_slots = int(device_slots)
         self.backend = backend
+        self.mesh = mesh
         self.poll_s = float(poll_s)
         self.lease_s = float(lease_s)  # server value adopted at register
         self.timeout_s = float(timeout_s)
+        #: how long claim outages are ridden out before giving up —
+        #: spent in seeded-jittered backoff sleeps (ISSUE 11 satellite:
+        #: each worker's delay stream is seeded from its own name, so a
+        #: fleet recovering from a coordinator outage doesn't
+        #: synchronize its re-poll storm)
+        self.claim_budget_s = float(claim_budget_s)
+        self._backoff_rng = random.Random(f"{self.name}|claim-backoff")
         # generous by default: the retry window must cover a
         # coordinator kill -9 + restart (a few seconds of ECONNREFUSED)
         self.retry = retry or RetryPolicy(
@@ -76,6 +87,9 @@ class FleetWorker:
         self.stop = threading.Event()
         self.cells_done = 0
         self.duplicates = 0
+        #: the last installed window set (digest + descriptors) — what
+        #: heartbeat ticks report while a scheduled cell runs
+        self.installed_windows: Optional[Dict[str, Any]] = None
 
     # -- transport -----------------------------------------------------------
 
@@ -102,7 +116,8 @@ class FleetWorker:
     def register(self) -> Dict[str, Any]:
         r = self._post("fleet.register", "/fleet/register", {
             "worker": self.name, "host": socket.gethostname(),
-            "backend": self.backend, "device-slots": self.device_slots})
+            "backend": self.backend, "mesh": self.mesh,
+            "device-slots": self.device_slots})
         if isinstance(r.get("lease-s"), (int, float)):
             self.lease_s = float(r["lease-s"])
         logger.info("fleet worker %s registered with %s (campaign %s, "
@@ -110,26 +125,45 @@ class FleetWorker:
                     r.get("campaign"), self.lease_s)
         return r
 
+    def _claim_backoff(self, fails: int) -> float:
+        """One seeded-jittered backoff delay for the `fails`-th
+        consecutive claim outage: exponential from `poll_s`, capped,
+        each draw scaled by a per-worker random factor — two workers
+        with the same poll settings still desynchronize their re-poll
+        storms against a recovering coordinator."""
+        base = min(self.poll_s * (2.0 ** max(0, fails - 1)), 5.0)
+        return base * self._backoff_rng.uniform(0.5, 1.5)
+
     def run(self) -> int:
         """Claim-execute until the campaign finishes (or SIGTERM
         drains); returns the number of cells this worker completed."""
         self.register()
         claim_fails = 0
+        claim_waited = 0.0
         while not self.stop.is_set():
             try:
                 r = self._post("fleet.claim", "/fleet/claim",
                                {"worker": self.name})
             except Exception as e:  # noqa: BLE001 — outage outlasting
-                # the retry budget: keep polling (a daemon rides out
-                # long partitions), give up only after many in a row
+                # the retry budget: keep polling under seeded jittered
+                # backoff (a daemon rides out long partitions), give up
+                # only once the configured budget is spent
                 claim_fails += 1
-                if claim_fails > 10:
+                delay = self._claim_backoff(claim_fails)
+                if claim_waited + delay > self.claim_budget_s:
+                    logger.error(
+                        "fleet worker %s: claim outage outlasted the "
+                        "%.1fs budget (%d attempts); giving up",
+                        self.name, self.claim_budget_s, claim_fails)
                     raise
+                claim_waited += delay
                 logger.warning("fleet worker %s: claim failed (%s); "
-                               "re-polling", self.name, e)
-                time.sleep(self.poll_s)
+                               "re-polling in %.2fs", self.name, e,
+                               delay)
+                time.sleep(delay)
                 continue
             claim_fails = 0
+            claim_waited = 0.0
             spec = r.get("spec")
             if not spec:
                 if r.get("finished"):
@@ -142,23 +176,79 @@ class FleetWorker:
                 self._post("fleet.release", "/fleet/release",
                            {"worker": self.name, "run": spec["run_id"]})
                 break
-            self._run_cell(spec)
+            self._run_cell(spec, r.get("windows"))
         logger.info("fleet worker %s done: %d cells completed "
                     "(%d duplicates discarded upstream)",
                     self.name, self.cells_done, self.duplicates)
         return self.cells_done
 
-    def _run_cell(self, spec: Dict[str, Any]) -> None:
+    def _install_windows(self, rs: RunSpec,
+                         windows: Optional[Dict[str, Any]]) -> None:
+        """Install the claim response's synchronized window set before
+        `execute_run` (ISSUE 11 tentpole).  The claim broadcast is
+        authoritative: it overrides whatever the ledger's serialized
+        spec carried (a cell enqueued before the schedule existed, or
+        by an older coordinator), so every host's cell for generation
+        *g* runs the same seeded windows at the same schedule
+        positions.  The worker's name rides along as the executing
+        host, the attribution the cross-host fault-window ddmin
+        surfaces."""
+        from jepsen_tpu.campaign.plan import windows_digest
+
+        rs.opts["_fleet-host"] = self.name
+        wins = (windows or {}).get("set")
+        if wins is not None:
+            rs.opts["nemesis-windows"] = wins
+        wins = rs.opts.get("nemesis-windows")
+        if wins:
+            self.installed_windows = {
+                "gen": int(rs.seed),
+                "digest": windows_digest(wins),
+                "set": wins,
+            }
+            want = (windows or {}).get("digest")
+            if want and want != self.installed_windows["digest"]:
+                logger.warning(
+                    "fleet worker %s: installed window digest %s != "
+                    "coordinator's %s for gen %s", self.name,
+                    self.installed_windows["digest"], want, rs.seed)
+        else:
+            self.installed_windows = None
+
+    def _window_ticks(self, t0: float) -> Optional[Dict[str, Any]]:
+        """The heartbeat's chaos-clock payload: installed digest plus
+        which schedule positions are open right now (derived from the
+        deterministic window offsets and the cell's elapsed wall
+        clock) — lease renewal doubles as window open/close tick
+        sync."""
+        iw = self.installed_windows
+        if not iw:
+            return None
+        elapsed = time.monotonic() - t0
+        open_: List[Dict[str, Any]] = [
+            {"pos": w.get("pos"), "fault": w.get("fault")}
+            for w in iw["set"]
+            if w["at_s"] <= elapsed < w["at_s"] + w["dur_s"]]
+        return {"gen": iw["gen"], "digest": iw["digest"],
+                "n": len(iw["set"]), "open": open_,
+                "elapsed": round(elapsed, 3)}
+
+    def _run_cell(self, spec: Dict[str, Any],
+                  windows: Optional[Dict[str, Any]] = None) -> None:
         from jepsen_tpu.campaign.core import execute_run
 
         rs = RunSpec.from_dict(spec)
         rs.opts["_base"] = self.base
+        self._install_windows(rs, windows)
         run_id = rs.run_id
         state = {"run": run_id, "workload": rs.workload_label,
                  "fault": rs.fault_label, "seed": rs.seed,
                  "slot": None, "worker-host": socket.gethostname()}
+        if self.installed_windows:
+            state["windows-digest"] = self.installed_windows["digest"]
         stop_renew = threading.Event()
         lease_lost = threading.Event()
+        t0 = time.monotonic()
 
         def renew_loop() -> None:
             # heartbeat + renew at lease/3; failures are logged, never
@@ -168,6 +258,7 @@ class FleetWorker:
                 try:
                     r = self._post("fleet.heartbeat", "/fleet/heartbeat",
                                    {"worker": self.name, "state": state,
+                                    "windows": self._window_ticks(t0),
                                     "renew": [run_id]})
                     if run_id in (r.get("lost") or []):
                         lease_lost.set()
@@ -175,6 +266,15 @@ class FleetWorker:
                             "fleet worker %s: lease on %s LOST "
                             "(requeued elsewhere); finishing anyway",
                             self.name, run_id)
+                    want = r.get("windows-digest")
+                    if want and self.installed_windows and \
+                            want != self.installed_windows["digest"]:
+                        logger.warning(
+                            "fleet worker %s: window desync on %s "
+                            "(installed %s, coordinator %s); will "
+                            "reinstall at next claim", self.name,
+                            run_id, self.installed_windows["digest"],
+                            want)
                 except Exception as e:  # noqa: BLE001 — best-effort
                     logger.warning("fleet worker %s: heartbeat failed "
                                    "(%s)", self.name, e)
@@ -184,13 +284,14 @@ class FleetWorker:
         try:
             self._post("fleet.heartbeat", "/fleet/heartbeat",
                        {"worker": self.name, "state": state,
+                        "windows": self._window_ticks(t0),
                         "renew": [run_id]})
         except Exception:  # noqa: BLE001
             pass
         renewer = threading.Thread(target=renew_loop, daemon=True,
                                    name=f"fleet-renew-{self.name}")
         renewer.start()
-        t0 = time.monotonic()
+        t0 = time.monotonic()  # the window tick clock: workload start
         try:
             rec = execute_run(rs, self.base)
         except Exception as e:  # noqa: BLE001 — same contract as the
@@ -221,8 +322,10 @@ class FleetWorker:
                            "beyond retries (%s); cell will requeue on "
                            "lease expiry", self.name, run_id, e)
         finally:
+            self.installed_windows = None
             try:
                 self._post("fleet.heartbeat", "/fleet/heartbeat",
-                           {"worker": self.name, "state": None})
+                           {"worker": self.name, "state": None,
+                            "windows": None})
             except Exception:  # noqa: BLE001
                 pass

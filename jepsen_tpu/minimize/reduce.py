@@ -39,8 +39,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from jepsen_tpu.history.ops import History, Op
 
 __all__ = ["Unit", "units_of", "build_history", "unit_keys",
-           "drop_key", "Reducer", "is_nemesis_unit", "fault_windows",
-           "window_descriptors"]
+           "drop_key", "Reducer", "is_nemesis_unit", "unit_window",
+           "fault_windows", "window_descriptors"]
 
 #: the interpreter's nemesis thread id — fault ops carry it as their
 #: process (generator/context.NEMESIS_THREAD)
@@ -154,6 +154,21 @@ def is_nemesis_unit(u: Unit) -> bool:
     return u.process == NEMESIS_PROCESS
 
 
+def unit_window(u: Unit) -> Optional[dict]:
+    """The window identity a scheduled nemesis op carries (`Op.ext`
+    ``"window"``: pos/digest/fault/host, stamped by
+    `nemesis.combined.schedule_package`), or None for unscheduled
+    fault ops.  This is the **host dimension** of the cross-host
+    fault-window ddmin: ops from different hosts' windows never share
+    an identity, so each host's window is its own drop candidate and
+    the minimal witness records *whose* window mattered."""
+    for op in u.ops:
+        w = (op.ext or {}).get("window")
+        if isinstance(w, dict) and w.get("digest") is not None:
+            return w
+    return None
+
+
 _STOP_PREFIXES = ("stop", "heal", "resume", "fast", "reset")
 
 
@@ -171,14 +186,39 @@ def fault_windows(nem_units: Sequence[Unit]) -> List[List[int]]:
     """Group nemesis units into fault *windows* (indices into
     `nem_units`, deterministic order).
 
-    Heuristic mirrors `perf.nemesis_intervals`, suffix-aware: a
-    start-like f opens a window; a stop/heal-like f closes the open
-    window of the SAME fault family (suffix after the start-/stop-
-    prefix), falling back to the most recent open window — so composed
-    packages' interleaved windows (start-skew, start-partition,
-    stop-skew, stop-partition) pair correctly.  One-shot faults
-    (``leave-node``, ``bump-clock``, ...) join the most recent open
-    window, or stand alone outside any."""
+    Scheduled ops group EXACTLY: units stamped with a window identity
+    (`unit_window`) belong to the window keyed by (host, digest) — the
+    host dimension — so a merged multi-host history keeps each host's
+    instance of the same schedule position as a separate droppable
+    window.  Unstamped ops fall back to the suffix-aware heuristic
+    mirroring `perf.nemesis_intervals`: a start-like f opens a window;
+    a stop/heal-like f closes the open window of the SAME fault family
+    (suffix after the start-/stop- prefix), falling back to the most
+    recent open window — so composed packages' interleaved windows
+    (start-skew, start-partition, stop-skew, stop-partition) pair
+    correctly.  One-shot faults (``leave-node``, ``bump-clock``, ...)
+    join the most recent open window, or stand alone outside any.
+    Output order is by first unit index — canonical at any worker
+    count."""
+    stamped: Dict[tuple, List[int]] = {}
+    plain: List[int] = []
+    for i, u in enumerate(nem_units):
+        w = unit_window(u)
+        if w is not None:
+            key = (str(w.get("host") or ""), str(w["digest"]))
+            stamped.setdefault(key, []).append(i)
+        else:
+            plain.append(i)
+    wins = [sorted(v) for v in stamped.values()]
+    sub = [nem_units[i] for i in plain]
+    wins.extend([plain[j] for j in w] for w in _heuristic_windows(sub))
+    wins.sort(key=lambda w: w[0])
+    return wins
+
+
+def _heuristic_windows(nem_units: Sequence[Unit]) -> List[List[int]]:
+    """The start/stop pairing heuristic over unstamped nemesis units
+    (indices into `nem_units`)."""
     wins: List[List[int]] = []
     open_wins: List[tuple] = []  # (suffix, window) in open order
     for i, u in enumerate(nem_units):
@@ -206,17 +246,32 @@ def fault_windows(nem_units: Sequence[Unit]) -> List[List[int]]:
 
 
 def window_descriptors(nem_units: Sequence[Unit],
-                       wins: Sequence[List[int]]) -> List[dict]:
+                       wins: Sequence[List[int]],
+                       kept: Optional[Sequence[str]] = None
+                       ) -> List[dict]:
     """The witness-meta shape for a window set: per window, its
-    opening f, the original op indices it spans, and the index span."""
+    opening f, the original op indices it spans, and the index span;
+    scheduled windows add their identity (``pos``/``digest``/``fault``
+    from the schedule — host-free, so distributed and single-process
+    runs of one spec agree — plus ``host``, the executing host, as
+    attribution).  `kept` labels why each window survived reduction
+    (``necessary`` / ``overlap`` / ``interaction``)."""
     out = []
-    for w in wins:
+    for j, w in enumerate(wins):
         ops = [op.index for i in w for op in nem_units[i].ops]
-        out.append({
+        d = {
             "f": str(nem_units[w[0]].ops[0].f),
             "ops": sorted(ops),
             "span": [min(ops), max(ops)],
-        })
+        }
+        ident = unit_window(nem_units[w[0]])
+        if ident is not None:
+            d.update(pos=ident.get("pos"), digest=ident.get("digest"),
+                     fault=ident.get("fault"),
+                     host=ident.get("host") or None)
+        if kept is not None:
+            d["kept"] = kept[j]
+        out.append(d)
     return out
 
 
@@ -391,12 +446,20 @@ class Reducer:
         lo = min((u.order for u in client), default=0)
         hi = max((max(op.index for op in u.ops) for u in client),
                  default=0)
-        keep: List[List[int]] = []
+        verdicts = []  # (window, drop_ok, overlaps)
         for w, drop_ok in zip(wins, droppable):
             ops = [op.index for i in w for op in nem[i].ops]
-            overlaps = min(ops) <= hi and max(ops) >= lo
-            if not drop_ok or overlaps:
+            verdicts.append((w, drop_ok,
+                             min(ops) <= hi and max(ops) >= lo))
+        keep: List[List[int]] = []
+        reasons: List[str] = []
+        for w, drop_ok, overlaps in verdicts:
+            if not drop_ok:
                 keep.append(w)
+                reasons.append("necessary")
+            elif overlaps:
+                keep.append(w)
+                reasons.append("overlap")
         kept = [nem[i] for w in keep for i in w]
         improved = len(kept) < len(nem)
         if improved:
@@ -410,10 +473,13 @@ class Reducer:
                 pass
             else:
                 kept, keep, improved = nem, wins, False
+                reasons = ["necessary" if not ok else
+                           ("overlap" if ov else "interaction")
+                           for _, ok, ov in verdicts]
         self._note("fault-windows", len(wins), _merge(client, kept),
                    improved)
         self._nemesis = kept
-        self.windows_meta = window_descriptors(nem, keep)
+        self.windows_meta = window_descriptors(nem, keep, reasons)
         return _merge(client, kept)
 
     def run(self, units: List[Unit]) -> List[Unit]:

@@ -36,10 +36,10 @@ DEFAULT_INTERVAL = 10.0
 
 # ---------------------------------------------------------------- partition
 
-def partition_package(opts: dict) -> Optional[dict]:
-    if "partition" not in opts.get("faults", ()):
-        return None
-    interval = opts.get("interval", DEFAULT_INTERVAL)
+def _partition_start(opts: dict):
+    """The start-partition op factory (shared between the interval
+    package and the window schedule): rng-chosen grudge over the
+    test's nodes at emit time."""
     rng = opts.get("rng") or _random
     targets = opts.get("partition_targets") or [
         nc.partition_random_halves, nc.partition_random_node,
@@ -49,6 +49,15 @@ def partition_package(opts: dict) -> Optional[dict]:
         grudge_fn = rng.choice(targets)
         return {"f": "start-partition",
                 "value": grudge_fn(test["nodes"])}
+
+    return start
+
+
+def partition_package(opts: dict) -> Optional[dict]:
+    if "partition" not in opts.get("faults", ()):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    start = _partition_start(opts)
 
     return {
         "nemesis": nc.partitioner(),
@@ -161,16 +170,24 @@ def pause_package(opts: dict) -> Optional[dict]:
 
 # ---------------------------------------------------------------- clock
 
-def clock_package(opts: dict) -> Optional[dict]:
-    if "clock" not in opts.get("faults", ()):
-        return None
-    interval = opts.get("interval", DEFAULT_INTERVAL)
+def _clock_bump(opts: dict):
+    """The bump-clock op factory (shared with the window schedule)."""
     rng = opts.get("rng") or _random
 
     def bump(test, ctx):
         node = rng.choice(test["nodes"])
         ms = rng.choice([-1, 1]) * rng.choice([100, 1000, 10_000, 60_000])
         return {"f": "bump-clock", "value": {node: ms}}
+
+    return bump
+
+
+def clock_package(opts: dict) -> Optional[dict]:
+    if "clock" not in opts.get("faults", ()):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    rng = opts.get("rng") or _random
+    bump = _clock_bump(opts)
 
     def strobe(test, ctx):
         return {"f": "strobe-clock",
@@ -219,15 +236,9 @@ def file_package(opts: dict) -> Optional[dict]:
 
 # ---------------------------------------------------------------- traffic
 
-def traffic_package(opts: dict) -> Optional[dict]:
-    """Traffic-shaping fault package: drives the `Net.slow/flaky/shape`
-    protocol (which no package exercised before) through a
-    :class:`~jepsen_tpu.nemesis.core.TrafficShaper`.  Each cycle picks
-    one shaping mode at random, holds it for `interval`, then heals
-    with ``fast``."""
-    if "traffic" not in opts.get("faults", ()):
-        return None
-    interval = opts.get("interval", DEFAULT_INTERVAL)
+def _traffic_degrade(opts: dict):
+    """The traffic-degrade op factory (shared with the window
+    schedule): one rng-chosen shaping mode per emit."""
     rng = opts.get("rng") or _random
 
     def degrade(test, ctx):
@@ -241,6 +252,20 @@ def traffic_package(opts: dict) -> Optional[dict]:
                       "loss", f"{rng.choice([1, 5])}%"],
         }[f]
         return {"f": f, "value": value}
+
+    return degrade
+
+
+def traffic_package(opts: dict) -> Optional[dict]:
+    """Traffic-shaping fault package: drives the `Net.slow/flaky/shape`
+    protocol (which no package exercised before) through a
+    :class:`~jepsen_tpu.nemesis.core.TrafficShaper`.  Each cycle picks
+    one shaping mode at random, holds it for `interval`, then heals
+    with ``fast``."""
+    if "traffic" not in opts.get("faults", ()):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    degrade = _traffic_degrade(opts)
 
     return {
         "nemesis": nc.traffic_shaper(),
@@ -317,6 +342,143 @@ def membership_package(opts: dict) -> Optional[dict]:
     }
 
 
+# ------------------------------------------------------- window schedule
+
+#: fault families a campaign-level nemesis schedule can window
+#: (ISSUE 11): family -> its package fn.  Window-shaped families emit a
+#: start op at window open and a heal op at close; one-shot families
+#: (file, membership) emit their single op at open.
+WINDOW_FAULTS = {
+    "partition": partition_package,
+    "kill": kill_package,
+    "pause": pause_package,
+    "clock": clock_package,
+    "file": file_package,
+    "traffic": traffic_package,
+    "skew": skew_package,
+    "membership": membership_package,
+}
+
+#: families whose window has no closing op
+_ONE_SHOT_FAULTS = frozenset({"file", "membership"})
+
+
+def _window_events(fault: str, opts: dict):
+    """(start, stop) event specs for one window of `fault` — the same
+    op shapes the interval packages emit, minus the cycling.  `stop` is
+    None for one-shot families."""
+    if fault == "partition":
+        return _partition_start(opts), {"f": "stop-partition",
+                                        "value": None}
+    if fault == "skew":
+        return ({"f": "start-skew", "value": None},
+                {"f": "stop-skew", "value": None})
+    if fault == "kill":
+        return ({"f": "kill", "value": None},
+                {"f": "start", "value": None})
+    if fault == "pause":
+        return ({"f": "pause", "value": None},
+                {"f": "resume", "value": None})
+    if fault == "clock":
+        return _clock_bump(opts), {"f": "reset-clock", "value": None}
+    if fault == "traffic":
+        return _traffic_degrade(opts), {"f": "fast", "value": None}
+    if fault == "file":
+        path = opts.get("file")
+        rng = opts.get("rng") or _random
+
+        def corrupt(test, ctx):
+            node = rng.choice(test["nodes"])
+            f = rng.choice(["bitflip-file", "truncate-file"])
+            return {"f": f, "value": {"file": path, "nodes": [node]}}
+
+        return corrupt, None
+    if fault == "membership":
+        from jepsen_tpu.nemesis.membership import possible_op
+
+        state = opts["membership_state"]
+
+        def next_change(test, ctx):
+            op = possible_op(state, test)
+            return op or {"f": "membership-view", "value": None}
+
+        return next_change, None
+    raise ValueError(f"unknown window fault family {fault!r} "
+                     f"(have {sorted(WINDOW_FAULTS)})")
+
+
+def _stamp_event(evt, stamp: dict):
+    """Attach the window identity (pos/digest/fault/host) to an event's
+    emitted op — it rides the op dict into `Op.ext`, survives store
+    round-trips, and is what the cross-host fault-window ddmin groups
+    on."""
+    if callable(evt):
+        def fn(test, ctx):
+            op = evt(test, ctx)
+            return dict(op, window=dict(stamp)) if op else op
+
+        return fn
+    return dict(evt, window=dict(stamp))
+
+
+def schedule_package(opts: dict) -> dict:
+    """Build a nemesis package from EXPLICIT window descriptors instead
+    of interval cycling (the campaign-level nemesis schedule, ISSUE
+    11): ``opts["windows"]`` is a list of ``{"pos", "fault", "at_s",
+    "dur_s", "digest"}`` (see `campaign.plan.schedule_windows`); the
+    generator emits each window's start op at its offset and its heal
+    op at close, every op stamped with the window identity plus
+    ``opts["host"]`` (the executing host, for cross-host witness
+    attribution).  Families whose package is unavailable in this run
+    (e.g. ``kill`` without a Process-capable db) have their windows
+    skipped.
+
+    Sub-nemeses, final heal ops, and perf metadata come from the
+    ordinary interval packages (`compose_packages` shape), so
+    downstream consumers cannot tell a scheduled window from an
+    interval one — except by the window stamp."""
+    windows = [w for w in (opts.get("windows") or ())
+               if w.get("fault") in WINDOW_FAULTS]
+    host = str(opts.get("host") or "")
+    fams = []
+    for w in windows:
+        if w["fault"] not in fams:
+            fams.append(w["fault"])
+    if "membership" in fams and not opts.get("membership_state"):
+        from jepsen_tpu.nemesis.sim import SimMembershipState
+
+        opts = dict(opts, membership_state=SimMembershipState(
+            opts.get("nodes") or ["n1", "n2", "n3"]))
+    pkgs, alive = [], []
+    for fam in fams:
+        p = WINDOW_FAULTS[fam](dict(opts, faults={fam}))
+        if p is not None:
+            pkgs.append(p)
+            alive.append(fam)
+    base = compose_packages(pkgs)
+    timeline = []  # (time_s, order, event)
+    for w in windows:
+        if w["fault"] not in alive:
+            continue
+        start, stop = _window_events(w["fault"], opts)
+        stamp = {"pos": w.get("pos"), "digest": w.get("digest"),
+                 "fault": w["fault"], "host": host}
+        timeline.append((float(w["at_s"]), len(timeline),
+                         _stamp_event(start, stamp)))
+        if stop is not None and w["fault"] not in _ONE_SHOT_FAULTS:
+            timeline.append((float(w["at_s"]) + float(w["dur_s"]),
+                             len(timeline), _stamp_event(stop, stamp)))
+    timeline.sort(key=lambda t: (t[0], t[1]))
+    seq, t_prev = [], 0.0
+    for t, _, evt in timeline:
+        if t > t_prev:
+            seq.append(gen.sleep(t - t_prev))
+            t_prev = t
+        seq.append(gen.once(evt) if callable(evt) else evt)
+    base["generator"] = seq or None
+    return base
+
+
 # ---------------------------------------------------------------- compose
 
 PACKAGE_FNS = [partition_package, kill_package, pause_package,
@@ -324,16 +486,32 @@ PACKAGE_FNS = [partition_package, kill_package, pause_package,
                skew_package, membership_package]
 
 
+def _perf_list(pkg: dict) -> List[dict]:
+    """A package's perf entries as a flat list — base packages carry
+    one dict, COMPOSED packages a list (so composition must accept
+    both to be closed under itself)."""
+    perf = pkg.get("perf")
+    if not perf:
+        return []
+    return [p for p in perf if p] if isinstance(perf, list) else [perf]
+
+
 def _fs_of(pkg: dict) -> set:
-    perf = pkg.get("perf") or {}
-    return (set(perf.get("start", ())) | set(perf.get("stop", ()))
-            | set(perf.get("fs", ())))
+    out: set = set()
+    for perf in _perf_list(pkg):
+        out |= (set(perf.get("start", ())) | set(perf.get("stop", ()))
+                | set(perf.get("fs", ())))
+    return out
 
 
 def compose_packages(pkgs: Sequence[dict]) -> dict:
     """Combine packages: compose nemeses by their op fs; interleave
     generators with `any_gen`; chain final generators
-    (reference `nemesis.combined/compose-packages`)."""
+    (reference `nemesis.combined/compose-packages`).  Closed under
+    itself: an already-composed package (perf list, compose nemesis)
+    composes again — its fs is the union of its entries', and its
+    nested compose nemesis routes ops on — which is what lets a cell's
+    own nemesis package stack with a campaign-level window schedule."""
     pkgs = [p for p in pkgs if p]
     if not pkgs:
         return {"nemesis": nc.Noop(), "generator": None,
@@ -351,7 +529,7 @@ def compose_packages(pkgs: Sequence[dict]) -> dict:
         "nemesis": nc.compose(dispatch),
         "generator": gen.any_gen(*gens) if gens else None,
         "final_generator": finals or None,
-        "perf": [p.get("perf") for p in pkgs if p.get("perf")],
+        "perf": [q for p in pkgs for q in _perf_list(p)],
     }
 
 

@@ -38,8 +38,9 @@ import os
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
 
-__all__ = ["FaultInjected", "FaultPlan", "parse_spec", "plan_for",
-           "use", "install", "clear", "active_plan", "KIND_MESSAGES"]
+__all__ = ["FaultInjected", "FaultPlan", "parse_spec", "seeded_for",
+           "plan_for", "use", "install", "clear", "active_plan",
+           "KIND_MESSAGES"]
 
 #: synthetic messages mimic the real jaxlib failure strings so the
 #: transient classifier (policy.is_transient) exercises its production
@@ -99,6 +100,21 @@ def parse_spec(spec: Union[str, dict, None]) -> Optional[dict]:
         k, v = part.split("=", 1)
         out[k.strip()] = v.strip()
     return out or None
+
+
+def seeded_for(spec: Union[str, dict, None], salt: int
+               ) -> Optional[dict]:
+    """Derive a spec whose seed mixes in `salt` — the campaign-level
+    idiom (ISSUE 11): one ``"plan"`` template in a nemesis schedule
+    yields a distinct-but-replayable FaultPlan per generation, and
+    every host installing generation *g*'s plan injects identically.
+    The mix is a plain XOR of the normalized template seed, so
+    ``seeded_for(s, 0)`` keeps the template's own stream."""
+    d = parse_spec(spec)
+    if d is None:
+        return None
+    d["seed"] = int(d.get("seed", 0)) ^ int(salt)
+    return d
 
 
 class FaultPlan:

@@ -451,18 +451,29 @@ td, th {{ border: 1px solid #bbb; padding: 3px 8px; }}
         windows_html = ""
         fw = w.get("fault-windows") or []
         if fw:
+            def _wcell(win, k):
+                v = win.get(k)
+                return "&mdash;" if v is None else html.escape(str(v))
+
             rows = "".join(
                 f"<tr><td><code>{html.escape(str(win.get('f')))}</code>"
                 f"</td><td>{win.get('span', ['?', '?'])[0]}&ndash;"
                 f"{win.get('span', ['?', '?'])[1]}</td>"
-                f"<td>{len(win.get('ops') or ())} ops</td></tr>"
+                f"<td>{len(win.get('ops') or ())} ops</td>"
+                f"<td>{_wcell(win, 'pos')}</td>"
+                f"<td><code>{_wcell(win, 'digest')}</code></td>"
+                f"<td>{_wcell(win, 'host')}</td>"
+                f"<td>{_wcell(win, 'kept')}</td></tr>"
                 for win in fw)
             windows_html = (
                 "<h2>surviving fault windows</h2>"
                 "<p>the nemesis-schedule ddmin kept these windows "
                 "(reproduction-necessary or overlapping the witness "
-                "ops); spans are source-history op indices</p>"
-                f"<table><tr><th>fault</th><th>span</th><th>ops</th>"
+                "ops); spans are source-history op indices; scheduled "
+                "windows carry their schedule position/digest and the "
+                "executing host (the cross-host attribution)</p>"
+                "<table><tr><th>fault</th><th>span</th><th>ops</th>"
+                "<th>pos</th><th>digest</th><th>host</th><th>kept</th>"
                 f"</tr>{rows}</table>")
         quant = " ".join(
             f"{k.replace('_', ' ')}={w[k]}" for k in
@@ -1197,18 +1208,62 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
         if code != 200:
             return self._send_json(code, s)
         c = s.get("counts") or {}
+
+        def _wwin(d):
+            """Installed-window cell: digest + open positions, red when
+            the worker's reported digest disagrees with the
+            coordinator's authoritative set (a desynced worker must be
+            visible at a glance)."""
+            wd = d.get("windows")
+            if not wd:
+                return "&mdash;"
+            open_ = ",".join(str(o.get("pos"))
+                             for o in wd.get("open") or ()) or "-"
+            cell = (f"<code>{html.escape(str(wd.get('digest')))}</code>"
+                    f" open={html.escape(open_)}")
+            if not wd.get("synced"):
+                cell += ' <b style="color:#b00">DESYNCED</b>'
+            return cell
+
         wrows = "".join(
             f"<tr><td>{html.escape(w)}</td>"
             f"<td>{html.escape(str(d.get('host')))}</td>"
+            f"<td>{html.escape(str(d.get('backend')))}</td>"
             f"<td>{d.get('device-slots')}</td>"
             f"<td>{d.get('age-s')}s</td>"
-            f"<td>{'alive' if d.get('alive') else 'silent'}</td></tr>"
+            f"<td>{'alive' if d.get('alive') else 'silent'}</td>"
+            f"<td>{_wwin(d)}</td></tr>"
             for w, d in sorted((s.get("workers") or {}).items()))
         lrows = "".join(
             f"<tr><td><code>{html.escape(str(l['run']))}</code></td>"
             f"<td>{html.escape(str(l['worker']))}</td>"
             f"<td>{l['deadline']}</td></tr>"
             for l in s.get("leases") or [])
+        sched_html = ""
+        sched = s.get("nemesis-schedule")
+        if sched:
+            grows = []
+            gens = sched.get("gens") or {}
+            digests = sched.get("digest-by-gen") or {}
+            for g in sorted(gens, key=lambda x: int(x)):
+                wins = " ".join(
+                    f"[{w.get('pos')}:{html.escape(str(w.get('fault')))}"
+                    f"@{w.get('at_s')}s+{w.get('dur_s')}s]"
+                    for w in gens[g])
+                grows.append(
+                    f"<tr><td>{html.escape(str(g))}</td>"
+                    f"<td><code>{html.escape(str(digests.get(g)))}"
+                    f"</code></td><td>{wins}</td></tr>")
+            sched_html = (
+                "<h2>nemesis schedule</h2>"
+                f"<p>{sched.get('windows')} synchronized window(s) per "
+                f"generation over "
+                f"<code>{html.escape('|'.join(sched.get('faults')))}"
+                "</code> &mdash; every host's cell for a generation "
+                "installs the same seeded set (workers table shows "
+                "installed digests)</p>"
+                "<table><tr><th>gen</th><th>digest</th>"
+                f"<th>windows</th></tr>{''.join(grows)}</table>")
         name = str(s.get("campaign"))
         state = "finished" if s.get("finished") else "running"
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
@@ -1228,12 +1283,14 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 completions discarded &middot; queue digest
 <code>{html.escape(str(s.get("digest")))}</code></p>
 <h2>workers</h2>
-<table><tr><th>worker</th><th>host</th><th>device slots</th>
-<th>last seen</th><th></th></tr>{wrows or
-'<tr><td colspan="5">(none registered)</td></tr>'}</table>
+<table><tr><th>worker</th><th>host</th><th>backend</th>
+<th>device slots</th><th>last seen</th><th></th>
+<th>installed windows</th></tr>{wrows or
+'<tr><td colspan="7">(none registered)</td></tr>'}</table>
 <h2>active leases</h2>
 <table><tr><th>run</th><th>worker</th><th>deadline</th></tr>{lrows or
 '<tr><td colspan="3">(none)</td></tr>'}</table>
+{sched_html}
 </body></html>"""
         self._send(200, doc.encode())
 

@@ -439,6 +439,65 @@ def packed_rw_history(n_txns: int, n_keys: int, concurrency: int = 10,
 
 
 # ---------------------------------------------------------------------------
+# Cross-host nemesis-window histories (ISSUE 11 ddmin corpus).
+# ---------------------------------------------------------------------------
+
+
+def cross_host_window_history(necessary_host: str = "hostA",
+                              other_host: str = "hostB",
+                              bad_sum_delta: int = 3) -> History:
+    """Two hosts' instances of the same nemesis-schedule position, with
+    one torn whole-state read inside `necessary_host`'s window only
+    (`other_host`'s window is disjoint, before the read).  Nemesis ops
+    carry the schedule stamp (`Op.ext["window"]`: pos/digest/fault/
+    host) exactly as `nemesis.combined.schedule_package` emits it —
+    the fixture for cross-host fault-window ddmin (shared by
+    tests/test_invariants.py and scripts/fuzz_faults.py)."""
+
+    def nem_pair(f: str, host: str) -> List[Op]:
+        w = {"pos": 0, "digest": f"win-{host}", "fault": "skew",
+             "host": host}
+        return [Op(type=INVOKE, process="nemesis", f=f, value=None,
+                   ext={"window": dict(w)}),
+                Op(type=INFO, process="nemesis", f=f, value=None,
+                   ext={"window": dict(w)})]
+
+    ops: List[Op] = []
+    ops += nem_pair("start-skew", other_host)
+    ops += nem_pair("stop-skew", other_host)
+    ops += nem_pair("start-skew", necessary_host)
+    ops.append(Op(type=INVOKE, process=0, f="read", value=None))
+    ops.append(Op(type=OK, process=0, f="read",
+                  value={0: 10, 1: 10 - int(bad_sum_delta)}))
+    ops += nem_pair("stop-skew", necessary_host)
+    return History(ops)
+
+
+def cross_host_sensitive_check(necessary_host: str = "hostA",
+                               total: int = 20):
+    """A fault-sensitive check fn (wrap in `checkers.api.FnChecker`):
+    the anomaly reproduces only while `necessary_host`'s window is in
+    the schedule AND a torn read (wrong total) is present — the shape
+    that makes a window reproduction-NECESSARY rather than merely
+    overlap-kept."""
+
+    def check(test, history, opts):
+        has_host = any(
+            ((op.ext or {}).get("window") or {}).get("host")
+            == necessary_host for op in history)
+        torn = any(op.type == OK and isinstance(op.value, dict)
+                   and sum(op.value.values()) != total
+                   for op in history)
+        if torn and has_host:
+            return {"valid?": False,
+                    "anomaly-types": ["cross-host-torn-read"],
+                    "anomalies": {"cross-host-torn-read": 1}}
+        return {"valid?": True}
+
+    return check
+
+
+# ---------------------------------------------------------------------------
 # Linearizable-register histories (knossos test corpus).
 # ---------------------------------------------------------------------------
 

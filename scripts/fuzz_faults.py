@@ -190,6 +190,42 @@ def run_one(seed: int, p: float, deadline_s: float) -> dict:
             row["degraded"] += 1
         row["nemesis-runs"] = row.get("nemesis-runs", 0) + 1
 
+    # --- cross-host fault-window ddmin (ISSUE 11 satellite) ------------
+    # a merged multi-host nemesis schedule: two hosts ran the same
+    # window position, only host A's instance makes the (fault-
+    # sensitive) checker fail.  The ddmin must drop host B's window,
+    # keep host A's as reproduction-necessary, attribute it by host,
+    # and produce the identical digest at any probe worker count —
+    # every probe verdict attributable, never a crash
+    from jepsen_tpu import minimize
+    from jepsen_tpu.checkers.api import FnChecker
+
+    import tempfile as _tf2
+
+    xtest = {"name": "cross-host-ddmin",
+             "store-dir": _tf2.mkdtemp(prefix="fuzz-xhost-"),
+             "history": synth.cross_host_window_history(
+                 "hostA", "hostB", bad_sum_delta=3 + seed % 3)}
+    host_sensitive = synth.cross_host_sensitive_check("hostA")
+    xres = {}
+    for workers in (1, 3):
+        s = minimize.shrink(
+            dict(xtest), checker=FnChecker(host_sensitive, "x-host"),
+            workers=workers, force=True)
+        assert s.get("valid?") is False, \
+            f"cross-host ddmin lost the verdict ({s})"
+        fw = s.get("fault-windows") or []
+        assert [ (w.get("host"), w.get("kept")) for w in fw] == \
+            [("hostA", "necessary")], \
+            f"cross-host witness must keep exactly host A's window " \
+            f"as reproduction-necessary, got {fw}"
+        xres[workers] = (s["digest"],
+                         [w.get("digest") for w in fw])
+    assert xres[1] == xres[3], \
+        f"cross-host witness not digest-stable across worker counts " \
+        f"({xres})"
+    row["cross-host-windows"] = 1
+
     # --- flight recorder under chaos (ISSUE 5 satellite) ---------------
     # every faulted / deadline-killed TELEMETRIC run must still leave a
     # well-formed (tail-truncated at worst) events.jsonl: parseable,

@@ -22,6 +22,15 @@ verdict record (zero lost, zero duplicated) and the distributed result
 set equals a single-process ``run_campaign`` of the same spec on
 verdict keys.
 
+Then the **coordinated-chaos round** (ISSUE 11 acceptance): a campaign
+whose spec carries a ``"nemesis-schedule"`` (a synchronized
+skew+partition window pair per generation) runs distributed over 3
+workers under the same control-plane chaos, and must produce — per
+generation — the same minimal witness set (same fault-window digests,
+host-attributed) as a single-process ``run_campaign`` of the identical
+spec + seed, with every verdict attributable and every observed
+worker window tick synced to the coordinator's authoritative set.
+
 Usage::
 
     python scripts/soak_fleet.py --fast      # tier-1 smoke (the
@@ -102,6 +111,134 @@ def spawn_worker(base, url, name, seed, fault_p, env):
          "fleet", "work", "--coordinator", url, "--name", name,
          "--poll", "0.1"],
         env=wenv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+#: the coordinated-chaos spec (ISSUE 11): generation-scoped
+#: synchronized windows — skew first (it corrupts sim reads, making
+#: cells reliably invalid), then a partition window after the client
+#: ops drain (droppable, so ddmin must drop it on every host alike)
+def chaos_spec(cells: int) -> dict:
+    return {
+        "name": "fleetchaos", "workloads": ["bank"],
+        "seeds": list(range(cells)),
+        "nemesis-schedule": {"faults": ["skew", "partition"],
+                             "windows": 2, "interval": 0.02,
+                             "duration": 0.2, "seed": 5},
+        "opts": {"time-limit": 1.0, "ops": 240, "concurrency": 3,
+                 "client-latency": 0.002,
+                 "shrink": {"host-oracle": True, "probe-deadline": 20}},
+    }
+
+
+def witness_windows(rec) -> list:
+    """(digest, kept) pairs of a record's surviving fault windows —
+    host-free, so a fleet cell and its single-process twin compare
+    equal iff the SAME schedule windows survived for the same
+    reasons."""
+    wit = rec.get("witness") or {}
+    return sorted((w.get("digest"), w.get("kept"))
+                  for w in wit.get("fault-windows") or ())
+
+
+def coordinated_chaos_round(args, env) -> list:
+    """Distributed nemesis-schedule campaign vs its single-process
+    twin; returns failure strings (empty = round passed)."""
+    import tempfile as _tf
+
+    from jepsen_tpu import campaign
+    from jepsen_tpu.campaign import core as ccore
+    from jepsen_tpu.campaign.index import Index
+
+    failures = []
+    cells = 3
+    n_workers = 3
+    spec = chaos_spec(cells)
+    base = _tf.mkdtemp(prefix="fleet-chaos-")
+    spec_path = os.path.join(base, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    port = free_port()
+    url = f"http://127.0.0.1:{port}"
+    lease = max(args.lease, 4.0)  # shrink runs inside the lease
+    coord = spawn_coordinator(base, spec_path, port, lease, env)
+    workers = {}
+    sightings = []  # (worker, digest, synced)
+    try:
+        s = wait_status(url, lambda s: s.get("nemesis-schedule"), 60,
+                        "chaos coordinator up with a schedule")
+        auth = s["nemesis-schedule"]["digest-by-gen"]
+        for i in range(n_workers):
+            workers[f"cw{i}"] = spawn_worker(
+                base, url, f"cw{i}", args.seed * 100 + i, args.fault_p,
+                env)
+
+        def record_ticks(st):
+            for w, d in (st.get("workers") or {}).items():
+                wd = d.get("windows")
+                if wd:
+                    sightings.append((w, wd.get("digest"),
+                                      bool(wd.get("synced"))))
+            return st.get("finished")
+
+        wait_status(url, record_ticks, 240, "chaos campaign finished")
+    finally:
+        for p in list(workers.values()) + [coord]:
+            if p.poll() is None:
+                p.kill()
+    desynced = [s for s in sightings if not s[2]]
+    if not sightings:
+        failures.append("coordinated chaos: no worker window ticks "
+                        "observed on /fleet/status")
+    if desynced:
+        failures.append(f"coordinated chaos: DESYNCED worker window "
+                        f"ticks: {desynced[:5]}")
+    idx = Index(ccore.index_path("fleetchaos", base))
+    got = idx.latest_by_run()
+    bad = [r for r in got.values()
+           if r.get("valid?") not in (True, False, "unknown")]
+    if bad:
+        failures.append(f"coordinated chaos: unattributable verdicts "
+                        f"{bad}")
+    wrong_install = [
+        r["run"] for r in got.values()
+        if r.get("windows-digest") != auth.get(str(r.get("seed")))]
+    if wrong_install:
+        failures.append(
+            f"coordinated chaos: cells ran with a window set other "
+            f"than the authoritative one: {wrong_install}")
+    # the acceptance: same minimal witness set as single-process
+    ref_base = _tf.mkdtemp(prefix="fleet-chaos-ref-")
+    ref = campaign.run_campaign(spec, ref_base, workers=2)
+    ref_by_key = {r["key"]: r for r in ref["rows"]}
+    got_by_key = {r["key"]: r for r in got.values()}
+    for key in sorted(set(ref_by_key) | set(got_by_key)):
+        g, r = got_by_key.get(key, {}), ref_by_key.get(key, {})
+        if g.get("valid?") != r.get("valid?"):
+            failures.append(
+                f"coordinated chaos: verdict mismatch at {key}: "
+                f"fleet {g.get('valid?')} vs single-process "
+                f"{r.get('valid?')}")
+            continue
+        if witness_windows(g) != witness_windows(r):
+            failures.append(
+                f"coordinated chaos: witness fault-window mismatch at "
+                f"{key}: fleet {witness_windows(g)} vs single-process "
+                f"{witness_windows(r)}")
+    if not failures:
+        hosts = sorted({w.get("host") for r in got.values()
+                        for w in (r.get("witness") or {}).get(
+                            "fault-windows") or ()})
+        print(f"coordinated chaos OK: synchronized windows across "
+              f"{n_workers} workers ({len(sightings)} synced tick "
+              f"sightings), witness windows match single-process "
+              f"({cells}/{cells} generations; surviving windows "
+              f"host-attributed to {hosts})")
+        shutil.rmtree(base, ignore_errors=True)
+        shutil.rmtree(ref_base, ignore_errors=True)
+    else:
+        print(f"coordinated chaos round FAILED (store: {base})",
+              file=sys.stderr)
+    return failures
 
 
 def main():
@@ -278,6 +415,9 @@ def main():
         failures.append(f"distributed != single-process verdicts: "
                         f"{diff}")
 
+    # -- the coordinated-chaos round (ISSUE 11 acceptance) ------------
+    failures += coordinated_chaos_round(args, env)
+
     wall = time.time() - t0
     if failures:
         for f in failures:
@@ -287,9 +427,9 @@ def main():
         return 1
     print(f"fleet soak OK: {args.cells} cells x {args.workers} workers "
           f"under chaos (worker kill -9, coordinator kill -9 + "
-          f"restart{', zombie freeze' if zombie else ''}) — exactly "
-          f"one verdict per cell, distributed == single-process, "
-          f"in {wall:.1f}s")
+          f"restart{', zombie freeze' if zombie else ''}) + a "
+          f"coordinated nemesis-schedule round — exactly one verdict "
+          f"per cell, distributed == single-process, in {wall:.1f}s")
     if args.store is None:
         shutil.rmtree(base, ignore_errors=True)
         shutil.rmtree(ref_base, ignore_errors=True)

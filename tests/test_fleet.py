@@ -536,25 +536,322 @@ def test_store_tests_skips_fleet_subtree(tmp_path):
             for d in store.tests(base=base)] == ["a-test"]
 
 
+# ------------------------------- nemesis schedule (ISSUE 11 tentpole)
+
+SCHED_SPEC = {
+    "name": "fl-sched", "workloads": ["bank"], "seeds": [0, 1],
+    "nemesis-schedule": {"faults": ["skew", "partition"], "windows": 2,
+                         "interval": 0.02, "duration": 0.2, "seed": 5},
+    "opts": {"time-limit": 0.3, "ops": 60, "concurrency": 2,
+             "client-latency": 0.002},
+}
+
+
+def test_schedule_windows_deterministic_and_generation_scoped():
+    from jepsen_tpu.campaign.plan import (expand, schedule_windows,
+                                          windows_digest)
+
+    w0 = schedule_windows(SCHED_SPEC, 0)
+    assert w0 == schedule_windows(SCHED_SPEC, 0)  # pure function
+    assert [w["fault"] for w in w0] == ["skew", "partition"]
+    assert all(w["digest"] for w in w0)
+    w1 = schedule_windows(SCHED_SPEC, 1)
+    # generation-scoped: each generation draws its own seeded layout
+    assert [w["digest"] for w in w0] != [w["digest"] for w in w1]
+    assert windows_digest(w0) != windows_digest(w1)
+    # expand injects the window set into every cell's opts — the
+    # single-process and distributed expansions of one spec are
+    # chaos-equivalent cell for cell
+    specs = expand(SCHED_SPEC)
+    assert all(rs.opts.get("nemesis-windows") ==
+               schedule_windows(SCHED_SPEC, rs.seed) for rs in specs)
+    # and run ids stay stable across re-expansion
+    assert [rs.run_id for rs in specs] == \
+        [rs.run_id for rs in expand(SCHED_SPEC)]
+
+
+def test_schedule_composes_with_per_cell_nemesis():
+    """Review regression: a cell carrying BOTH its own nemesis opts and
+    the campaign window schedule must compose (compose_packages is
+    closed under composition) — and both fault sources' ops must be
+    routed and answered in the run's history."""
+    import tempfile
+
+    from jepsen_tpu import core as jcore
+    from jepsen_tpu.campaign.plan import build_test, expand
+
+    spec = dict(SCHED_SPEC, name="fl-both", seeds=[0])
+    spec["workloads"] = [{"name": "bank", "opts": {
+        "nemesis": {"faults": ["membership"], "interval": 0.05}}}]
+    spec["nemesis-schedule"] = {"faults": ["skew"], "windows": 1,
+                                "interval": 0.02, "duration": 0.2,
+                                "seed": 3}
+    rs = expand(spec)[0]
+    assert rs.opts["nemesis"] and rs.opts["nemesis-windows"]
+    t = build_test(rs, tempfile.mkdtemp(prefix="both-"))
+    done = jcore.run(t)
+    assert "valid?" in (done.get("results") or {})
+    nem_fs = {op.f for op in done["history"]
+              if op.process == "nemesis" and op.type != "invoke"}
+    assert "start-skew" in nem_fs  # the scheduled window ran...
+    assert nem_fs & {"leave-node", "join-node", "membership-view"}, \
+        nem_fs  # ...and so did the cell's own nemesis
+
+
+def test_schedule_validates_fault_families():
+    from jepsen_tpu.campaign.plan import load_spec
+
+    bad = dict(SCHED_SPEC,
+               **{"nemesis-schedule": {"faults": ["wat"]}})
+    with pytest.raises(ValueError, match="wat"):
+        load_spec(bad)
+    neg = dict(SCHED_SPEC, **{"nemesis-schedule": {
+        "faults": ["skew"], "duration": -0.5}})
+    with pytest.raises(ValueError, match="duration"):
+        load_spec(neg)  # heal-before-start schedules refused at plan time
+
+
+def test_schedule_plan_template_seeds_per_generation():
+    """A schedule "plan" template derives a distinct-but-replayable
+    FaultPlan spec per generation, installed only when the cell's own
+    fault axis is empty."""
+    from jepsen_tpu.campaign.plan import build_test, expand
+    from jepsen_tpu.resilience.faults import seeded_for
+
+    spec = dict(SCHED_SPEC, name="fl-plan")
+    spec["nemesis-schedule"] = dict(
+        SCHED_SPEC["nemesis-schedule"],
+        plan={"seed": 9, "p": 0.1, "kinds": "oom"})
+    specs = expand(spec)
+    by_seed = {rs.seed: rs for rs in specs}
+    assert by_seed[0].opts["nemesis-plan"]["seed"] == 9 ^ 0
+    assert by_seed[1].opts["nemesis-plan"]["seed"] == 9 ^ 1
+    assert seeded_for({"seed": 9}, 1)["seed"] == 8
+    t = build_test(by_seed[1], "store")
+    assert t["faults"]["seed"] == 9 ^ 1
+    # an explicit fault axis entry wins over the schedule plan
+    spec2 = dict(spec, faults=[{"seed": 77, "p": 0.2}])
+    rs2 = expand(spec2)[0]
+    assert build_test(rs2, "store")["faults"]["seed"] == 77
+
+
+def test_queue_affinity_and_starvation_fallback(tmp_path):
+    """Worker-affine placement: a device cell pinning a backend defers
+    on non-matching workers (counted), lands on the matching one, and
+    falls back to any device-capable worker once starved past a
+    lease."""
+    from jepsen_tpu import telemetry
+
+    q = WorkQueue(str(tmp_path / "q.jsonl"))
+    cell = _spec("dev", device=True)
+    cell["opts"] = {"backend": "tpu"}
+    q.enqueue(cell)
+    reg = telemetry.registry()
+    before = reg.counter("fleet-affinity-deferrals", worker="cpu-w").value
+    # a cpu worker defers; the tpu worker claims
+    spec, _ = q.claim("cpu-w", lease_s=5.0,
+                      caps={"backend": "cpu"}, now=100.0)
+    assert spec is None
+    assert reg.counter("fleet-affinity-deferrals",
+                       worker="cpu-w").value == before + 1
+    spec, _ = q.claim("tpu-w", lease_s=5.0,
+                      caps={"backend": "tpu"}, now=100.5)
+    assert spec and spec["run_id"] == "dev"
+    # starvation-safe fallback: past one lease of deferral, any
+    # device-capable worker may take it
+    q2 = WorkQueue(str(tmp_path / "q2.jsonl"))
+    q2.enqueue(dict(_spec("dev2", device=True), opts={"backend": "tpu"}))
+    assert q2.claim("cpu-w", lease_s=5.0, caps={"backend": "cpu"},
+                    now=100.0)[0] is None  # arms the clock
+    assert q2.claim("cpu-w", lease_s=5.0, caps={"backend": "cpu"},
+                    now=103.0)[0] is None  # still inside the lease
+    spec, _ = q2.claim("cpu-w", lease_s=5.0, caps={"backend": "cpu"},
+                       now=106.0)
+    assert spec and spec["run_id"] == "dev2"  # starved: affinity yields
+    # mesh-shape pins behave the same way
+    q3 = WorkQueue(str(tmp_path / "q3.jsonl"))
+    q3.enqueue(dict(_spec("dev3", device=True), opts={"mesh": "2x2"}))
+    assert q3.claim("w", lease_s=5.0, caps={"mesh": [4]},
+                    now=0.0)[0] is None
+    assert q3.claim("w", lease_s=5.0, caps={"mesh": [2, 2]},
+                    now=0.1)[0] is not None
+
+
+def test_claim_broadcasts_windows_and_worker_installs(tmp_path):
+    """The claim response carries the cell generation's synchronized
+    window set; the worker installs it (authoritative over the
+    ledger's serialized spec) before execute_run."""
+    from jepsen_tpu.campaign.plan import schedule_windows, windows_digest
+    from jepsen_tpu.campaign.plan import RunSpec
+
+    base = str(tmp_path)
+    coord = FleetCoordinator(SCHED_SPEC, base, lease_s=5.0)
+    try:
+        code, r = coord.claim({"worker": "w"})
+        assert code == 200 and r["spec"]
+        g = r["spec"]["seed"]
+        want = schedule_windows(SCHED_SPEC, g)
+        assert r["windows"]["set"] == want
+        assert r["windows"]["digest"] == windows_digest(want)
+        assert r["windows"]["gen"] == g
+        # the worker-side install: claim wins even over a stale spec
+        w = FleetWorker("http://127.0.0.1:1", base, name="w")
+        stale = dict(r["spec"], opts=dict(r["spec"]["opts"]))
+        stale["opts"].pop("nemesis-windows", None)  # pre-schedule ledger
+        rs = RunSpec.from_dict(stale)
+        w._install_windows(rs, r["windows"])
+        assert rs.opts["nemesis-windows"] == want
+        assert rs.opts["_fleet-host"] == "w"
+        assert w.installed_windows["digest"] == r["windows"]["digest"]
+        # tick derivation: before any window opens, none are open
+        ticks = w._window_ticks(__import__("time").monotonic())
+        assert ticks["digest"] == r["windows"]["digest"]
+        assert ticks["n"] == 2 and ticks["open"] == []
+    finally:
+        coord.close()
+
+
+def test_heartbeat_ticks_sync_and_desync_visible(tmp_path):
+    """Lease renewal doubles as chaos clock sync: worker window ticks
+    land in the coordinator's worker table (synced flag, /fleet page,
+    gauges); a desynced digest is visible at a glance."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.telemetry import prometheus
+
+    base = str(tmp_path)
+    coord = FleetCoordinator(SCHED_SPEC, base, lease_s=5.0)
+    try:
+        code, r = coord.claim({"worker": "w"})
+        auth = r["windows"]["digest"]
+        code, hb = coord.heartbeat({
+            "worker": "w",
+            "windows": {"gen": r["windows"]["gen"], "digest": auth,
+                        "open": [{"pos": 0, "fault": "skew"}]},
+            "renew": [r["spec"]["run_id"]]})
+        assert code == 200 and hb["windows-digest"] == auth
+        code, s = coord.status()
+        ws = s["workers"]["w"]["windows"]
+        assert ws["synced"] is True and ws["digest"] == auth
+        assert s["nemesis-schedule"]["digest-by-gen"][
+            str(r["windows"]["gen"])] == auth
+        lines = prometheus.render_registry(telemetry.registry())
+        assert any("jepsen_fleet_nemesis_windows_active" in ln
+                   and 'fault="skew"} 1' in ln for ln in lines), lines
+        # a desynced worker is flagged
+        coord.heartbeat({"worker": "w",
+                         "windows": {"gen": r["windows"]["gen"],
+                                     "digest": "bogus", "open": []}})
+        code, s = coord.status()
+        assert s["workers"]["w"]["windows"]["synced"] is False
+        # windows retire with the cell
+        coord.heartbeat({"worker": "w", "state": None, "windows": None})
+        code, s = coord.status()
+        assert "windows" not in s["workers"]["w"]
+    finally:
+        coord.close()
+
+
+def test_nemesis_broadcast_survives_heartbeat_chaos(tmp_path):
+    """ISSUE 11 satellite: with the fleet.heartbeat seam fully dead
+    (the existing fleet.* fault sites), a worker misses every window
+    tick — and still installs the correct seeded window set from its
+    next claim: the records' installed-window digests equal the
+    coordinator's authoritative ones."""
+    from jepsen_tpu.campaign.plan import schedule_windows, windows_digest
+    from jepsen_tpu.resilience import RetryPolicy
+    from jepsen_tpu.resilience.faults import FaultPlan, use
+    from jepsen_tpu.resilience.policy import is_transient_http
+
+    base = str(tmp_path)
+    spec = dict(SCHED_SPEC, name="fl-chaos-hb")
+    coord = FleetCoordinator(spec, base, lease_s=30.0)
+    srv = web.serve(port=0, base=base, background=True, fleet=coord)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    plan = FaultPlan(persistent=("fleet.heartbeat",), kinds=("oom",))
+    try:
+        w = FleetWorker(url, base, name="deaf", poll_s=0.05,
+                        retry=RetryPolicy(max_attempts=2,
+                                          base_delay_s=0.02,
+                                          classify=is_transient_http))
+        with use(plan):
+            t = threading.Thread(target=w.run, daemon=True)
+            t.start()
+            t.join(timeout=120)
+            assert not t.is_alive(), "worker wedged"
+        assert len(plan.injected) > 0  # heartbeats really dropped
+        idx = Index(ccore.index_path("fl-chaos-hb", base))
+        recs = list(idx.latest_by_run().values())
+        assert len(recs) == 2
+        for rec in recs:
+            want = windows_digest(schedule_windows(spec, rec["seed"]))
+            assert rec["windows-digest"] == want
+        # the ticks never arrived: the worker table records no windows
+        code, s = coord.status()
+        assert "windows" not in s["workers"]["deaf"]
+    finally:
+        srv.server_close()
+        coord.close()
+
+
+def test_worker_claim_backoff_seeded_and_budgeted():
+    """Claim give-up is a seeded-jittered backoff under a configurable
+    budget — two workers never share a delay stream (no synchronized
+    re-poll storms), and the budget bounds the total wait."""
+    wa = FleetWorker("http://127.0.0.1:1", "store", name="wa",
+                     poll_s=0.1)
+    wb = FleetWorker("http://127.0.0.1:1", "store", name="wb",
+                     poll_s=0.1)
+    da = [wa._claim_backoff(i) for i in range(1, 9)]
+    db = [wb._claim_backoff(i) for i in range(1, 9)]
+    assert da != db  # per-name seeding desynchronizes the fleet
+    wa2 = FleetWorker("http://127.0.0.1:1", "store", name="wa",
+                      poll_s=0.1)
+    assert da == [wa2._claim_backoff(i) for i in range(1, 9)]
+    # ...but each worker's stream replays
+    for i, d in enumerate(da, start=1):
+        base = min(0.1 * 2 ** (i - 1), 5.0)
+        assert 0.5 * base <= d <= 1.5 * base
+    # budget give-up: a claim outage outlasting claim_budget_s raises
+    w = FleetWorker("http://127.0.0.1:1", "store", name="wc",
+                    poll_s=0.01, claim_budget_s=0.05)
+    w.register = lambda: None
+    calls = []
+
+    def dead_post(site, path, doc):
+        calls.append(site)
+        raise ConnectionRefusedError("down")
+
+    w._post = dead_post
+    with pytest.raises(ConnectionRefusedError):
+        w.run()
+    assert len(calls) > 1  # re-polled under backoff before giving up
+
+
 # ------------------------------------------- chaos acceptance (tier 1)
 
 def test_fleet_soak_fast_chaos_acceptance():
-    """The ISSUE 9 acceptance pin, end to end in subprocesses: a
-    12-cell campaign run by 3 workers under seeded control-plane chaos
-    (drops + stalls on claim/heartbeat/complete, both sides), one
-    worker kill -9 (lease-expiry requeue), one coordinator kill -9 +
-    restart (ledger replay digest-pinned against an independent
-    replay) — exactly one attributable verdict per cell, and the
-    distributed result set equals a single-process run_campaign on
-    verdict keys."""
+    """The ISSUE 9 + ISSUE 11 acceptance pin, end to end in
+    subprocesses: a 12-cell campaign run by 3 workers under seeded
+    control-plane chaos (drops + stalls on claim/heartbeat/complete,
+    both sides), one worker kill -9 (lease-expiry requeue), one
+    coordinator kill -9 + restart (ledger replay digest-pinned against
+    an independent replay) — exactly one attributable verdict per
+    cell, the distributed result set equal to a single-process
+    run_campaign on verdict keys — followed by the coordinated-chaos
+    round: a synchronized skew+partition window schedule across 3
+    workers whose per-generation minimal witness sets (fault-window
+    digests, host-attributed) equal the single-process equivalent of
+    the same spec + seed."""
     script = os.path.join(os.path.dirname(__file__), os.pardir,
                           "scripts", "soak_fleet.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run([sys.executable, script, "--fast"],
-                          capture_output=True, text=True, timeout=280,
+                          capture_output=True, text=True, timeout=420,
                           env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "fleet soak OK" in proc.stdout
     assert "replayed to identical state" in proc.stdout
     assert "killed -9 worker" in proc.stdout
     assert "killed -9 coordinator" in proc.stdout
+    assert "coordinated chaos OK" in proc.stdout
+    assert "witness windows match single-process" in proc.stdout

@@ -543,6 +543,67 @@ def test_one_shot_faults_are_own_windows():
     assert [len(w) for w in wins] == [1, 1, 3, 1]
 
 
+def _xhost_history():
+    """Two hosts' instances of the same schedule position, only host
+    A's covering the torn read (the ISSUE 11 cross-host shape) — the
+    shared fixture `synth.cross_host_window_history` (scripts/
+    fuzz_faults.py pins the same shape)."""
+    from jepsen_tpu.workloads import synth
+
+    return synth.cross_host_window_history("hostA", "hostB")
+
+
+def test_fault_windows_group_by_host():
+    """ISSUE 11: window-stamped nemesis units group by (host, digest)
+    — each host's instance of a schedule position is its own window —
+    and the descriptors carry the schedule identity + host
+    attribution."""
+    from jepsen_tpu.minimize import reduce as reduce_mod
+
+    units = reduce_mod.units_of(_xhost_history())
+    nem = [u for u in units if reduce_mod.is_nemesis_unit(u)]
+    wins = reduce_mod.fault_windows(nem)
+    assert len(wins) == 2
+    desc = reduce_mod.window_descriptors(nem, wins,
+                                         ["overlap", "necessary"])
+    assert [(d["host"], d["digest"], d["kept"]) for d in desc] == \
+        [("hostB", "win-hostB", "overlap"),
+         ("hostA", "win-hostA", "necessary")]
+    # stamped and unstamped units coexist: an unscheduled one-shot
+    # fault still groups heuristically beside the stamped windows
+    extra = reduce_mod.units_of(History(
+        list(_xhost_history()) + _nem("bump-clock", 0)))
+    nem2 = [u for u in extra if reduce_mod.is_nemesis_unit(u)]
+    assert len(reduce_mod.fault_windows(nem2)) == 3
+
+
+def test_cross_host_ddmin_attributes_necessary_window(tmp_path):
+    """The cross-host fault-window ddmin end to end: a fault-sensitive
+    checker that needs host A's window keeps exactly that window,
+    marked reproduction-necessary and host-attributed; host B's
+    (disjoint, droppable) window goes — digest-stable at any probe
+    worker count."""
+    from jepsen_tpu import minimize
+    from jepsen_tpu.checkers.api import FnChecker
+    from jepsen_tpu.workloads import synth
+
+    host_sensitive = synth.cross_host_sensitive_check("hostA")
+    test = {"name": "xhost", "store-dir": str(tmp_path / "s"),
+            "history": _xhost_history()}
+    s1 = minimize.shrink(dict(test),
+                         checker=FnChecker(host_sensitive, "x-host"),
+                         workers=1, force=True)
+    assert s1["valid?"] is False
+    assert [(w["host"], w["kept"], w["digest"])
+            for w in s1["fault-windows"]] == \
+        [("hostA", "necessary", "win-hostA")]
+    s3 = minimize.shrink(dict(test),
+                         checker=FnChecker(host_sensitive, "x-host"),
+                         workers=3, force=True)
+    assert s3["digest"] == s1["digest"]
+    assert s3["fault-windows"] == s1["fault-windows"]
+
+
 def test_interleaved_package_windows_pair_by_family():
     """Composed packages interleave: stop-skew must close start-skew,
     not the partition window opened in between."""

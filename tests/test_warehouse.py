@@ -715,6 +715,14 @@ def _golden_exposition(base):
     reg.counter("fleet-requeues", worker="w1",
                 reason="lease-expired").inc(2)
     reg.counter("fleet-duplicate-completions", worker="w1").inc(1)
+    # coordinated chaos (ISSUE 11 satellite): currently-open
+    # synchronized nemesis windows by fault family, and worker-affine
+    # placement deferrals
+    reg.gauge("fleet-nemesis-windows-active", campaign="soak",
+              fault="skew").set(1)
+    reg.gauge("fleet-nemesis-windows-active", campaign="soak",
+              fault="partition").set(0)
+    reg.counter("fleet-affinity-deferrals", worker="w1").inc(3)
     cdir = os.path.join(str(base), "campaigns")
     os.makedirs(cdir, exist_ok=True)
     with open(os.path.join(cdir, "soak.live.json"), "w") as f:
