@@ -28,6 +28,15 @@ this long before the CPU fallback, default 450), BENCH_DEADLINE (s,
 default 2700), BENCH_CACHE_DIR (persistent XLA compilation cache,
 default <repo>/.jax_cache).
 
+Sharded rows (``--shards N`` argv or BENCH_SHARDS, ISSUE 12
+satellite): on the CPU backend the bench boots N virtual host devices
+and, after the headline (sharded-default) measurement, times the SAME
+padded history through the single-device path and the N-shard default,
+asserting identical verdict bits — the per-shard-count rows land under
+``"shards"`` in the payload.  Caveat: XLA:CPU's GSPMD compile of the
+sharded program is very slow at >= 2^16-txn shapes (absorbed once into
+the persistent cache); real accelerator backends compile on-device.
+
 Streaming mode (``--streaming`` argv or BENCH_STREAMING=1, ISSUE 7
 satellite): additionally feeds each rung's history through the
 incremental ``verifier.VerifierSession`` in BENCH_STREAM_SEG-txn
@@ -51,11 +60,26 @@ import traceback
 BASELINE_OPS_PER_SEC = 10_000_000 / 60.0  # BASELINE.json: 10M ops in 60 s
 
 
+def _shards_arg() -> int:
+    """--shards N argv (or BENCH_SHARDS): bench the sharded-by-default
+    path over N virtual host devices on the CPU backend (real devices
+    shard automatically on TPU).  0 = unset."""
+    if "--shards" in sys.argv:
+        try:
+            return int(sys.argv[sys.argv.index("--shards") + 1])
+        except (ValueError, IndexError):
+            return 0
+    try:
+        return int(os.environ.get("BENCH_SHARDS", 0))
+    except ValueError:
+        return 0
+
+
 def _force_cpu_backend():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from jepsen_tpu.utils.backend import force_cpu_backend
 
-    force_cpu_backend()
+    force_cpu_backend(_shards_arg() or None)
 
 
 def _probe_default_backend(timeout_s: float) -> str:
@@ -253,6 +277,10 @@ def _run_size(n_txns: int, repeats: int):
         telemetry.registry().gauge(
             "checker-ops-per-s", checker="device-core").set(
             round(ops_per_sec, 1))
+        # --shards: quote single-device vs sharded-default on the SAME
+        # padded history, verdict-asserted identical (ISSUE 12)
+        shard_rows = (_run_shard_rows(h, p, repeats, check)
+                      if _shards_arg() > 1 else None)
         streaming = (_run_streaming(p, n_txns)
                      if _streaming_enabled() else None)
         doc = telemetry.snapshot(coll)
@@ -277,9 +305,49 @@ def _run_size(n_txns: int, repeats: int):
             "check_ops_per_s": round(ops_per_sec, 1),
         },
     }
+    if shard_rows is not None:
+        out["shards"] = shard_rows
     if streaming is not None:
         out["streaming"] = streaming
     return out
+
+
+def _run_shard_rows(h, p, repeats: int, check):
+    """Per-shard-count rows: the same padded history through the
+    single-device path (JEPSEN_SHARDS=1) and the sharded default
+    (all visible devices), bits asserted identical."""
+    import jax
+    import numpy as np
+
+    n_dev = len(jax.devices())
+    rows = {}
+    ref = None
+    for n in (1, n_dev):
+        prev = os.environ.get("JEPSEN_SHARDS")
+        os.environ["JEPSEN_SHARDS"] = str(n)
+        try:
+            bits, _ = check(h, p.n_keys)  # warm / compile
+            jax.block_until_ready(bits)
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                bits, _ = check(h, p.n_keys)
+                jax.block_until_ready(bits)
+                best = min(best, time.perf_counter() - t0)
+            b = np.asarray(bits)
+            if ref is None:
+                ref = b
+            else:
+                assert np.array_equal(b, ref), \
+                    "sharded verdict bits != single-device bits"
+            rows[str(n)] = {"value": round(p.n_txns / best, 1),
+                            "unit": "ops/sec", "wall_s": round(best, 3)}
+        finally:
+            if prev is None:
+                os.environ.pop("JEPSEN_SHARDS", None)
+            else:
+                os.environ["JEPSEN_SHARDS"] = prev
+    return {"devices": n_dev, "rows": rows}
 
 
 def _streaming_enabled():

@@ -232,7 +232,18 @@ class Scheduler:
                 if self.executor == "subprocess":
                     rec = self._run_subprocess(rs, slot)
                 else:
-                    rec = execute(rs)
+                    # pin this thread's device slice to the acquired
+                    # slot: the run's device checks then build their
+                    # default mesh over slot_devices(slot, n_slots) —
+                    # one host drives N sub-meshes concurrently
+                    # (parallel/slots.py, ISSUE 12 satellite)
+                    from jepsen_tpu.parallel import slots as slots_mod
+
+                    slots_mod.set_active_slot(slot, self.slots.n)
+                    try:
+                        rec = execute(rs)
+                    finally:
+                        slots_mod.set_active_slot(None)
                 rec["attempt"] = attempt
                 if slot is not None:
                     rec["device-slot"] = slot
@@ -267,6 +278,7 @@ class Scheduler:
         env = dict(os.environ)
         if slot is not None:
             env["JEPSEN_CAMPAIGN_DEVICE_SLOT"] = str(slot)
+            env["JEPSEN_CAMPAIGN_DEVICE_SLOTS"] = str(self.slots.n)
         try:
             r = subprocess.run(
                 [sys.executable, "-m", "jepsen_tpu.campaign.runner"],

@@ -126,12 +126,21 @@ def _merge_valid(vs: Iterable[Any]) -> Any:
 
 
 class Compose(Checker):
-    """A map of named checkers run over the same history."""
+    """A map of named checkers run over the same history.
+
+    The history is wrapped in ONE :class:`~jepsen_tpu.history.ir.
+    HistoryIR` (a History subclass sharing the same op list), so every
+    IR-aware sub-checker reuses the same packed columns / inference
+    instead of re-deriving per family (docs/IR.md)."""
 
     def __init__(self, checkers: Dict[str, Checker]):
         self.checkers = checkers
 
     def check(self, test, history, opts=None):
+        if isinstance(history, History):
+            from jepsen_tpu.history.ir import HistoryIR
+
+            history = HistoryIR.of(history)
         results = {name: check_safe(c, test, history, opts)
                    for name, c in self.checkers.items()}
         return {"valid?": _merge_valid(r.get("valid?") for r in results.values()),

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +54,7 @@ from jepsen_tpu.ops.segments import (
 )
 
 BIG = jnp.int32(2 ** 30)
+BIG_I = 2 ** 30  # host-side twin (the IR column derivation)
 
 
 @dataclasses.dataclass
@@ -89,6 +90,33 @@ class PaddedLA:
     #                                (0 = unknown or > _RUN_CAP_MAX)
     complete_monotone: bool = False  # static: txn_complete_pos strictly
     #                                  increasing over valid txns
+    # IR v2 capacity/layout facts (history/ir.py).  0/False = unknown:
+    # infer falls back to the legacy R-sized tables / unsorted scatters.
+    v_cap: int = 0                 # static: pow2 > max value id — the
+    #                                value-table capacity (legacy: R)
+    o_cap: int = 0                 # static: pow2 >= total version-order
+    #                                slots (sum of per-key longest-read
+    #                                lengths; legacy: R)
+    app_val_mono: bool = False     # static: append mop val ids
+    #                                nondecreasing in mop order
+    rd_start_mono: bool = False    # static: rd_start strictly increasing
+    #                                and in-bounds over has-elems reads
+    proc_seq: bool = False         # static: within each process,
+    #                                invoke_pos increases with txn row
+    # IR derived-order columns (history/ir.py, docs/IR.md): computed
+    # ONCE host-side at pad time and reused by every check over the same
+    # history — the in-program sorts/scatters they replace are the top
+    # steady-state inference costs on scatter-hostile backends.  None =
+    # derive in-program (legacy; exact either way, pinned by the IR
+    # round-trip differentials).
+    run_sort: Optional[jnp.ndarray] = None      # (M,) i32 (txn,key,pos) order
+    inv_run: Optional[jnp.ndarray] = None       # (M,) i32 its inverse
+    key_ord_len: Optional[jnp.ndarray] = None   # (K,) i32 longest known read
+    key_ord_read: Optional[jnp.ndarray] = None  # (K,) i32 its mop (-1 none)
+    proc_order: Optional[jnp.ndarray] = None    # (T,) i32 (process, invoke)
+    barrier_order: Optional[jnp.ndarray] = None  # (T,) i32 ok-completion
+    barrier_bi: Optional[jnp.ndarray] = None    # (T,) i32 barrier index
+    #                                             before each invoke (-1)
 
 
 jax.tree_util.register_dataclass(
@@ -96,9 +124,12 @@ jax.tree_util.register_dataclass(
     data_fields=["txn_type", "txn_process", "txn_invoke_pos",
                  "txn_complete_pos", "txn_mask", "mop_txn", "mop_kind",
                  "mop_key", "mop_val", "mop_rd_start", "mop_rd_len",
-                 "mop_mask", "rd_elems", "rd_elem_mask"],
+                 "mop_mask", "rd_elems", "rd_elem_mask", "run_sort",
+                 "inv_run", "key_ord_len", "key_ord_read", "proc_order",
+                 "barrier_order", "barrier_bi"],
     meta_fields=["n_keys", "n_vals", "txn_major", "run_cap",
-                 "complete_monotone"],
+                 "complete_monotone", "v_cap", "o_cap", "app_val_mono",
+                 "rd_start_mono", "proc_seq"],
 )
 
 # Above this many mops in one txn the shifted-compare ranking (2*(cap-1)
@@ -139,13 +170,168 @@ def _layout_facts(p: PackedTxns) -> tuple[bool, int, bool]:
     return txn_major, run_cap, complete_monotone
 
 
+def _ir_facts(p: PackedTxns) -> dict:
+    """Host-verify the IR v2 capacity/layout facts (cheap numpy; ~50 ms
+    at 1M txns).  Every fact degrades to the legacy path when False/0,
+    so exotic hand-built histories stay exact.
+
+    The capacities are the big lever on this class of backend: the
+    legacy layout sized the value table and the version-order table at R
+    (the read-element capacity, 2^24 at 1M txns) when the data needs
+    2^22 — and XLA:CPU scatters cost per *update*, so the order-table
+    passes were 4x oversized (ISSUE 12).
+
+    NOT memoized on the instance: hand-built tests (and shrink probes)
+    mutate PackedTxns arrays in place and re-pad — a cache would serve
+    stale facts for a different history.  Batch paths avoid the double
+    computation by passing `batch_caps`'s facts into `pad_packed`
+    explicitly (`ir_facts=`)."""
+    nk = max(p.n_keys, 1)
+    kind = p.mop_kind
+    # ---- v_cap: one past the max value id anywhere ----------------------
+    mx = p.n_vals - 1
+    if p.n_mops:
+        mx = max(mx, int(p.mop_val.max()))
+    if len(p.rd_elems):
+        mx = max(mx, int(p.rd_elems.max()))
+    v_cap = pow2_at_least(mx + 1, floor=8)
+    # ---- o_cap: sum of per-key longest known-read lengths ---------------
+    # only when every real mop key is in range: the program's scatter
+    # semantics for out-of-range keys (wrap/drop) are not worth
+    # emulating host-side — fall back to the legacy R-sized table
+    o_cap = 0
+    keys_ok = p.n_mops == 0 or (
+        int(p.mop_key.min()) >= 0 and int(p.mop_key.max()) < nk)
+    if keys_ok:
+        rd = (kind == MOP_READ) & (p.mop_rd_len >= 0)
+        total = 0
+        if rd.any():
+            mk = np.zeros(nk, np.int64)
+            np.maximum.at(mk, p.mop_key[rd], p.mop_rd_len[rd])
+            total = int(mk.sum())
+        o_cap = pow2_at_least(max(total, 1), floor=8)
+    # ---- append-val monotonicity ----------------------------------------
+    app = (kind == MOP_APPEND) & (p.mop_val >= 0)
+    app_val_mono = bool(np.all(np.diff(p.mop_val[app]) >= 0)) \
+        if app.any() else True
+    # ---- read-element allocation monotonicity ---------------------------
+    he = (kind == MOP_READ) & (p.mop_rd_len > 0)
+    if he.any():
+        hs = p.mop_rd_start[he]
+        rd_start_mono = bool(
+            hs[0] >= 0 and np.all(np.diff(hs) > 0)
+            and int(hs[-1] + p.mop_rd_len[he][-1]) <= len(p.rd_elems))
+    else:
+        rd_start_mono = True
+    # ---- per-process invoke order == row order --------------------------
+    if p.n_txns > 1:
+        order = np.argsort(p.txn_process, kind="stable")
+        inv_s = p.txn_invoke_pos[order]
+        same = p.txn_process[order][1:] == p.txn_process[order][:-1]
+        proc_seq = bool(np.all(inv_s[1:][same] > inv_s[:-1][same]))
+    else:
+        proc_seq = True
+    return {"v_cap": v_cap, "o_cap": o_cap, "app_val_mono": app_val_mono,
+            "rd_start_mono": rd_start_mono, "proc_seq": proc_seq}
+
+
+def _ir_columns(p: PackedTxns, T: int, M: int, txn_major: bool,
+                run_cap: int) -> Optional[dict]:
+    """Host-derive the IR order columns over the PADDED index spaces,
+    bit-for-bit replicating the orders `infer` would compute in-program
+    (same sentinel placement, same stable tie-breaks).  Returns None
+    when the packing is too exotic to replicate safely (ids out of
+    range) — infer then derives everything in-program, exactly as
+    before."""
+    n, m = p.n_txns, p.n_mops
+    nk = max(p.n_keys, 1)
+    if m and (int(p.mop_txn.min()) < 0 or int(p.mop_txn.max()) >= max(n, 1)
+              or int(p.mop_key.min()) < 0 or int(p.mop_key.max()) >= nk):
+        return None
+
+    # ---- (txn, key, pos) run permutation --------------------------------
+    # padded tail carries the same (T, nk) sentinels the device sort
+    # keys use, so it lands after every valid row in position order
+    if txn_major and run_cap:
+        # within-txn counting by shifted compares (the device fast
+        # path's exact host twin) — ~10x cheaper than a full lexsort
+        te = p.mop_txn.astype(np.int64)
+        ke = p.mop_key.astype(np.int64)
+        rank = np.zeros(m, np.int64)
+        for d in range(1, run_cap):
+            same = te[d:] == te[:-d]
+            rank[d:] += same & (ke[:-d] <= ke[d:])
+            rank[:-d] += same & (ke[d:] < ke[:-d])
+        first_mop = np.searchsorted(te, np.arange(n, dtype=np.int64))
+        inv_v = first_mop[te] + rank
+    else:
+        inv_v = np.empty(m, np.int64)
+        inv_v[np.lexsort((np.arange(m), p.mop_key.astype(np.int64),
+                          p.mop_txn.astype(np.int64)))] = np.arange(m)
+    inv_run = np.concatenate([inv_v, np.arange(m, M)]).astype(np.int32)
+    run_sort = np.zeros(M, np.int32)
+    run_sort[inv_run] = np.arange(M, dtype=np.int32)
+
+    # ---- per-key longest known read -------------------------------------
+    ok = p.txn_type == TXN_OK
+    K = pow2_at_least(nk, floor=8)
+    kl = np.zeros(K, np.int64)
+    kr_read = np.full(K, M, np.int64)
+    if m:
+        kr = (p.mop_kind == MOP_READ) & (p.mop_rd_len >= 0) & ok[p.mop_txn]
+        np.maximum.at(kl, p.mop_key[kr], p.mop_rd_len[kr])
+        longest = kr & (p.mop_rd_len == kl[p.mop_key])
+        np.minimum.at(kr_read, p.mop_key[longest],
+                      np.nonzero(longest)[0])
+    key_ord_read = np.where(kr_read < M, kr_read, -1).astype(np.int32)
+
+    # ---- process / realtime orders --------------------------------------
+    graph = ok | (p.txn_type == TXN_INFO)
+    pslot = np.full(T, BIG_I, np.int64)
+    pslot[:n] = np.where(graph, p.txn_process, BIG_I)
+    inv_pad = np.zeros(T, np.int64)
+    inv_pad[:n] = p.txn_invoke_pos
+    proc_order = np.lexsort((np.arange(T), inv_pad, pslot)).astype(np.int32)
+    bslot = np.full(T, BIG_I, np.int64)
+    bslot[:n] = np.where(ok, p.txn_complete_pos, BIG_I)
+    border = np.argsort(bslot, kind="stable").astype(np.int32)
+    comp_sorted = np.where(bslot[border] < BIG_I, bslot[border], BIG_I)
+    bi = (np.searchsorted(comp_sorted, inv_pad, side="left") - 1) \
+        .astype(np.int32)
+    return {
+        "run_sort": run_sort, "inv_run": inv_run,
+        "key_ord_len": kl.astype(np.int32), "key_ord_read": key_ord_read,
+        "proc_order": proc_order, "barrier_order": border,
+        "barrier_bi": bi,
+    }
+
+
 def pad_packed(p: PackedTxns, t_pad: int = 0, m_pad: int = 0,
-               r_pad: int = 0) -> PaddedLA:
-    """Pad a PackedTxns to pow2 capacities (host-side, cheap numpy)."""
+               r_pad: int = 0, v_pad: int = 0, o_pad: int = 0,
+               ir_facts: Optional[dict] = None) -> PaddedLA:
+    """Pad a PackedTxns to pow2 capacities (host-side, cheap numpy).
+
+    `v_pad`/`o_pad` pin the value-table / order-table capacities (batch
+    paths share one executable across groups); 0 = derive from the data
+    (`_ir_facts`).  `ir_facts` (a dict `_ir_facts(p)` produced for THIS
+    packing) skips re-deriving the facts — batch paths computed them in
+    `batch_caps` already."""
     T = t_pad or pow2_at_least(p.n_txns)
     M = m_pad or pow2_at_least(p.n_mops)
     R = r_pad or pow2_at_least(max(len(p.rd_elems), p.n_vals, p.n_keys + 1))
     txn_major, run_cap, complete_monotone = _layout_facts(p)
+    ir = dict(ir_facts) if ir_facts is not None else _ir_facts(p)
+    if v_pad:
+        ir["v_cap"] = v_pad
+    if o_pad:
+        ir["o_cap"] = o_pad
+    # capacities never exceed R (the legacy sizing): a degenerate history
+    # whose id space outruns its element table keeps the old layout
+    ir["v_cap"] = min(ir["v_cap"], R) if ir["v_cap"] else 0
+    ir["o_cap"] = min(ir["o_cap"], R) if ir["o_cap"] else 0
+    cols = _ir_columns(p, T, M, txn_major, run_cap)
+    if cols is not None:
+        ir.update({k: jnp.asarray(v) for k, v in cols.items()})
 
     def pad(a, n, fill=0):
         out = np.full(n, fill, dtype=a.dtype)
@@ -172,6 +358,7 @@ def pad_packed(p: PackedTxns, t_pad: int = 0, m_pad: int = 0,
         txn_major=txn_major,
         run_cap=run_cap,
         complete_monotone=complete_monotone,
+        **ir,
     )
 
 
@@ -181,7 +368,11 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     T = h.txn_type.shape[0]
     M = h.mop_txn.shape[0]
     R = h.rd_elems.shape[0]
-    V = R  # value-id capacity
+    # value-id / version-order-table capacities: the host-verified IR
+    # facts size these at pow2(actual need) — 4x under R at 1M bench
+    # shapes, and XLA:CPU scatters cost per update (0 = legacy layout)
+    V = h.v_cap or R
+    O = h.o_cap or R
     nk = max(n_keys, 1)
 
     ok = h.txn_type == TXN_OK
@@ -195,13 +386,26 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     mop_pos = jnp.arange(M, dtype=jnp.int32)
 
     # ---- writers ---------------------------------------------------------
-    val_slot = jnp.where(is_append, h.mop_val, V)
-    writer = jnp.full(V + 1, -1, jnp.int32).at[val_slot].max(
-        jnp.where(is_append, h.mop_txn, -1))[:V]
+    if h.app_val_mono:
+        # append val ids are nondecreasing in mop order (host-verified):
+        # forward-fill gives a globally nondecreasing index vector whose
+        # non-append rows carry a no-op payload, unlocking XLA's
+        # sorted-scatter path (~3.5x the unsorted one on this CPU)
+        w_idx = jnp.clip(
+            jax.lax.cummax(jnp.where(is_append, h.mop_val, -1)), 0, V)
+        writer = jnp.full(V + 1, -1, jnp.int32).at[w_idx].max(
+            jnp.where(is_append, h.mop_txn, -1),
+            indices_are_sorted=True)[:V]
+        app_count = jnp.zeros(V + 1, jnp.int32).at[w_idx].add(
+            is_append.astype(jnp.int32), indices_are_sorted=True)[:V]
+    else:
+        val_slot = jnp.where(is_append, h.mop_val, V)
+        writer = jnp.full(V + 1, -1, jnp.int32).at[val_slot].max(
+            jnp.where(is_append, h.mop_txn, -1))[:V]
+        app_count = jnp.zeros(V + 1, jnp.int32).at[val_slot].add(
+            is_append.astype(jnp.int32))[:V]
     writer_type = jnp.where(
         writer >= 0, h.txn_type[jnp.clip(writer, 0, T - 1)], 0)
-    app_count = jnp.zeros(V + 1, jnp.int32).at[val_slot].add(
-        is_append.astype(jnp.int32))[:V]
     duplicate_appends = jnp.sum((app_count > 1).astype(jnp.int32))
 
     # ---- (txn, key, pos) run order ---------------------------------------
@@ -212,7 +416,15 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # sorted iota payload IS the permutation.
     txn_eff = jnp.where(h.mop_mask, h.mop_txn, T)
     key_eff = jnp.where(h.mop_mask, h.mop_key, nk)
-    if h.txn_major and h.run_cap:
+    if h.run_sort is not None:
+        # IR columns (pad-time host derivation, docs/IR.md): the
+        # permutation arrives as input — no in-program ranking or
+        # inverse-permutation scatter at all
+        run_sort = h.run_sort
+        inv_run = h.inv_run
+        t2 = txn_eff[run_sort]
+        k2 = key_eff[run_sort]
+    elif h.txn_major and h.run_cap:
         # Sort-free: mops are packed txn-major (host-verified static
         # flag), so the global (txn, key, pos) order decomposes into a
         # within-txn ranking by (key, pos) over runs of <= run_cap mops.
@@ -232,9 +444,11 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
             lt_n = key_eff[d:] < key_eff[:-d]
             rank += jnp.concatenate([zpad, same_p & le_p]).astype(jnp.int32) \
                 + jnp.concatenate([same_p & lt_n, zpad]).astype(jnp.int32)
+        # txn_major: mop_txn is nondecreasing with the padding tail at T,
+        # so the scatter indices are sorted — tell XLA
         first_mop = jnp.full(T + 1, M, jnp.int32).at[
             jnp.where(h.mop_mask, mop_txn_c, T)].min(
-            jnp.where(h.mop_mask, mop_pos, M))[:T]
+            jnp.where(h.mop_mask, mop_pos, M), indices_are_sorted=True)[:T]
         inv_run = jnp.where(h.mop_mask, first_mop[mop_txn_c] + rank,
                             mop_pos)
         run_sort = jnp.zeros(M, jnp.int32).at[inv_run].set(mop_pos)
@@ -261,21 +475,32 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
         jnp.where(app2, q, -1)[::-1], run_end[::-1],
         exclusive=True, neutral=-1)[::-1]
     run_final = app2 & (suf_app_q < 0)
-    is_final = jnp.zeros(V + 1, bool).at[
-        jnp.where(app2, val2, V)].max(run_final)[:V]
+    if h.app_val_mono:
+        # scatter in mop order through the same sorted index vector the
+        # writer table uses (run_final gathered back via inv_run)
+        is_final = jnp.zeros(V + 1, bool).at[w_idx].max(
+            is_append & run_final[inv_run], indices_are_sorted=True)[:V]
+    else:
+        is_final = jnp.zeros(V + 1, bool).at[
+            jnp.where(app2, val2, V)].max(run_final)[:V]
 
     # ---- version orders (longest known read per key) ---------------------
-    key_slot = jnp.where(known_read, h.mop_key, nk)
-    ord_len = jnp.zeros(nk + 1, jnp.int32).at[key_slot].max(
-        jnp.where(known_read, h.mop_rd_len, 0))[:nk]
-    # pick one longest read per key (two-pass scatter; no 64-bit packing);
-    # ties take the earliest read, matching the host oracle
-    is_longest = known_read & (h.mop_rd_len == ord_len[
-        jnp.clip(h.mop_key, 0, nk - 1)])
-    ord_read_raw = jnp.full(nk + 1, M, jnp.int32).at[
-        jnp.where(is_longest, h.mop_key, nk)].min(
-        jnp.where(is_longest, mop_pos, M))[:nk]
-    ord_read = jnp.where(ord_read_raw < M, ord_read_raw, -1)
+    if h.key_ord_len is not None and h.key_ord_len.shape[0] >= nk:
+        # IR columns: per-key longest-read table precomputed at pad time
+        ord_len = h.key_ord_len[:nk]
+        ord_read = h.key_ord_read[:nk]
+    else:
+        key_slot = jnp.where(known_read, h.mop_key, nk)
+        ord_len = jnp.zeros(nk + 1, jnp.int32).at[key_slot].max(
+            jnp.where(known_read, h.mop_rd_len, 0))[:nk]
+        # pick one longest read per key (two-pass scatter; no 64-bit
+        # packing); ties take the earliest read, matching the host oracle
+        is_longest = known_read & (h.mop_rd_len == ord_len[
+            jnp.clip(h.mop_key, 0, nk - 1)])
+        ord_read_raw = jnp.full(nk + 1, M, jnp.int32).at[
+            jnp.where(is_longest, h.mop_key, nk)].min(
+            jnp.where(is_longest, mop_pos, M))[:nk]
+        ord_read = jnp.where(ord_read_raw < M, ord_read_raw, -1)
     ord_start = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(ord_len)[:-1].astype(jnp.int32)])
     total_ord = jnp.sum(ord_len)
@@ -284,8 +509,10 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # slot_key = max key whose segment start <= slot (starts are monotone;
     # zero-length keys share a start and the scatter-max picks the last,
     # which is the containing one) — a scatter + cummax forward fill, an
-    # O(R) replacement for the former O(R log nk) searchsorted
-    slot = jnp.arange(R, dtype=jnp.int32)
+    # O(O) replacement for the former O(O log nk) searchsorted.  The
+    # whole table lives in the O-capacity space (sum of per-key longest
+    # reads), not R: at 1M bench shapes that is 2^22 vs 2^24.
+    slot = jnp.arange(O, dtype=jnp.int32)
     slot_valid = slot < total_ord
     if nk == 1:
         # single key: every slot is key 0.  Also dodges a real compile
@@ -293,7 +520,7 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
         # constant (ord_start == [0], key_ids == [0]) and XLA:CPU
         # constant-folds the cummax's R-sized reduce-window tree
         # interpretively — measured 1-18 s of compile per shape.
-        slot_key = jnp.zeros(R, jnp.int32)
+        slot_key = jnp.zeros(O, jnp.int32)
         slot_off = slot
         src_read0 = ord_read[0]
         src_start = jnp.where(
@@ -312,28 +539,32 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
         # keys (unique starts): every valid slot's containing key has
         # elements, and invalid slots are masked by slot_valid.
         key_ids = jnp.arange(nk, dtype=jnp.int32)
-        sk_seed = jnp.full(R + 1, -1, jnp.int32).at[
-            jnp.clip(ord_start, 0, R)].max(key_ids)[:R]
+        sk_seed = jnp.full(O + 1, -1, jnp.int32).at[
+            jnp.clip(ord_start, 0, O)].max(
+            key_ids, indices_are_sorted=True)[:O]
         slot_key = jnp.clip(pallas_fill.locf_flat(sk_seed), 0, nk - 1)
         nonempty = ord_len > 0
-        pos_ne = jnp.where(nonempty, ord_start, R)
-        osv_seed = jnp.full(R + 1, -1, jnp.int32).at[
-            jnp.clip(pos_ne, 0, R)].max(
-            jnp.where(nonempty, ord_start, -1))[:R]
+        pos_ne = jnp.where(nonempty, ord_start, O)
+        osv_seed = jnp.full(O + 1, -1, jnp.int32).at[
+            jnp.clip(pos_ne, 0, O)].max(
+            jnp.where(nonempty, ord_start, -1))[:O]
         # per-key rd_start of the chosen longest read (ord_len > 0
         # implies ord_read >= 0)
         srcst_k = h.mop_rd_start[jnp.clip(ord_read, 0, M - 1)]
-        srcst_seed = jnp.full(R + 1, -1, jnp.int32).at[
-            jnp.clip(pos_ne, 0, R)].max(
-            jnp.where(nonempty, srcst_k, -1))[:R]
+        srcst_seed = jnp.full(O + 1, -1, jnp.int32).at[
+            jnp.clip(pos_ne, 0, O)].max(
+            jnp.where(nonempty, srcst_k, -1))[:O]
         ord_start_f = pallas_fill.locf_flat(osv_seed)
         src_start = pallas_fill.locf_flat(srcst_seed)
         slot_off = slot - jnp.where(ord_start_f >= 0, ord_start_f, 0)
         src_start = jnp.where(src_start >= 0, src_start, 0)
     else:
         key_ids = jnp.arange(nk, dtype=jnp.int32)
-        sk_seed = jnp.full(R + 1, -1, jnp.int32).at[
-            jnp.clip(ord_start, 0, R)].max(key_ids)[:R]
+        # ord_start is a cumsum, so the seed indices are sorted by
+        # construction — no layout fact needed
+        sk_seed = jnp.full(O + 1, -1, jnp.int32).at[
+            jnp.clip(ord_start, 0, O)].max(
+            key_ids, indices_are_sorted=True)[:O]
         slot_key = jnp.clip(jax.lax.cummax(sk_seed), 0, nk - 1)
         slot_off = slot - ord_start[slot_key]
         src_read = ord_read[slot_key]
@@ -348,9 +579,20 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # forward-fill with a parallel cummax (read extents are contiguous and
     # allocated in mop order, so ids are increasing)
     has_elems = known_read & (h.mop_rd_len > 0)
-    seed = jnp.full(R + 1, -1, jnp.int32).at[
-        jnp.where(has_elems, h.mop_rd_start, R)].max(
-        jnp.where(has_elems, mop_pos, -1))[:R]
+    if h.rd_start_mono:
+        # rd_start strictly increases over has-elems reads (host-verified
+        # allocation-order fact): forward-fill the masked rows onto the
+        # previous read's start (whose payload then loses the max) so
+        # the scatter indices are sorted
+        seed = jnp.full(R + 1, -1, jnp.int32).at[
+            jnp.clip(jax.lax.cummax(
+                jnp.where(has_elems, h.mop_rd_start, -1)), 0, R)].max(
+            jnp.where(has_elems, mop_pos, -1),
+            indices_are_sorted=True)[:R]
+    else:
+        seed = jnp.full(R + 1, -1, jnp.int32).at[
+            jnp.where(has_elems, h.mop_rd_start, R)].max(
+            jnp.where(has_elems, mop_pos, -1))[:R]
 
     def _aseed(vals):
         # value channel seeded at the same (unique) read-start slots
@@ -384,14 +626,14 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
         erd_len = h.mop_rd_len[er]
         elem_key = h.mop_key[er]
         elem_txn = h.mop_txn[er]
-    elem_off = slot - erd_start
+    elem_off = jnp.arange(R, dtype=jnp.int32) - erd_start
     elem_in_read = h.rd_elem_mask & (elem_read >= 0) & (elem_off >= 0) & \
         (elem_off < erd_len)
     ev = jnp.clip(h.rd_elems, 0, V - 1)
 
     # incompatible-order: element disagrees with its key's version order
     expect = ord_elems[jnp.clip(
-        ord_start[jnp.clip(elem_key, 0, nk - 1)] + elem_off, 0, R - 1)]
+        ord_start[jnp.clip(elem_key, 0, nk - 1)] + elem_off, 0, O - 1)]
     incompat = elem_in_read & (h.rd_elems != expect)
     incompatible_order = jnp.sum(incompat.astype(jnp.int32))
     incompat_witness = jnp.argmax(incompat)
@@ -450,8 +692,8 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
 
     # dirty-update: aborted write immediately followed by a committed one
     nxt_slot_same_key = slot_valid & (slot + 1 < total_ord) & \
-        (slot_key == slot_key[jnp.clip(slot + 1, 0, R - 1)])
-    nv = jnp.clip(ord_elems[jnp.clip(slot + 1, 0, R - 1)], 0, V - 1)
+        (slot_key == slot_key[jnp.clip(slot + 1, 0, O - 1)])
+    nv = jnp.clip(ord_elems[jnp.clip(slot + 1, 0, O - 1)], 0, V - 1)
     dirty = nxt_slot_same_key & (writer_type[cv] == TXN_FAIL) & \
         (writer_type[nv] == TXN_OK)
     dirty_update = jnp.sum(dirty.astype(jnp.int32))
@@ -538,7 +780,7 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     has_next = known_read & (h.mop_rd_len < ord_len[key_c])
     nxt_val = jnp.where(
         has_next,
-        ord_elems[jnp.clip(ord_start[key_c] + h.mop_rd_len, 0, R - 1)], -1)
+        ord_elems[jnp.clip(ord_start[key_c] + h.mop_rd_len, 0, O - 1)], -1)
     rw_dst = jnp.where(nxt_val >= 0, writer[jnp.clip(nxt_val, 0, V - 1)], -1)
     rw_src = h.mop_txn
     rw_ok = has_next & (rw_dst >= 0) & (rw_dst != rw_src) & \
@@ -554,8 +796,21 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     # process chains: ok/info txns by (process, invoke_pos); complete_pos is
     # monotone along a process chain, so ranks increase as required
     pslot = jnp.where(h.txn_mask & graph_txn, h.txn_process, BIG)
-    p_sorted, _, porder = jax.lax.sort(
-        (pslot, h.txn_invoke_pos, tidx), num_keys=2, is_stable=True)
+    if h.proc_order is not None:
+        # IR column: the (process, invoke) order precomputed at pad time
+        porder = h.proc_order
+        p_sorted = pslot[porder]
+    elif h.proc_seq:
+        # within each process, invoke order == txn row order
+        # (host-verified: a jepsen process is sequential), so a stable
+        # 1-key sort by process reproduces the (process, invoke) order
+        # for every chain row; the BIG-keyed masked rows may permute
+        # among themselves but never enter the chain (p_mask)
+        p_sorted, porder = jax.lax.sort((pslot, tidx), num_keys=1,
+                                        is_stable=True)
+    else:
+        p_sorted, _, porder = jax.lax.sort(
+            (pslot, h.txn_invoke_pos, tidx), num_keys=2, is_stable=True)
     p_nodes = porder.astype(jnp.int32)
     p_mask = p_sorted < BIG
     p_starts = jnp.concatenate([jnp.ones(1, bool),
@@ -563,7 +818,10 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
 
     # realtime barriers: one per ok txn, ordered by completion
     bslot = jnp.where(h.txn_mask & ok, h.txn_complete_pos, BIG)
-    if h.complete_monotone:
+    if h.barrier_order is not None:
+        # IR column: ok-completion order precomputed at pad time
+        border = h.barrier_order
+    elif h.complete_monotone:
         # complete_pos is strictly increasing over valid txns
         # (host-verified static flag: TxnPacker emits txns in completion
         # order), so argsort(bslot) is a stable partition — ok txns keep
@@ -585,8 +843,12 @@ def infer(h: PaddedLA, n_keys: int) -> Dict[str, dict]:
     tb_src = b_txn
     tb_dst = barrier_node
     tb_ok = b_mask
-    comp_sorted = jnp.where(b_mask, bslot[border], BIG)
-    bi = jnp.searchsorted(comp_sorted, h.txn_invoke_pos, side="left") - 1
+    if h.barrier_bi is not None:
+        bi = h.barrier_bi
+    else:
+        comp_sorted = jnp.where(b_mask, bslot[border], BIG)
+        bi = jnp.searchsorted(comp_sorted, h.txn_invoke_pos,
+                              side="left") - 1
     bt_ok = h.txn_mask & graph_txn & (bi >= 0)
     bt_src = (T + jnp.clip(bi, 0, T - 1)).astype(jnp.int32)
     bt_dst = tidx

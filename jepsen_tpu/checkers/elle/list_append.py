@@ -44,6 +44,7 @@ from jepsen_tpu.checkers.elle.graph import (
     find_cycle,
 )
 from jepsen_tpu.checkers.elle.specs import CYCLE_ANOMALY_SPECS, SPEC_ORDER
+from jepsen_tpu.history.ir import HistoryIR
 from jepsen_tpu.history.soa import TXN_OK, PackedTxns, pack_txns
 from jepsen_tpu.ops.cycle_sweep import SweepGraph, detect_cycles
 
@@ -112,11 +113,17 @@ def _check_device(history, consistency_models, anomalies, max_reported,
     # process already traced/compiled the infer program — the closest
     # cheap proxy for jit compile vs execute time
     ph = telemetry.phases()
+    ir = history if isinstance(history, HistoryIR) else None
     if isinstance(history, PackedTxns):
         p = history
     else:
         ph.start("elle.pack", device=True)
-        p = pack_txns(history, "list-append")
+        p = (ir.packed("list-append") if ir is not None
+             else pack_txns(history, "list-append"))
+    if ir is not None and ir.packed_only:
+        # packed-only IR: downstream consumers (oracle fallback, session
+        # coverage) must see the bare PackedTxns degradation semantics
+        history = p
     if p.n_txns == 0 or not (p.txn_type == TXN_OK).any():
         ph.end()
         return {"valid?": "unknown", "anomaly-types": [], "anomalies": {},
@@ -126,7 +133,20 @@ def _check_device(history, consistency_models, anomalies, max_reported,
     ph.start("elle.infer", device=True, txns=p.n_txns,
              warm=_WARM.get("infer", False))
     _WARM["infer"] = True
-    h = pad_packed(p)
+    # the IR caches the padded layout (capacity facts + derived-order
+    # columns): repeat checks over one history skip the pad entirely
+    h = ir.padded("list-append") if ir is not None else pad_packed(p)
+    # sharded-by-default (ISSUE 12): with >1 visible device and a large
+    # enough history, op arrays go up with NamedSharding(P("batch")) so
+    # GSPMD partitions inference, and each projection sweep runs the
+    # K-axis shard_map kernel
+    from jepsen_tpu.parallel import slots as _slots
+
+    mesh = _slots.default_mesh(h.txn_type.shape[0])
+    if mesh is not None:
+        from jepsen_tpu.parallel.op_shard import shard_padded
+
+        h, _ = shard_padded(h, mesh, "batch")
     if telemetry.enabled():
         telemetry.registry().counter("device-bytes-staged").inc(
             sum(int(np.asarray(a).nbytes) for a in (
@@ -212,7 +232,8 @@ def _check_device(history, consistency_models, anomalies, max_reported,
                        nc_mask=mask, chain_nodes=chain_nodes,
                        chain_starts=chain_starts, chain_mask=cmask)
         res = dev("elle.cycle-sweep",
-                  lambda g=g: detect_cycles(g, deadline=deadline))
+                  lambda g=g: detect_cycles(g, deadline=deadline,
+                                            mesh=mesh))
         if not res.converged:
             needs_fallback = True
             break

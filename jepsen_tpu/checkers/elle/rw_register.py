@@ -77,8 +77,14 @@ def check(history, consistency_models: Sequence[str] = ("snapshot-isolation",),
     `policy` retries; synthetic faults per `plan`) degrades to this
     host path with ``"degraded": "host-fallback"`` stamped; `deadline`
     expiry returns the canonical deadline-exceeded unknown."""
-    p = history if isinstance(history, PackedTxns) \
-        else pack_txns(history, "rw-register")
+    if isinstance(history, PackedTxns):
+        p = history
+    else:
+        from jepsen_tpu.history.ir import HistoryIR
+
+        p = history.packed("rw-register") \
+            if isinstance(history, HistoryIR) \
+            else pack_txns(history, "rw-register")
     if p.n_txns == 0 or not (p.txn_type == TXN_OK).any():
         return {"valid?": "unknown", "anomaly-types": [], "anomalies": {},
                 "not": [], "also-not": []}

@@ -75,7 +75,11 @@ def _reduce_host(balances: np.ndarray, total: int, negative_ok: bool):
 def _reduce_device(balances: np.ndarray, total: int, negative_ok: bool):
     import jax.numpy as jnp
 
-    b = jnp.asarray(balances)
+    from jepsen_tpu.parallel.slots import place_sharded
+
+    # sharded-by-default: rows (reads) split over the active mesh's
+    # "batch" axis — the row sums/sign tests partition embarrassingly
+    b = place_sharded(balances)
     sums = b.sum(axis=1)
     wrong = sums != total
     neg = (b < 0).any(axis=1) if not negative_ok \
@@ -137,10 +141,13 @@ def check(history, test: Optional[dict] = None, *,
     ph = telemetry.phases()
     pb = history if isinstance(history, PackedBank) else None
     if pb is None:
+        from jepsen_tpu.history.ir import HistoryIR
+
+        accounts = ((test or {}).get("accounts") or {}).keys() or None
         ph.start("invariants.pack", device=False)
-        pb = packed_mod.pack_bank(
-            history, accounts=((test or {}).get("accounts") or {}).keys()
-            or None)
+        pb = (history.bank(accounts)
+              if isinstance(history, HistoryIR)
+              else packed_mod.pack_bank(history, accounts=accounts))
     t = resolve_total(test, pb, total)
     if not pb.n_reads or t is None:
         ph.end()

@@ -224,12 +224,16 @@ def check(history, consistency_models: Sequence[str] = (
     from jepsen_tpu import resilience
     from jepsen_tpu.checkers.elle.explain import rw_explainer
 
+    from jepsen_tpu.history.ir import HistoryIR
+
     ph = telemetry.phases()
+    ir = history if isinstance(history, HistoryIR) else None
     if isinstance(history, PackedTxns):
         p = history
     else:
         ph.start("invariants.pack", device=False)
-        p = packed_mod.pack_rw(history)
+        p = ir.packed("rw-register") if ir is not None \
+            else packed_mod.pack_rw(history)
     if p.n_txns == 0 or not (p.txn_type == TXN_OK).any():
         ph.end()
         return {"valid?": "unknown", "anomaly-types": [], "anomalies": {},
@@ -247,7 +251,10 @@ def check(history, consistency_models: Sequence[str] = (
             found[LONG_FORK] = forks
 
         ph.start("invariants.infer", device=False)
-        inf = packed_mod.infer_rw(p)
+        # the IR shares ONE RwInference between the predicate and
+        # session checkers of a composed check (docs/IR.md)
+        inf = ir.rw_inference() if ir is not None \
+            else packed_mod.infer_rw(p)
         skews = write_skews(inf, max_reported=max_reported)
         if skews:
             found[WRITE_SKEW] = skews

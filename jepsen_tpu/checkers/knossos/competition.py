@@ -198,7 +198,10 @@ def analysis(history: History, model: Model,
     """
     with telemetry.span("knossos.analysis", algorithm=algorithm) as sp:
         with telemetry.span("knossos.prep"):
-            ops = prepare(history)
+            from jepsen_tpu.history.ir import HistoryIR
+
+            ops = history.lin_ops() if isinstance(history, HistoryIR) \
+                else prepare(history)
         sp.set_attr(ops=len(ops))
         res = _dispatch(ops, model, algorithm, deadline_s, deadline, kw)
         sp.set_attr(valid=res.get("valid?"),

@@ -292,6 +292,22 @@ class FleetWorker:
                                    name=f"fleet-renew-{self.name}")
         renewer.start()
         t0 = time.monotonic()  # the window tick clock: workload start
+        # mesh capability -> default-mesh shard count (PR 10 follow-on,
+        # ISSUE 12 satellite): a cell pinning opts["mesh"] — or a worker
+        # advertising one — runs its device checks sharded over exactly
+        # that many devices.  The pin is THREAD-LOCAL
+        # (slots.set_forced_shards): several workers may share one
+        # process, and a process-global env pin would leak across their
+        # concurrently executing cells
+        import math
+
+        from jepsen_tpu.fleet.queue import _norm_mesh
+        from jepsen_tpu.parallel import slots as slots_mod
+
+        want_mesh = _norm_mesh(rs.opts.get("mesh")) or \
+            _norm_mesh(self.mesh)
+        if want_mesh:
+            slots_mod.set_forced_shards(math.prod(want_mesh))
         try:
             rec = execute_run(rs, self.base)
         except Exception as e:  # noqa: BLE001 — same contract as the
@@ -300,6 +316,8 @@ class FleetWorker:
             rec = crash_record(rs, f"{type(e).__name__}: {e}", 1,
                                time.monotonic() - t0)
         finally:
+            if want_mesh:
+                slots_mod.set_forced_shards(None)
             stop_renew.set()
             renewer.join(timeout=5)
         try:

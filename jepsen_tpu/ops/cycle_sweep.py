@@ -265,6 +265,38 @@ _sweep = jax.jit(_sweep_arrays,
                  static_argnames=("n_nodes", "max_k", "max_rounds"))
 
 
+@partial(jax.jit, static_argnames=("n_nodes", "max_k", "max_rounds",
+                                   "mesh", "axis"))
+def _sweep_sharded(n_nodes: int, max_k: int, max_rounds: int, mesh, axis,
+                   rank, nc_src, nc_dst, nc_mask,
+                   chain_nodes, chain_starts, chain_mask):
+    """`_sweep_arrays` with the backward-edge axis sharded over `mesh`
+    (the per-projection form of `parallel/op_shard.py`'s K-window
+    pattern): each device owns max_k / n_shards backward-edge columns
+    and propagates only its label-plane window; the (K, K) meta graph
+    merges with one all_gather.  Same result contract as `_sweep`."""
+    from jax.sharding import PartitionSpec as P
+
+    from jepsen_tpu.utils.backend import get_shard_map
+
+    n_shards = mesh.shape[axis]
+    assert max_k % n_shards == 0, (max_k, n_shards)
+    k_local = max_k // n_shards
+    shard_map = get_shard_map()
+    rep = P()
+
+    @partial(shard_map, mesh=mesh, in_specs=(rep,) * 7,
+             out_specs=(rep, rep, rep, rep))
+    def run(rank_, s_, d_, m_, cn_, cs_, cm_):
+        off = jax.lax.axis_index(axis) * k_local
+        return _sweep_window(n_nodes, max_k, k_local, max_rounds,
+                             rank_, s_, d_, m_, cn_, cs_, cm_,
+                             k_offset=off, axis_name=axis)
+
+    return run(rank, nc_src, nc_dst, nc_mask, chain_nodes, chain_starts,
+               chain_mask)
+
+
 def projection_scan(n_nodes: int, max_k: int, max_rounds: int,
                     rank, e_src, e_dst, fam_masks, inc_stack,
                     chain_nodes, chain_starts, chain_masks, cinc_stack,
@@ -379,9 +411,26 @@ def projection_scan(n_nodes: int, max_k: int, max_rounds: int,
     # carry init derives from traced inputs so its varying-axis type
     # matches the body outputs under shard_map/vmap
     zero0 = e_src[0] * 0
-    (conv_all, overflow), cyc_bits = jax.lax.scan(
-        proj_body, (zero0 == 0, zero0), (inc_stack, cinc_stack))
-    return conv_all, overflow, cyc_bits
+    n_proj = int(inc_stack.shape[0])
+
+    def run_scan(_):
+        (conv_all, overflow), cyc_bits = jax.lax.scan(
+            proj_body, (zero0 == 0, zero0), (inc_stack, cinc_stack))
+        return conv_all, overflow, cyc_bits
+
+    def no_backward(_):
+        # zero backward edges across the FULL family union: every
+        # projection's backward set is a subset, so all P projections
+        # are DAGs — converged, no overflow, no cycles.  The common
+        # case for valid histories; skipping the scan saves P rounds of
+        # E-sized masking/enumeration.  (Under vmap this cond lowers to
+        # select and both branches still run — batched paths keep their
+        # old cost, never a new one.)
+        return zero0 == 0, zero0, jnp.zeros((n_proj,), jnp.int32) + zero0
+
+    total_back = cum[-1] if cum.shape[0] else jnp.int32(0)
+    return jax.lax.cond(total_back > 0, run_scan, no_backward,
+                        operand=None)
 
 #: budget ceilings shared by every sweep driver (detect_cycles here,
 #: grow_until_exact in device_core): past these, callers fall back to
@@ -399,7 +448,8 @@ class SweepResult:
 
 
 def detect_cycles(g: SweepGraph, max_k: int = 128,
-                  max_rounds: int = 64, deadline=None) -> SweepResult:
+                  max_rounds: int = 64, deadline=None, mesh=None,
+                  axis: str = "batch") -> SweepResult:
     """Run the sweep; rebatch automatically if backward edges exceed max_k.
 
     Exact: cycle reported iff one exists in the (masked) graph, provided
@@ -410,12 +460,26 @@ def detect_cycles(g: SweepGraph, max_k: int = 128,
     retry — the budget-doubling fixpoint is this driver's unbounded
     loop, and a pathological graph must not hold the checker past its
     time budget (expiry raises `DeadlineExceeded`).
+
+    `mesh` (a 1-D jax Mesh, ISSUE 12 sharded-by-default) shards the
+    backward-edge axis over its devices — verdict-identical to the
+    single-device sweep, differential-pinned in tests/test_parallel.py.
     """
     if deadline is not None:
         deadline.check("cycle-sweep")
-    has, wit, n_back, conv = _sweep(
-        g.n_nodes, max_k, max_rounds, g.rank, g.nc_src, g.nc_dst, g.nc_mask,
-        g.chain_nodes, g.chain_starts, g.chain_mask)
+    if mesh is not None and mesh.devices.size > 1:
+        n_shards = mesh.shape[axis]
+        if max_k % n_shards:
+            max_k = ((max_k // n_shards) + 1) * n_shards
+        has, wit, n_back, conv = _sweep_sharded(
+            g.n_nodes, max_k, max_rounds, mesh, axis, g.rank, g.nc_src,
+            g.nc_dst, g.nc_mask, g.chain_nodes, g.chain_starts,
+            g.chain_mask)
+    else:
+        mesh = None
+        has, wit, n_back, conv = _sweep(
+            g.n_nodes, max_k, max_rounds, g.rank, g.nc_src, g.nc_dst,
+            g.nc_mask, g.chain_nodes, g.chain_starts, g.chain_mask)
     n_back = int(n_back)
     if n_back > max_k:
         if n_back > MAX_K_CAP or max_k >= MAX_K_CAP:
@@ -432,7 +496,8 @@ def detect_cycles(g: SweepGraph, max_k: int = 128,
         return detect_cycles(g,
                              max_k=min(max(max_k * 2, _pow2(n_back)),
                                        MAX_K_CAP),
-                             max_rounds=max_rounds, deadline=deadline)
+                             max_rounds=max_rounds, deadline=deadline,
+                             mesh=mesh, axis=axis)
     if not bool(conv) and max_rounds < MAX_ROUNDS_CAP:
         # fixpoint truncated: grow rounds like grow_until_exact does for
         # the fused path (histories dense with injected cycles can need
@@ -440,7 +505,7 @@ def detect_cycles(g: SweepGraph, max_k: int = 128,
         return detect_cycles(g, max_k=max_k,
                              max_rounds=min(max_rounds * 2,
                                             MAX_ROUNDS_CAP),
-                             deadline=deadline)
+                             deadline=deadline, mesh=mesh, axis=axis)
     wit = np.asarray(wit)
     conv = bool(conv)
     has = bool(has)

@@ -47,38 +47,80 @@ def stack_padded(hs: Sequence[PaddedLA]) -> PaddedLA:
               "mop_key", "mop_val", "mop_rd_start", "mop_rd_len", "mop_mask",
               "rd_elems", "rd_elem_mask"):
         out[f] = jnp.stack([getattr(h, f) for h in hs])
+    # IR derived-order columns stack only when every member carries them
+    # at the same shape (else the program derives in-program, as before)
+    for f in ("run_sort", "inv_run", "key_ord_len", "key_ord_read",
+              "proc_order", "barrier_order", "barrier_bi"):
+        vals = [getattr(h, f) for h in hs]
+        if all(v is not None for v in vals) and \
+                len({v.shape for v in vals}) == 1:
+            out[f] = jnp.stack(vals)
     # static layout facts must hold for EVERY stacked history (vmap shares
-    # one program): AND the flags, take the widest run bucket
+    # one program): AND the flags, take the widest run bucket/capacity
     return PaddedLA(
         n_keys=first.n_keys, n_vals=first.n_vals,
         txn_major=all(h.txn_major for h in hs),
         run_cap=(max(h.run_cap for h in hs)
                  if all(h.run_cap for h in hs) else 0),
         complete_monotone=all(h.complete_monotone for h in hs),
+        v_cap=(max(h.v_cap for h in hs)
+               if all(h.v_cap for h in hs) else 0),
+        o_cap=(max(h.o_cap for h in hs)
+               if all(h.o_cap for h in hs) else 0),
+        app_val_mono=all(h.app_val_mono for h in hs),
+        rd_start_mono=all(h.rd_start_mono for h in hs),
+        proc_seq=all(h.proc_seq for h in hs),
         **out)
 
 
 def batch_caps(ps: Sequence[PackedTxns]) -> tuple:
-    """The shared padded capacities (T, M, R, n_keys) for a batch."""
-    from jepsen_tpu.checkers.elle.device_infer import pow2_at_least
+    """The shared padded capacities (T, M, R, n_keys, V, O) for a batch.
+    V/O are the IR value-table / order-table capacities (the batch must
+    share ONE executable, so per-history capacities are maxed)."""
+    from jepsen_tpu.checkers.elle.device_infer import _ir_facts, \
+        pow2_at_least
 
     T = pow2_at_least(max(p.n_txns for p in ps))
     M = pow2_at_least(max(p.n_mops for p in ps))
     R = pow2_at_least(max(max(len(p.rd_elems), p.n_vals, p.n_keys + 1)
                           for p in ps))
     nk = max(p.n_keys for p in ps)
-    return T, M, R, nk
+    facts = {id(p): _ir_facts(p) for p in ps}
+    vs = [f["v_cap"] for f in facts.values()]
+    os_ = [f["o_cap"] for f in facts.values()]
+    V = max(vs) if all(vs) else 0
+    O = max(os_) if all(os_) else 0
+    caps = (T, M, R, nk, min(V, R), min(O, R))
+    return _BatchCaps(caps, facts)
+
+
+class _BatchCaps(tuple):
+    """The (T, M, R, nk, V, O) capacity tuple, carrying the per-history
+    `_ir_facts` so `pad_batch` doesn't re-derive them (they are full
+    O(n_mops) host scans).  Plain tuples remain accepted everywhere."""
+
+    def __new__(cls, caps, facts):
+        self = super().__new__(cls, caps)
+        self.facts = facts
+        return self
 
 
 def pad_batch(ps: Sequence[PackedTxns], caps: tuple = None) -> PaddedLA:
     """Pad a list of PackedTxns to shared capacities and stack them.
 
     `caps` (from `batch_caps`) overrides the per-call maxima so several
-    groups of one larger batch share one compiled executable."""
-    T, M, R, nk = caps if caps is not None else batch_caps(ps)
+    groups of one larger batch share one compiled executable.  Legacy
+    4-tuples (T, M, R, nk) are accepted; V/O then derive per batch."""
+    if caps is None:
+        caps = batch_caps(ps)
+    facts = getattr(caps, "facts", {})
+    if len(caps) == 4:
+        caps = (*caps, 0, 0)
+    T, M, R, nk, V, O = caps
     padded = []
     for p in ps:
-        h = pad_packed(p, t_pad=T, m_pad=M, r_pad=R)
+        h = pad_packed(p, t_pad=T, m_pad=M, r_pad=R, v_pad=V, o_pad=O,
+                       ir_facts=facts.get(id(p)))
         h.n_keys = nk
         padded.append(h)
     return stack_padded(padded)

@@ -27,6 +27,13 @@ Tarjan:
 Verdicts are bitwise-identical to the single-device `core_check` — tested
 differentially (tests/test_parallel.py) per the determinism-as-oracle
 rule (SURVEY.md §5).
+
+Since ISSUE 12 this module is the ENGINE under the sharded-by-default
+path: `device_core.core_check_auto` / `core_check_exact` /
+`list_append.check` resolve a mesh via `parallel.slots.default_mesh`
+and dispatch through `_core_check_sharded` + `shard_padded` directly.
+`check_sharded` remains as the explicit opt-in wrapper (superseded as
+an entry point — docs/IR.md).
 """
 
 from __future__ import annotations

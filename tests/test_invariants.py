@@ -366,16 +366,48 @@ def test_session_agrees_with_dag_walker():
         assert vec["anomaly-types"] == ref["anomaly-types"]
 
 
-def test_session_cross_key_sessions_use_walker():
-    """Multi-key WRITER sessions register cross-key obligations only
-    the DAG walker checks — those histories must route to it, and the
-    verdict must equal the walker's by construction."""
+def test_session_cross_key_sessions_vectorized():
+    """Multi-key WRITER sessions register cross-key obligations; since
+    ISSUE 12 the vectorized obligation pass covers them — NO walker
+    fallback, and the verdict + anomaly set must equal the walker's."""
     from jepsen_tpu.checkers.elle import sessions as walker
 
-    broken = inject_session_break(sess_history(seed=0))
-    res = inv_sess.check(broken)
-    assert res.get("fallback") == "dag-walker"
-    ref = walker.check(broken)
+    for seed in SEEDS:
+        broken = inject_session_break(sess_history(seed=seed))
+        res = inv_sess.check(broken)
+        assert not res.get("fallback"), res.get("fallback")
+        ref = walker.check(broken)
+        assert res["valid?"] == ref["valid?"]
+        assert res["anomaly-types"] == ref["anomaly-types"]
+
+
+def test_session_cross_key_obligation_only_violation():
+    """A violation visible ONLY through cross-key propagation (the
+    observer's k1 reads are same-key-consistent): S1 reads k1@2 then
+    writes k2; the observer reads that k2 version and afterwards an
+    ANCESTOR of k1@2.  Walker and vectorized pass must both flag it."""
+    from jepsen_tpu.checkers.elle import sessions as walker
+
+    ops = []
+
+    def txn(p, filled):
+        ops.append(Op(type=INVOKE, process=p, f="txn",
+                      value=[[m[0], m[1],
+                              None if m[0] == "r" else m[2]]
+                             for m in filled]))
+        ops.append(Op(type=OK, process=p, f="txn", value=filled))
+
+    txn(0, [["r", 1, None], ["w", 1, 1]])
+    txn(0, [["r", 1, 1], ["w", 1, 2]])
+    txn(0, [["r", 1, 2], ["w", 2, 10]])   # k2 write depends on k1@2
+    txn(2, [["r", 2, 10]])                # observer activates
+    txn(2, [["r", 1, 1]])                 # older than k1@2 -> WFR
+    h = History(ops)
+    res = inv_sess.check(h, use_device=False)
+    ref = walker.check(h)
+    assert not res.get("fallback")
+    assert res["valid?"] is False
+    assert "writes-follow-reads-violation" in res["anomaly-types"]
     assert res["valid?"] == ref["valid?"]
     assert res["anomaly-types"] == ref["anomaly-types"]
 

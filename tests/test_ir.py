@@ -1,0 +1,206 @@
+"""HistoryIR (ISSUE 12): one packed-history IR for every checker
+family, and the sharded-by-default checking path built on it.
+
+Pins:
+- IR round-trip: checking THROUGH the IR == checking the raw history,
+  verdict-and-anomaly-set, for every family (elle la/rw, bank,
+  long-fork, write-skew, session, knossos).
+- section caching: a composed check derives each packing once.
+- IR derived columns / capacity facts: the padded layout with columns
+  stripped (legacy in-program derivation) produces bitwise-identical
+  core-check results.
+- packed-only IRs degrade exactly like bare PackedTxns.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers import api as checker_api
+from jepsen_tpu.history.ir import IR_VERSION, HistoryIR
+from jepsen_tpu.history.ops import INVOKE, OK, History, Op
+from jepsen_tpu.history.soa import pack_txns
+from jepsen_tpu.workloads import synth
+
+
+def _txn(ops, p, filled):
+    ops.append(Op(type=INVOKE, process=p, f="txn",
+                  value=[[m[0], m[1], None if m[0] == "r" else m[2]]
+                         for m in filled]))
+    ops.append(Op(type=OK, process=p, f="txn", value=filled))
+
+
+def _la_history(invalid=False):
+    h = synth.la_history(n_txns=80, n_keys=4, concurrency=5,
+                         multi_append_prob=0.2, seed=11)
+    if invalid:
+        synth.inject_wr_cycle(h)
+        synth.inject_g1a(h)
+    return h
+
+
+def _rw_history():
+    ops = []
+    _txn(ops, 0, [["r", 0, None], ["w", 0, 1]])
+    _txn(ops, 1, [["r", 0, 1], ["w", 1, 5]])
+    _txn(ops, 0, [["r", 1, 5]])
+    return History(ops)
+
+
+def _bank_history():
+    ops = []
+    ops.append(Op(type=INVOKE, process=0, f="read", value=None))
+    ops.append(Op(type=OK, process=0, f="read", value={0: 5, 1: 5}))
+    ops.append(Op(type=INVOKE, process=1, f="transfer",
+                  value={"from": 0, "to": 1, "amount": 2}))
+    ops.append(Op(type=OK, process=1, f="transfer",
+                  value={"from": 0, "to": 1, "amount": 2}))
+    ops.append(Op(type=INVOKE, process=0, f="read", value=None))
+    ops.append(Op(type=OK, process=0, f="read", value={0: 3, 1: 7}))
+    return History(ops)
+
+
+# ------------------------------------------------- round-trip per family
+
+def test_ir_roundtrip_list_append():
+    from jepsen_tpu.checkers.elle import list_append
+
+    for invalid in (False, True):
+        h = _la_history(invalid)
+        raw = list_append.check(h, ("strict-serializable",))
+        via = list_append.check(HistoryIR.of(h),
+                                ("strict-serializable",))
+        assert via["valid?"] == raw["valid?"]
+        assert sorted(via["anomaly-types"]) == sorted(raw["anomaly-types"])
+
+
+def test_ir_roundtrip_rw_register():
+    from jepsen_tpu.checkers.elle import rw_register
+
+    h = _rw_history()
+    raw = rw_register.check(h)
+    via = rw_register.check(HistoryIR.of(h))
+    assert via["valid?"] == raw["valid?"]
+    assert sorted(via["anomaly-types"]) == sorted(raw["anomaly-types"])
+
+
+def test_ir_roundtrip_invariants_families():
+    from jepsen_tpu.checkers.invariants import bank as inv_bank
+    from jepsen_tpu.checkers.invariants import predicate as inv_pred
+    from jepsen_tpu.checkers.invariants import session as inv_sess
+
+    hb = _bank_history()
+    raw = inv_bank.check(hb, {"accounts": {0: 5, 1: 5}})
+    via = inv_bank.check(HistoryIR.of(hb), {"accounts": {0: 5, 1: 5}})
+    assert via["valid?"] == raw["valid?"]
+    assert via.get("anomaly-types") == raw.get("anomaly-types")
+
+    hr = _rw_history()
+    for mod in (inv_pred, inv_sess):
+        raw = mod.check(hr, use_device=False)
+        via = mod.check(HistoryIR.of(hr), use_device=False)
+        assert via["valid?"] == raw["valid?"]
+        assert via.get("anomaly-types") == raw.get("anomaly-types")
+
+
+def test_ir_roundtrip_knossos():
+    from jepsen_tpu.checkers.knossos import analysis
+    from jepsen_tpu.models import register
+
+    ops = [
+        Op(type=INVOKE, process=0, f="write", value=1),
+        Op(type=OK, process=0, f="write", value=1),
+        Op(type=INVOKE, process=1, f="read", value=None),
+        Op(type=OK, process=1, f="read", value=1),
+    ]
+    h = History(ops)
+    ir = HistoryIR.of(h)
+    raw = analysis(h, register())
+    via = analysis(ir, register())
+    assert via["valid?"] == raw["valid?"] is True
+    # the entry table is the memoized IR section
+    assert ir.lin_ops() is ir.lin_ops()
+
+
+# ------------------------------------------------------ caching contract
+
+def test_ir_sections_memoized_and_shared():
+    h = _rw_history()
+    ir = HistoryIR.of(h)
+    assert HistoryIR.of(ir) is ir
+    assert ir.packed("rw-register") is ir.packed("rw-register")
+    assert ir.rw_inference() is ir.rw_inference()
+    # the IR is a History: plain consumers see the same ops
+    assert len(ir) == len(h)
+    assert list(ir) == list(h.ops)
+
+    la = HistoryIR.of(_la_history())
+    assert la.padded("list-append") is la.padded("list-append")
+    lay = la.layout()
+    assert lay["version"] == IR_VERSION
+    assert lay["derived_columns"] is True
+
+
+def test_compose_wraps_history_in_one_ir():
+    """A composed check hands every sub-checker the SAME IR (each
+    family's packing derives once)."""
+    seen = []
+
+    class Probe(checker_api.Checker):
+        def check(self, test, history, opts=None):
+            seen.append(history)
+            return {"valid?": True}
+
+    comp = checker_api.compose({"a": Probe(), "b": Probe()})
+    h = _rw_history()
+    res = comp.check({}, h, {})
+    assert res["valid?"] is True
+    assert len(seen) == 2
+    assert isinstance(seen[0], HistoryIR)
+    assert seen[0] is seen[1]
+    assert seen[0].ops is h.ops
+
+
+def test_packed_only_ir_degrades_like_packed():
+    from jepsen_tpu.checkers.invariants import session as inv_sess
+
+    p = pack_txns(_rw_history(), "rw-register")
+    ir = HistoryIR.of(p)
+    assert ir.packed_only
+    res = inv_sess.check(ir)
+    raw = inv_sess.check(p)
+    assert res["valid?"] == raw["valid?"]
+
+
+# ------------------------------- derived columns == in-program derivation
+
+def _strip_ir(h):
+    return dataclasses.replace(
+        h, v_cap=0, o_cap=0, app_val_mono=False, rd_start_mono=False,
+        proc_seq=False, run_sort=None, inv_run=None, key_ord_len=None,
+        key_ord_read=None, proc_order=None, barrier_order=None,
+        barrier_bi=None)
+
+
+@pytest.mark.parametrize("seed", [0, 5, 9])
+def test_ir_columns_bitwise_equal_to_legacy_layout(seed):
+    """pad_packed's capacity facts + derived-order columns change the
+    program, never the bits: stripping every v2 fact (the legacy
+    R-sized, in-program-derivation layout) yields identical core-check
+    results on valid AND corrupted histories."""
+    from jepsen_tpu.checkers.elle.device_core import core_check
+    from jepsen_tpu.checkers.elle.device_infer import pad_packed
+
+    h = synth.la_history(n_txns=100, n_keys=5, concurrency=6,
+                         multi_append_prob=0.25, seed=seed)
+    if seed % 2:
+        synth.inject_rw_cycle(h)
+        synth.inject_g1b(h)
+    p = pack_txns(h, "list-append")
+    hp = pad_packed(p)
+    assert hp.run_sort is not None and hp.v_cap and hp.o_cap
+    bits_v2, over_v2 = core_check(hp, p.n_keys)
+    bits_v1, over_v1 = core_check(_strip_ir(hp), p.n_keys)
+    assert np.array_equal(np.asarray(bits_v2), np.asarray(bits_v1))
+    assert int(np.asarray(over_v2)) == int(np.asarray(over_v1))

@@ -296,3 +296,71 @@ def test_check_batch_hybrid_500k():
     want = check_batch(ps)
     assert got == want
     assert all(r["valid?"] is True and r["exact"] for r in got)
+
+
+def test_sharded_default_differential_shard_counts(monkeypatch):
+    """ISSUE 12 acceptance pin: the sharded-DEFAULT path
+    (`core_check_auto` under a forced JEPSEN_SHARDS) at shard counts
+    1/2/4 is bitwise-equal to the single-device core check, and the
+    full `list_append.check` pipeline agrees with the HOST ORACLE
+    verdict-and-anomaly-set on the seeded anomaly corpora."""
+    from jepsen_tpu.checkers.elle import list_append, oracle
+    from jepsen_tpu.checkers.elle.device_core import core_check, \
+        core_check_auto
+
+    cases = [synth.packed_la_history(n_txns=96, n_keys=6, seed=12)]
+    hs = []
+    for seed in (4, 6):
+        h = synth.la_history(n_txns=110, n_keys=5, concurrency=6,
+                             multi_append_prob=0.2, seed=seed)
+        if seed == 4:
+            synth.inject_wr_cycle(h)
+            synth.inject_g1a(h)
+        else:
+            synth.inject_rw_cycle(h)
+        hs.append(h)
+        cases.append(pack_txns(h, "list-append"))
+
+    for n in ("1", "2", "4"):
+        monkeypatch.setenv("JEPSEN_SHARDS", n)
+        for p in cases:
+            hp = pad_packed(p)
+            bits_ref, over_ref = core_check(hp, p.n_keys)
+            bits_sh, over_sh = core_check_auto(hp, p.n_keys)
+            assert np.array_equal(np.asarray(bits_sh),
+                                  np.asarray(bits_ref)), n
+            assert int(np.asarray(over_sh)) == int(np.asarray(over_ref))
+        for h in hs:
+            dev = list_append.check(h, ("strict-serializable",))
+            ref = oracle.check(h, ("strict-serializable",))
+            assert dev["valid?"] == ref["valid?"], n
+            assert sorted(dev["anomaly-types"]) == \
+                sorted(ref["anomaly-types"]), n
+
+
+def test_default_mesh_gates(monkeypatch):
+    """Mesh resolution: forced JEPSEN_SHARDS activates sharding on any
+    backend; unforced CPU stays single-device (virtual host devices on
+    shared cores cannot win, and big-shape GSPMD compiles are
+    pathological on XLA:CPU); sub-threshold histories stay
+    single-device; slot slices carve the device set."""
+    from jepsen_tpu.parallel import slots
+
+    monkeypatch.delenv("JEPSEN_SHARDS", raising=False)
+    # unforced on the cpu backend: no default sharding even when large
+    assert slots.default_mesh(1 << 20) is None
+    monkeypatch.setenv("JEPSEN_SHARDS", "4")
+    m = slots.default_mesh(1 << 20)
+    assert m is not None and m.devices.size == 4
+    assert slots.default_mesh(None) is not None  # forced skips the gate
+    monkeypatch.setenv("JEPSEN_SHARDS", "1")
+    assert slots.default_mesh(1 << 20) is None
+    monkeypatch.delenv("JEPSEN_SHARDS", raising=False)
+    # slot slices: 8 devices / 4 slots -> 2 devices per slot
+    devs = slots.slot_devices(1, 4)
+    assert len(devs) == 2
+    try:
+        slots.set_active_slot(1, 4)
+        assert len(slots._visible_devices()) == 2
+    finally:
+        slots.set_active_slot(None)
