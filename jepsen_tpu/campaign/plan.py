@@ -410,6 +410,11 @@ def _schedule_pkg_for(opts: Dict[str, Any], nodes, client):
         "rng": _random.Random(f"sched|{windows_digest(wins)}"),
         "host": host,
         "client": client,
+        # wall-clock anchor (ISSUE 13): fleet workers install the
+        # claim's clock-offset-corrected t0 so every host's windows
+        # fire at the same absolute time; absent (single-process) the
+        # offsets stay relative to workload start
+        "t0": opts.get("nemesis-t0"),
     })
 
 
@@ -483,6 +488,12 @@ def build_test(rs: RunSpec, base: str) -> dict:
     t["campaign-run-id"] = rs.run_id
     if opts.get("telemetry"):
         t["telemetry"] = True
+    if opts.get("live-check"):
+        # live verification (ISSUE 13): the cell's interpreter streams
+        # completed ops into a verifier session while it runs — a URL
+        # (remote service / fleet coordinator with --ingest) or
+        # {"inproc": true}; see docs/VERIFIER.md
+        t["live-check"] = opts["live-check"]
     if opts.get("checker-time-limit") is not None:
         t["checker-time-limit"] = float(opts["checker-time-limit"])
     if rs.fault is not None:

@@ -156,7 +156,18 @@ def serve_cmd(opts: argparse.Namespace) -> int:
     if getattr(opts, "ingest", False):
         from .verifier import VerifierService
 
-        verifier = VerifierService(opts.store_dir)
+        cfg = {}
+        if getattr(opts, "compact_bytes", None):
+            cfg["compact-bytes"] = int(opts.compact_bytes)
+        if getattr(opts, "gc_idle", None):
+            cfg["gc-idle-s"] = float(opts.gc_idle)
+        if getattr(opts, "archive_sealed", None):
+            cfg["archive-sealed-s"] = float(opts.archive_sealed)
+        verifier = VerifierService(opts.store_dir, default_config=cfg)
+        # the production-service loop (ISSUE 13): batched multi-tenant
+        # sweeps + GC/retention on a maintenance thread
+        verifier.start_maintenance(
+            float(getattr(opts, "maintain_interval", 5.0) or 5.0))
     try:
         web.serve(port=opts.port, base=opts.store_dir,
                   host=getattr(opts, "host", "127.0.0.1"),
@@ -379,6 +390,16 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
         except (OSError, ValueError) as e:
             print(f"fleet: bad spec {opts.spec!r}: {e}", file=sys.stderr)
             return 2
+        verifier = None
+        if getattr(opts, "ingest", False):
+            # live verification at fleet scale (ISSUE 13): the
+            # coordinator also serves the verifier, so workers' cells
+            # with "live-check" opts stream here — no shared
+            # filesystem, one control-plane URL
+            from .verifier import VerifierService
+
+            verifier = VerifierService(base)
+            verifier.start_maintenance()
         print(f"fleet {coord.name}: {len(coord.specs)} cells, "
               f"{len(coord._done_ids)} already indexed, lease "
               f"{coord.lease_s}s, boot digest {coord.boot_digest}",
@@ -386,12 +407,15 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
         if not getattr(opts, "until_done", False):
             try:
                 web.serve(port=opts.port, base=base, host=opts.host,
-                          fleet=coord)
+                          fleet=coord, verifier=verifier)
             finally:
                 coord.close()
+                if verifier is not None:
+                    verifier.close()
             return 0
         srv = web.serve(port=opts.port, base=base, host=opts.host,
-                        fleet=coord, background=True)
+                        fleet=coord, verifier=verifier,
+                        background=True)
         try:
             while not coord.finished:
                 _time.sleep(0.2)
@@ -399,6 +423,8 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
             return 1
         finally:
             coord.close()
+            if verifier is not None:
+                verifier.close()
             srv.server_close()
         summary = coord.summary()
         print(report.render_campaign(summary))
@@ -414,7 +440,8 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
                              device_slots=opts.device_slots,
                              backend=opts.backend, mesh=opts.mesh,
                              poll_s=opts.poll,
-                             claim_budget_s=opts.claim_budget)
+                             claim_budget_s=opts.claim_budget,
+                             upload=getattr(opts, "upload", False))
         # SIGTERM drains gracefully: finish the in-flight cell, release
         # unstarted claims, exit — the lease protocol covers kill -9
         try:
@@ -460,6 +487,9 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
                 line += (f" windows[gen {wd.get('gen')}] "
                          f"{wd.get('digest')} open={open_}"
                          f"{'' if wd.get('synced') else ' DESYNCED'}")
+                if wd.get("t0-skew") is not None:
+                    line += (f" t0-skew={wd['t0-skew']}s"
+                             f"{'' if wd.get('clock-synced') else ' CLOCK-DESYNCED'}")
             print(line)
         sched = s.get("nemesis-schedule")
         if sched:
@@ -467,12 +497,14 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
                   f"window(s)/gen over {'|'.join(sched.get('faults'))}")
             gens = sched.get("gens") or {}
             digests = sched.get("digest-by-gen") or {}
+            t0s = sched.get("t0-by-gen") or {}
             for g in sorted(gens, key=lambda x: int(x)):
                 wins = " ".join(
                     f"[{w.get('pos')}:{w.get('fault')}@"
                     f"{w.get('at_s')}s+{w.get('dur_s')}s]"
                     for w in gens[g])
-                print(f"  gen {g}: {digests.get(g)} {wins}")
+                anchor = (f" t0={t0s[g]}" if g in t0s else "")
+                print(f"  gen {g}: {digests.get(g)}{anchor} {wins}")
         return 0
     print(f"fleet: unknown action {opts.action!r}", file=sys.stderr)
     return 2
@@ -634,6 +666,20 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                          "/ingest/<session> and publish rolling "
                          "verdicts on GET /verdict/<session> "
                          "(docs/VERIFIER.md)")
+    ps.add_argument("--compact-bytes", type=int, default=None,
+                    help="auto-compact a session's journal once it "
+                         "exceeds this many bytes (checkpoint + "
+                         "truncate; docs/VERIFIER.md)")
+    ps.add_argument("--gc-idle", type=float, default=None,
+                    help="expire open sessions idle for this many "
+                         "seconds (journal stays; a later touch "
+                         "recovers them)")
+    ps.add_argument("--archive-sealed", type=float, default=None,
+                    help="archive sealed sessions idle for this many "
+                         "seconds under <store>/verifier/_archive/")
+    ps.add_argument("--maintain-interval", type=float, default=5.0,
+                    help="seconds between maintenance ticks (batched "
+                         "sweep + gc)")
 
     pa = sub.add_parser("analyze", help="re-check a stored run")
     pa.add_argument("dir", help="store run directory")
@@ -788,6 +834,16 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                      help="seconds of seeded-jittered backoff a worker "
                           "spends riding out claim outages before "
                           "giving up (work)")
+    pfl.add_argument("--upload", action="store_true",
+                     help="work: upload each cell's run dir to the "
+                          "coordinator's artifact endpoint — no "
+                          "shared store filesystem needed "
+                          "(docs/FLEET.md federation)")
+    pfl.add_argument("--ingest", action="store_true",
+                     help="serve: also run the verifier service on "
+                          "the same port, so cells with "
+                          '"live-check" opts stream here '
+                          "(docs/VERIFIER.md)")
 
     def dispatch(opts: argparse.Namespace) -> int:
         if opts.cmd == "test":

@@ -205,6 +205,23 @@ def _run_phases(test: dict, tel) -> dict:
                 test.get("nodes"))
     sessions: Dict[str, Session] = {}
     nemesis = test.get("nemesis")
+    # live checking (ISSUE 13): opt-in via the "live-check" test key
+    # (campaign spec opts pass through build_test).  The interpreter
+    # streams every history event into the client's sink; a verifier
+    # partitioned past the budget degrades the client and the ordinary
+    # stored-history check below stands alone — the run never depends
+    # on the live path.
+    live = None
+    if test.get("live-check"):
+        from .verifier.client import live_check_for
+
+        try:
+            with tel.span("live-check.open"):
+                live = live_check_for(test)
+        except Exception as e:  # noqa: BLE001 — opt-in accelerant
+            logger.warning("live-check unavailable: %s", e)
+        if live is not None:
+            test["op-sink"] = live.feed
     try:
         sessions = _open_sessions(test)
         test["sessions"] = sessions
@@ -233,6 +250,9 @@ def _run_phases(test: dict, tel) -> dict:
             test["history"] = hist
             logger.info("Workload complete: %d ops", len(hist))
         except BaseException as e:
+            if live is not None:
+                _quietly("live-check close", live.close)
+                live = None
             log_run_failure(test, e)
             raise
         finally:
@@ -256,18 +276,38 @@ def _run_phases(test: dict, tel) -> dict:
         _close_sessions(sessions)
         test.pop("sessions", None)
 
+    test.pop("op-sink", None)  # the feed hook must not persist
     try:
         with tel.span("store.save_0"):
             store.save_0(test)
         # the check phase gets one span per (composed) checker, opened
         # inside checker_api.check_safe with the checker's name attached
         test["results"] = _check(test, test.get("history"))
+        if live is not None:
+            # drain + verdict (+seal) the live session; a degraded
+            # stream stamps {"state": "degraded"} and the stored-
+            # history verdict above is the sole authority.  In-proc
+            # services run their sweeps here, so verifier.sweep spans
+            # land in this run's telemetry.
+            with tel.span("live-check.finish"):
+                summary = live.finish()
+            live = None  # finished — the close-on-error below is moot
+            if isinstance(test.get("results"), dict):
+                test["results"]["live-check"] = summary
+            (logger.info if summary.get("state") == "ok"
+             else logger.warning)(
+                "live-check %s: state=%s ops=%s", summary.get("session"),
+                summary.get("state"), summary.get("ops"))
         with tel.span("store.save_1"):
             store.save_1(test)
         valid = test["results"].get("valid?")
         (logger.info if valid is True else logger.warning)(
             "Analysis complete: valid? = %s", valid)
     finally:
+        if live is not None:
+            # save_0/_check raised before finish(): a long-lived fleet
+            # worker must not leak the sender thread / in-proc service
+            _quietly("live-check close", live.close)
         _stop_logging(log_handler)
     return test
 

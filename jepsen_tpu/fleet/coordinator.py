@@ -48,6 +48,7 @@ from jepsen_tpu.campaign.index import Index
 from jepsen_tpu.resilience import faults as faults_mod
 from jepsen_tpu.resilience.faults import FaultInjected
 
+from .artifacts import ArtifactStore
 from .queue import WorkQueue, fleet_path
 
 logger = logging.getLogger("jepsen.fleet")
@@ -57,6 +58,15 @@ __all__ = ["FleetCoordinator"]
 #: a worker whose last heartbeat is older than this many leases is
 #: counted dead by the workers-alive gauge (it can still come back)
 ALIVE_LEASES = 3.0
+
+#: wall-clock t0 alignment (ISSUE 13 satellite): a generation's window
+#: anchor is set this many seconds past its FIRST claim, so the other
+#: hosts' cells claimed shortly after share the same absolute timeline
+T0_LEAD_S = 0.5
+
+#: a worker whose reported t0 differs from the authoritative anchor by
+#: more than this is flagged clock-desynced on /fleet/status
+T0_SKEW_S = 0.25
 
 
 def _registry():
@@ -99,6 +109,14 @@ class FleetCoordinator:
         self.sched = self.spec.get("nemesis-schedule")
         self._windows_by_gen: Dict[int, list] = {}
         self._windows_digests: Dict[int, str] = {}
+        #: per-generation wall-clock window anchor (ISSUE 13): lazily
+        #: set at a generation's first claim; broadcast with the
+        #: window set so every host fires the schedule on the
+        #: coordinator's absolute timeline
+        self._gen_t0: Dict[int, float] = {}
+        #: store federation (ISSUE 13): the artifact-upload endpoint's
+        #: staging + atomic landing
+        self.artifacts = ArtifactStore(self.base)
         if self.sched:
             for g in self.spec["seeds"]:
                 # pass the normalized block, not the whole spec — the
@@ -239,10 +257,20 @@ class FleetCoordinator:
             # heartbeat tick still installs the correct seeded windows
             # from here, before execute_run
             g = int(spec.get("seed", 0))
+            with self._lock:
+                # wall-clock t0 alignment: one absolute anchor per
+                # generation, minted at its first claim.  The claim
+                # also carries the coordinator's "now" so the worker
+                # can estimate its clock offset and convert the anchor
+                # into its own clock domain.
+                t0 = self._gen_t0.setdefault(
+                    g, round(time.time() + T0_LEAD_S, 3))
             out["windows"] = {
                 "gen": g,
                 "set": self._windows_by_gen.get(g, []),
                 "digest": self._windows_digests.get(g, ""),
+                "t0": t0,
+                "now": round(time.time(), 3),
             }
         return 200, out
 
@@ -345,6 +373,15 @@ class FleetCoordinator:
         return 200, {"ok": True, "status": status,
                      "finished": self.finished}
 
+    def artifact(self, run_id: str, params: Dict[str, Any],
+                 body: bytes) -> Tuple[int, Dict[str, Any]]:
+        """``POST /fleet/artifact/<run-id>`` — the store-federation
+        upload seam (chunked + digest-verified + idempotent; see
+        `artifacts.ArtifactStore`).  Guarded like every other
+        control-plane endpoint, so chaos plans drop/stall uploads."""
+        return self._guarded("fleet.artifact", self.artifacts.handle,
+                             run_id, params, body)
+
     def release(self, body: Dict[str, Any]
                 ) -> Tuple[int, Dict[str, Any]]:
         return self._guarded("fleet.release", self._release, body)
@@ -381,6 +418,17 @@ class FleetCoordinator:
                     row["windows"] = dict(
                         wins, synced=(auth is not None and
                                       wins.get("digest") == auth))
+                    # clock-desync visibility (ISSUE 13): the worker's
+                    # reported (offset-corrected) t0 vs the anchor
+                    auth_t0 = (self._gen_t0.get(int(g))
+                               if isinstance(g, int) else None)
+                    wt0 = wins.get("t0")
+                    if isinstance(wt0, (int, float)) \
+                            and auth_t0 is not None:
+                        skew = round(float(wt0) - auth_t0, 3)
+                        row["windows"]["t0-skew"] = skew
+                        row["windows"]["clock-synced"] = \
+                            abs(skew) <= T0_SKEW_S
                 workers[w] = row
             done = len(self._done_ids)
         self._update_gauges()
@@ -399,11 +447,15 @@ class FleetCoordinator:
             "workers": workers,
         }
         if self.sched:
+            with self._lock:
+                t0s = {str(g): t for g, t in
+                       sorted(self._gen_t0.items())}
             out["nemesis-schedule"] = {
                 "faults": self.sched["faults"],
                 "windows": self.sched["windows"],
                 "digest-by-gen": {str(g): d for g, d in
                                   sorted(self._windows_digests.items())},
+                "t0-by-gen": t0s,
                 "gens": {str(g): w for g, w in
                          sorted(self._windows_by_gen.items())},
             }

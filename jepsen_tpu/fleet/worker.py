@@ -37,6 +37,7 @@ import random
 import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
@@ -61,7 +62,8 @@ class FleetWorker:
                  lease_s: float = 15.0,
                  retry: Optional[RetryPolicy] = None,
                  timeout_s: float = 10.0,
-                 claim_budget_s: float = 120.0):
+                 claim_budget_s: float = 120.0,
+                 upload: bool = False):
         self.url = coordinator.rstrip("/")
         self.base = base or store.BASE
         self.name = name or f"{socket.gethostname()}-{os.getpid()}"
@@ -85,6 +87,12 @@ class FleetWorker:
             max_delay_s=2.0, classify=is_transient_http)
         #: SIGTERM drain flag (cli fleet work sets it from the handler)
         self.stop = threading.Event()
+        #: store federation (ISSUE 13): upload run dirs to the
+        #: coordinator's artifact endpoint after each cell — no shared
+        #: store filesystem needed.  Forced per cell by opts
+        #: ``"artifact-upload": true`` even when the flag is off.
+        self.upload = bool(upload)
+        self.uploads_done = 0
         self.cells_done = 0
         self.duplicates = 0
         #: the last installed window set (digest + descriptors) — what
@@ -98,18 +106,164 @@ class FleetWorker:
         """One guarded control-plane POST: the active fault plan fires
         at `site` (client-side chaos), transients retry per the
         policy."""
-        body = json.dumps(doc).encode()
+        return self._post_raw(site, path, json.dumps(doc).encode(),
+                              ctype="application/json")
 
+    def _post_raw(self, site: str, path: str, body: bytes, *,
+                  ctype: str = "application/octet-stream",
+                  accept_conflict: bool = False) -> Dict[str, Any]:
+        """One guarded POST.  With ``accept_conflict``, protocol 409s
+        are ANSWERS, not failures — their JSON body carries the
+        server's cursor, so they parse (stamped ``_conflict``) instead
+        of raising."""
         def send() -> Dict[str, Any]:
             req = urllib.request.Request(
                 self.url + path, data=body,
-                headers={"Content-Type": "application/json"},
-                method="POST")
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout_s) as r:
-                return json.loads(r.read().decode() or "{}")
+                headers={"Content-Type": ctype}, method="POST")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as r:
+                    return json.loads(r.read().decode() or "{}")
+            except urllib.error.HTTPError as e:
+                if accept_conflict and e.code == 409:
+                    doc = json.loads(e.read().decode() or "{}")
+                    doc["_conflict"] = True
+                    return doc
+                raise
 
         return resilience.device_call(site, send, policy=self.retry)
+
+    # -- store federation (ISSUE 13) -----------------------------------------
+
+    CHUNK_BYTES = 256 * 1024
+
+    def _artifact_post(self, run_id: str, params: Dict[str, Any],
+                       body: bytes) -> Dict[str, Any]:
+        from urllib.parse import quote, urlencode
+
+        q = urlencode({k: v for k, v in params.items()
+                       if v is not None})
+        path = f"/fleet/artifact/{quote(run_id)}" + (f"?{q}" if q
+                                                     else "")
+        return self._post_raw("fleet.artifact", path, body,
+                              accept_conflict=True)
+
+    #: how long an upload rides out a coordinator outage (a kill -9 +
+    #: restart window) before giving up — each re-contact resumes from
+    #: the server's durable cursor, so patience costs no re-sent bytes
+    UPLOAD_BUDGET_S = 30.0
+
+    def upload_artifact(self, run_id: str, rel: str) -> bool:
+        """Stream one run dir to the coordinator's artifact endpoint
+        (docs/FLEET.md federation): tar + sha256, chunked from the
+        server's resume cursor — a coordinator kill -9 mid-upload
+        leaves a resumable partial this loop picks back up after the
+        restart; a digest mismatch restarts the upload from byte 0.
+        Transport outages outlasting the retry policy re-probe under
+        :data:`UPLOAD_BUDGET_S` instead of failing the upload."""
+        import tempfile
+
+        from .artifacts import pack_run_dir_file
+
+        d = os.path.join(self.base, rel)
+        if not os.path.isdir(d):
+            logger.warning("fleet worker %s: no run dir %s to upload",
+                           self.name, d)
+            return False
+        with tempfile.TemporaryFile(prefix="jepsen-artifact-") as spool:
+            total, digest = pack_run_dir_file(d, spool)
+            return self._upload_spooled(run_id, rel, spool, total,
+                                        digest)
+
+    def _upload_spooled(self, run_id: str, rel: str, spool: Any,
+                        total: int, digest: str) -> bool:
+        # budget anchors the CONTINUOUS outage, not total upload time:
+        # every successful request pushes the deadline back out
+        deadline = time.monotonic() + self.UPLOAD_BUDGET_S
+
+        def patient(params: Dict[str, Any], body: bytes
+                    ) -> Optional[Dict[str, Any]]:
+            nonlocal deadline
+            while True:
+                try:
+                    r = self._artifact_post(run_id, params, body)
+                    deadline = time.monotonic() + self.UPLOAD_BUDGET_S
+                    return r
+                except urllib.error.HTTPError as e:
+                    if e.code < 500:
+                        # deterministic protocol rejection (oversized
+                        # artifact, bad rel): no retry can land it —
+                        # fail fast instead of burning the outage
+                        # budget on a non-outage
+                        logger.warning(
+                            "fleet worker %s: artifact upload of %s "
+                            "rejected (%s); giving up", self.name,
+                            run_id, e)
+                        return None
+                    if time.monotonic() > deadline:
+                        logger.warning(
+                            "fleet worker %s: artifact upload of %s "
+                            "gave up after %.0fs of outage (%s)",
+                            self.name, run_id, self.UPLOAD_BUDGET_S, e)
+                        return None
+                    time.sleep(0.5)
+                except Exception as e:  # noqa: BLE001 — outage window
+                    if time.monotonic() > deadline:
+                        logger.warning(
+                            "fleet worker %s: artifact upload of %s "
+                            "gave up after %.0fs of outage (%s)",
+                            self.name, run_id, self.UPLOAD_BUDGET_S, e)
+                        return None
+                    time.sleep(0.5)
+
+        probe = patient({}, b"")
+        if probe is None:
+            return False
+        if probe.get("landed") and probe.get("rel", rel) == rel:
+            return True
+        if probe.get("rel", rel) != rel:
+            # the marker/partial is another execution's dir (lease-
+            # lapse re-run, new timestamp): upload ours from scratch —
+            # the server discards the stale state on the first chunk
+            probe = {"received": 0}
+        offset = int(probe.get("received", 0))
+        restarts = 0
+        while True:
+            spool.seek(offset)
+            chunk = spool.read(self.CHUNK_BYTES)
+            r = patient(
+                {"offset": offset, "total": total,
+                 "digest": digest, "rel": rel}, chunk)
+            if r is None:
+                return False
+            if r.get("landed"):
+                self.uploads_done += 1
+                return True
+            if r.get("_conflict"):
+                got = int(r.get("received", 0))
+                if got == 0:
+                    # a discard-class answer (digest mismatch, unpack
+                    # failure), not a resume gap — gaps always carry a
+                    # positive cursor.  Counted regardless of offset:
+                    # a single-chunk upload conflicts AT offset 0, and
+                    # without the count it would re-POST forever while
+                    # the kept-alive lease pins the cell to this worker
+                    restarts += 1  # retry from 0 once, then give up
+                    if restarts > 1:
+                        logger.warning(
+                            "fleet worker %s: artifact %s rejected "
+                            "twice (%s); giving up", self.name,
+                            run_id, r.get("error"))
+                        return False
+                offset = got
+                continue
+            new_off = int(r.get("received", offset + len(chunk)))
+            if new_off <= offset and chunk:
+                logger.warning(
+                    "fleet worker %s: artifact upload of %s stuck at "
+                    "%d", self.name, run_id, offset)
+                return False
+            offset = new_off
 
     # -- protocol ------------------------------------------------------------
 
@@ -206,6 +360,21 @@ class FleetWorker:
                 "digest": windows_digest(wins),
                 "set": wins,
             }
+            # wall-clock t0 alignment (ISSUE 13): the claim carries the
+            # coordinator's absolute window anchor plus its "now";
+            # delta converts the anchor into THIS host's clock domain,
+            # so every host's windows fire at the same absolute time
+            # instead of `at_s` past whenever each workload happened
+            # to start.  The aligned anchor rides opts["nemesis-t0"]
+            # into `combined.schedule_package`.
+            t0 = (windows or {}).get("t0")
+            now = (windows or {}).get("now")
+            if isinstance(t0, (int, float)) \
+                    and isinstance(now, (int, float)):
+                delta = time.time() - float(now)
+                t0_local = float(t0) + delta
+                self.installed_windows["t0"] = round(t0_local, 3)
+                rs.opts["nemesis-t0"] = t0_local
             want = (windows or {}).get("digest")
             if want and want != self.installed_windows["digest"]:
                 logger.warning(
@@ -224,14 +393,26 @@ class FleetWorker:
         iw = self.installed_windows
         if not iw:
             return None
-        elapsed = time.monotonic() - t0
+        # one read: the cell thread may pop "t0" (stale-anchor path)
+        # between a has-key check and a lookup on this renewer thread
+        t0v = iw.get("t0")
+        if t0v is not None:
+            # aligned mode: elapsed runs from the shared wall-clock
+            # anchor, so two hosts' open/closed reports agree even when
+            # their workloads started at different times
+            elapsed = time.time() - float(t0v)
+        else:
+            elapsed = time.monotonic() - t0
         open_: List[Dict[str, Any]] = [
             {"pos": w.get("pos"), "fault": w.get("fault")}
             for w in iw["set"]
             if w["at_s"] <= elapsed < w["at_s"] + w["dur_s"]]
-        return {"gen": iw["gen"], "digest": iw["digest"],
-                "n": len(iw["set"]), "open": open_,
-                "elapsed": round(elapsed, 3)}
+        out = {"gen": iw["gen"], "digest": iw["digest"],
+               "n": len(iw["set"]), "open": open_,
+               "elapsed": round(elapsed, 3)}
+        if t0v is not None:
+            out["t0"] = t0v
+        return out
 
     def _run_cell(self, spec: Dict[str, Any],
                   windows: Optional[Dict[str, Any]] = None) -> None:
@@ -291,6 +472,37 @@ class FleetWorker:
         renewer = threading.Thread(target=renew_loop, daemon=True,
                                    name=f"fleet-renew-{self.name}")
         renewer.start()
+        # wall-clock t0 alignment: hold the workload until the
+        # generation's (clock-offset-corrected) anchor so every host
+        # starts — and therefore fires its windows — at the same
+        # absolute time, while the offsets stay RELATIVE to workload
+        # start (chaos-equivalent with the single-process expansion of
+        # the same spec, the PR 10 pin).  A stale anchor (claimed late,
+        # or a clock jumped) is skipped, bounded by the claim lead.
+        iw = self.installed_windows
+        if iw and iw.get("t0") is not None:
+            wait = float(iw["t0"]) - time.time()
+            if 0.0 < wait <= 5.0:
+                time.sleep(wait)
+            else:
+                if wait > 5.0:
+                    logger.warning(
+                        "fleet worker %s: window anchor %.3fs ahead "
+                        "(clock skew?); starting unaligned", self.name,
+                        wait)
+                # drop the anchor entirely, for a far-future anchor
+                # (clock skew — leaving nemesis-t0 set would shift
+                # every window by the full skew, silently diverging
+                # from the single-process schedule) AND for a stale
+                # one (claimed after t0, e.g. a later cell of the
+                # same generation — schedule_package clamps the shift
+                # to 0, so anchor-based ticks would report windows
+                # closed that actually fire relative to workload
+                # start).  Unaligned means RELATIVE offsets from
+                # workload start — the PR 10 behavior — and the tick
+                # clock must agree with where the faults really fire.
+                iw.pop("t0", None)
+                rs.opts.pop("nemesis-t0", None)
         t0 = time.monotonic()  # the window tick clock: workload start
         # mesh capability -> default-mesh shard count (PR 10 follow-on,
         # ISSUE 12 satellite): a cell pinning opts["mesh"] — or a worker
@@ -318,28 +530,54 @@ class FleetWorker:
         finally:
             if want_mesh:
                 slots_mod.set_forced_shards(None)
+        # store federation: ship the run dir BEFORE the verdict record,
+        # so the record's "dir" is browsable on the coordinator the
+        # moment the verdict lands.  Best-effort with retries — an
+        # upload outage never loses the verdict (the record carries
+        # it), and the idempotent protocol makes a re-upload after a
+        # lease-lapse re-execution harmless.  The renewer stays alive
+        # through upload AND complete: an outage-ridden upload
+        # (UPLOAD_BUDGET_S) can outlast the lease, and without
+        # renewals the cell would spuriously requeue and re-execute
+        # while this attempt is seconds from landing.
+        try:
+            if (self.upload or rs.opts.get("artifact-upload")) \
+                    and isinstance(rec.get("dir"), str):
+                try:
+                    if not self.upload_artifact(run_id, rec["dir"]):
+                        logger.warning(
+                            "fleet worker %s: artifact upload of %s "
+                            "did not land", self.name, run_id)
+                except Exception as e:  # noqa: BLE001 — verdict >
+                    # artifact
+                    logger.warning("fleet worker %s: artifact upload "
+                                   "of %s failed (%s)", self.name,
+                                   run_id, e)
+            try:
+                r = self._post("fleet.complete", "/fleet/complete",
+                               {"worker": self.name, "run": run_id,
+                                "record": rec})
+                if r.get("duplicate"):
+                    self.duplicates += 1
+                    logger.warning(
+                        "fleet worker %s: completion of %s was a "
+                        "duplicate (cell finished elsewhere)",
+                        self.name, run_id)
+                else:
+                    self.cells_done += 1
+            except Exception as e:  # noqa: BLE001 — an outage
+                # outlasting the retries loses THIS attempt, not the
+                # cell: the lease lapses, the cell requeues, and
+                # another worker (or this one, next claim) re-executes
+                # it — exactly-once still holds because this record
+                # never landed
+                logger.warning("fleet worker %s: complete(%s) failed "
+                               "beyond retries (%s); cell will "
+                               "requeue on lease expiry", self.name,
+                               run_id, e)
+        finally:
             stop_renew.set()
             renewer.join(timeout=5)
-        try:
-            r = self._post("fleet.complete", "/fleet/complete",
-                           {"worker": self.name, "run": run_id,
-                            "record": rec})
-            if r.get("duplicate"):
-                self.duplicates += 1
-                logger.warning("fleet worker %s: completion of %s was "
-                               "a duplicate (cell finished elsewhere)",
-                               self.name, run_id)
-            else:
-                self.cells_done += 1
-        except Exception as e:  # noqa: BLE001 — an upload outage
-            # outlasting the retries loses THIS attempt, not the cell:
-            # the lease lapses, the cell requeues, and another worker
-            # (or this one, next claim) re-executes it — exactly-one
-            # still holds because this record never landed
-            logger.warning("fleet worker %s: complete(%s) failed "
-                           "beyond retries (%s); cell will requeue on "
-                           "lease expiry", self.name, run_id, e)
-        finally:
             self.installed_windows = None
             try:
                 self._post("fleet.heartbeat", "/fleet/heartbeat",

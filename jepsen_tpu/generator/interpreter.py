@@ -180,6 +180,24 @@ def run(test: dict) -> History:
     events: List[dict] = []
     in_flight = 0
 
+    # the live-check op sink (ISSUE 13): every appended history event
+    # (invokes AND completions, in history order) is offered to
+    # test["op-sink"] — `verifier.client.LiveCheck.feed`, which only
+    # buffers under a lock (its own sender thread does the I/O).  A
+    # sink that raises is disarmed: live checking is an accelerant and
+    # must never break the workload.
+    sink = test.get("op-sink")
+
+    def offer(ev: dict) -> None:
+        nonlocal sink
+        if sink is None:
+            return
+        try:
+            sink(ev)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("op-sink failed (%s); live feed disarmed", e)
+            sink = None
+
     # telemetry (ISSUE 1): per-worker op counts accumulate in a local
     # dict on the (single-threaded) dispatch loop and flush to the
     # process registry once at the end — zero locking on the op path,
@@ -195,6 +213,7 @@ def run(test: dict) -> History:
         nonlocal ctx, gen, in_flight
         comp = dict(comp, time=now())
         events.append(comp)
+        offer(comp)
         if telemetric:
             k = (thread, comp.get("type"))
             op_counts[k] = op_counts.get(k, 0) + 1
@@ -246,6 +265,7 @@ def run(test: dict) -> History:
             gen = gen2
             invoke = dict(op_, type="invoke", time=ctx.time)
             events.append(invoke)
+            offer(invoke)
             thread = ctx.thread_for_process(invoke["process"])
             if telemetric:
                 k = (thread, "invoke")

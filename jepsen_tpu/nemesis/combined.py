@@ -22,6 +22,7 @@ facets for kill/pause; `opts["file"]` the corruption target.
 from __future__ import annotations
 
 import random as _random
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from jepsen_tpu import db as db_
@@ -456,6 +457,17 @@ def schedule_package(opts: dict) -> dict:
             pkgs.append(p)
             alive.append(fam)
     base = compose_packages(pkgs)
+    # wall-clock t0 alignment (ISSUE 13): when the campaign carries an
+    # absolute anchor (opts["t0"], epoch seconds — a fleet worker's
+    # claim-derived, clock-offset-corrected value), every window shifts
+    # by (t0 - now) so its ABSOLUTE fire time matches the other hosts'
+    # regardless of when each host's workload started.  An anchor in
+    # the past clamps to 0 — relative semantics, the single-process
+    # behavior, and window digests are anchor-free either way.
+    shift = 0.0
+    t0 = opts.get("t0")
+    if isinstance(t0, (int, float)):
+        shift = max(0.0, float(t0) - _time.time())
     timeline = []  # (time_s, order, event)
     for w in windows:
         if w["fault"] not in alive:
@@ -463,10 +475,10 @@ def schedule_package(opts: dict) -> dict:
         start, stop = _window_events(w["fault"], opts)
         stamp = {"pos": w.get("pos"), "digest": w.get("digest"),
                  "fault": w["fault"], "host": host}
-        timeline.append((float(w["at_s"]), len(timeline),
+        timeline.append((shift + float(w["at_s"]), len(timeline),
                          _stamp_event(start, stamp)))
         if stop is not None and w["fault"] not in _ONE_SHOT_FAULTS:
-            timeline.append((float(w["at_s"]) + float(w["dur_s"]),
+            timeline.append((shift + float(w["at_s"]) + float(w["dur_s"]),
                              len(timeline), _stamp_event(stop, stamp)))
     timeline.sort(key=lambda t: (t[0], t[1]))
     seq, t_prev = [], 0.0

@@ -190,7 +190,12 @@ def tests(name: Optional[str] = None, *, base: Optional[str] = None) -> List[str
             continue
         for ts in os.listdir(nd):
             d = os.path.join(nd, ts)
-            if ts != "latest" and os.path.isdir(d) and not os.path.islink(d):
+            # dot-prefixed dirs are in-flight artifact-upload staging
+            # (fleet store federation unpacks there, then atomically
+            # renames into place) — not run dirs, for this scan OR the
+            # warehouse ingest riding on it
+            if ts != "latest" and not ts.startswith(".") \
+                    and os.path.isdir(d) and not os.path.islink(d):
                 out.append(d)
     # newest run first regardless of test name: order by the timestamp
     # basename, not the full path (sorting full paths would rank runs by

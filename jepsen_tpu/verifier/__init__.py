@@ -14,10 +14,22 @@ Layers (see ``docs/VERIFIER.md``):
 - :mod:`.journal` — fsync'd per-session journals; accept → fsync → ack;
   byte-cursor resume; crash replay to the identical verdict digest.
 - :mod:`.service` — :class:`VerifierService`, the session manager the
-  web server (``cli serve --ingest``) routes to.
+  web server (``cli serve --ingest``) routes to; journal compaction,
+  session GC/archival, and the maintenance loop (ISSUE 13).
+- :mod:`.sweep` — multi-tenant batched dirty-region sweeps: many
+  sessions' regions, one ``ops.cycle_sweep`` dispatch (ISSUE 13).
+- :mod:`.client` — :class:`LiveCheck`, the live-checking client
+  `core.run`'s interpreter streams through (ISSUE 13).
 """
 
-from .journal import SessionJournal, read_meta, split_segment
+from .client import LiveCheck, live_check_for
+from .journal import (
+    SessionJournal,
+    read_checkpoint,
+    read_meta,
+    split_segment,
+    write_checkpoint,
+)
 from .service import VerifierService, scan_sessions
 from .session import (
     VerdictMismatch,
@@ -29,5 +41,6 @@ from .session import (
 __all__ = [
     "VerifierSession", "VerifierService", "SessionJournal",
     "VerdictMismatch", "verdict_digest", "iter_packed_segments",
-    "split_segment", "scan_sessions", "read_meta",
+    "split_segment", "scan_sessions", "read_meta", "LiveCheck",
+    "live_check_for", "read_checkpoint", "write_checkpoint",
 ]

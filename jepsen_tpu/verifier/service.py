@@ -55,6 +55,7 @@ from .journal import (
     JOURNAL_FILE,
     META_FILE,
     SessionJournal,
+    read_checkpoint,
     read_meta,
     split_segment,
 )
@@ -68,9 +69,14 @@ from .session import (
 
 logger = logging.getLogger("jepsen.verifier")
 
-__all__ = ["VerifierService", "VERIFIER_DIR", "scan_sessions"]
+__all__ = ["VerifierService", "VERIFIER_DIR", "ARCHIVE_DIR",
+           "scan_sessions"]
 
 VERIFIER_DIR = "verifier"
+
+#: sealed-session archival target under the verifier root; leading
+#: underscore so session scans (and the warehouse ingest) skip it
+ARCHIVE_DIR = "_archive"
 
 #: sweep-duration histogram bounds (seconds) — p95 derivable from the
 #: cumulative buckets on /metrics
@@ -94,6 +100,11 @@ class _Live:
         # handler that fetched it before the pop must not keep using
         # the zombie — it re-resolves and gets a freshly recovered one
         self.dead = False
+        # set at recovery when the compacted prefix is unrecoverable
+        # (checkpoint unusable, journal already truncated): the session
+        # must refuse to serve normal-looking verdicts over a partial
+        # history — ingest/verdict/seal answer 410 instead
+        self.recovery_error: Optional[str] = None
         self.journal = SessionJournal(dirpath)
         self.session = VerifierSession(
             name,
@@ -134,6 +145,8 @@ class _Live:
             "segments": self.session.segments,
             "config": self.config,
         }
+        if self.recovery_error:
+            doc["recovery-error"] = self.recovery_error
         if verdict is not None:
             doc["verdict"] = {
                 k: verdict.get(k) for k in
@@ -156,6 +169,32 @@ class _Live:
             self.last_verdict = verdict
         self.journal.write_meta(self.snapshot(verdict))
 
+    def idle_s(self, now: Optional[float] = None) -> float:
+        now = time.time() if now is None else now
+        return max(0.0, now - max(self.last_ingest,
+                                  self.last_verdict_ts))
+
+    def compact(self) -> Dict[str, Any]:
+        """Checkpoint-then-truncate (caller holds self.lock): persist
+        the packed prefix as ``checkpoint.npz``, then rewrite the
+        journal down to the un-checkpointed suffix.  Ordering is the
+        crash discipline — a kill between the two writes leaves the
+        full journal AND a checkpoint; recovery replays only the
+        suffix past the checkpoint's cursor, so nothing doubles."""
+        before = self.journal.disk_bytes()
+        cursor = self.journal.cursor
+        cols, meta = self.session.checkpoint_state()
+        meta["cursor"] = cursor
+        self.journal.write_checkpoint(cols, meta)
+        self.journal.compact(cursor)
+        out = {"session": self.name, "cursor": cursor,
+               "journal-bytes-before": before,
+               "journal-bytes-after": self.journal.disk_bytes()}
+        self.stream.emit("compact", **{k: v for k, v in out.items()
+                                       if k != "session"})
+        _registry().counter("verifier-compactions").inc()
+        return out
+
     def close(self, reason: str) -> None:
         self.stream.close(reason=reason)
         self.journal.close()
@@ -177,6 +216,8 @@ class VerifierService:
         self._lock = threading.RLock()
         self._live: Dict[str, _Live] = {}
         self._name_locks: Dict[str, threading.RLock] = {}
+        self._maint: Optional[threading.Thread] = None
+        self._maint_stop = threading.Event()
 
     # -- lookup / lifecycle -------------------------------------------------
 
@@ -185,7 +226,16 @@ class VerifierService:
 
     @staticmethod
     def valid_name(name: str) -> bool:
-        return bool(name) and store.sanitize(name) == name
+        # leading "_" / "." are infrastructure namespaces (``_archive/``
+        # retention, dot-prefixed staging) that the session and store
+        # scans skip — a session there would journal into the retention
+        # subtree or be invisible to listings and gc
+        return bool(name) and store.sanitize(name) == name \
+            and not name.startswith(("_", "."))
+
+    def _nlock(self, name: str) -> threading.RLock:
+        with self._lock:
+            return self._name_locks.setdefault(name, threading.RLock())
 
     def _get(self, name: str, create: bool = False,
              config: Optional[Dict[str, Any]] = None) -> Optional[_Live]:
@@ -195,7 +245,7 @@ class VerifierService:
             live = self._live.get(name)
             if live is not None:
                 return live
-            nlock = self._name_locks.setdefault(name, threading.RLock())
+        nlock = self._nlock(name)
         with nlock:
             with self._lock:
                 live = self._live.get(name)  # a racer built it first
@@ -226,11 +276,55 @@ class VerifierService:
     def _recover(self, live: _Live, meta: Optional[Dict[str, Any]]
                  ) -> None:
         """Replay the journal into the fresh session — the restart
-        path.  A sealed session keeps its recorded seal block instead
-        of re-running the batch checker."""
+        path.  With a checkpoint on disk (a compacted session) the
+        packed prefix restores vectorized and only the journal suffix
+        past the checkpoint cursor replays line by line; the reached
+        verdict digest is identical either way.  A sealed session
+        keeps its recorded seal block instead of re-running the batch
+        checker."""
         n = 0
         t0 = time.time()
-        for chunk in live.journal.read_ops():
+        start = None
+        ckpt = read_checkpoint(live.dir)
+        if ckpt is None and live.journal.base > 0:
+            # compaction truncated the journal but its checkpoint is
+            # missing/unreadable: the prefix cannot be rebuilt.
+            # Quarantine rather than serve valid?-looking verdicts
+            # over a suffix-only replay
+            live.recovery_error = ("checkpoint missing or unreadable "
+                                   "and the journal prefix was "
+                                   "compacted away")
+            logger.error("verifier: session %s unrecoverable: %s",
+                         live.name, live.recovery_error)
+            return
+        if ckpt is not None:
+            cols, cmeta = ckpt
+            try:
+                live.session.load_checkpoint(cols, cmeta)
+                start = int(cmeta["cursor"])
+            except Exception as e:  # noqa: BLE001 — external corruption
+                if live.journal.base > 0:
+                    # the journal prefix was compacted away: without
+                    # the checkpoint the history cannot be rebuilt.
+                    # Quarantine rather than serve valid?-looking
+                    # verdicts over a truncated replay
+                    live.recovery_error = (
+                        f"checkpoint unusable ({e}) and the journal "
+                        "prefix was compacted away")
+                    logger.error("verifier: session %s unrecoverable: "
+                                 "%s", live.name, live.recovery_error)
+                    return
+                logger.warning(
+                    "verifier: checkpoint for %s unusable (%s); "
+                    "replaying the journal", live.name, e)
+                live.session = VerifierSession(
+                    live.name,
+                    consistency_models=live.session.consistency_models,
+                    anomalies=live.session.extra_anomalies,
+                    sweep_chunk=live.session.sweep_chunk,
+                    max_reported=live.session.max_reported)
+                start = None
+        for chunk in live.journal.read_ops(from_cursor=start):
             live.session.append_ops(chunk)
             n += len(chunk)
         v = (meta.get("verdict") or {}) if meta else {}
@@ -273,6 +367,9 @@ class VerifierService:
             with live.lock:
                 if live.dead:
                     continue  # re-resolve: a fresh recovery replaces it
+                if live.recovery_error:
+                    return 410, {"error": "session unrecoverable: "
+                                 + live.recovery_error}
                 if live.state == "sealed":
                     return 409, {"error": "session sealed",
                                  "cursor": live.journal.cursor}
@@ -318,6 +415,22 @@ class VerifierService:
             round(live.last_ingest - live.last_verdict_ts, 3))
         live.stream.emit("ingest", ops=n_lines, txns=txns,
                          cursor=jr.cursor)
+        # auto-compaction (ISSUE 13): once the on-disk journal outgrows
+        # the configured budget, checkpoint + truncate inline — the
+        # cost amortizes over the bytes that grew it, and a month-long
+        # session's journal stays bounded instead of monotone
+        cb = live.config.get("compact-bytes")
+        try:
+            cb = int(cb) if cb else 0
+        except (TypeError, ValueError):
+            cb = 0
+        if cb and jr.disk_bytes() >= cb:
+            try:
+                live.compact()
+            except Exception as e:  # noqa: BLE001 — compaction is an
+                # optimization; a failed one leaves the journal whole
+                logger.warning("verifier: auto-compact of %s failed: "
+                               "%s", live.name, e)
         live.persist()
         return 200, {"cursor": jr.cursor, "ops": n_lines, "txns": txns}
 
@@ -336,6 +449,9 @@ class VerifierService:
         return 503, {"error": "session expired mid-request; retry"}
 
     def _verdict_locked(self, live: _Live) -> Tuple[int, Dict[str, Any]]:
+        if live.recovery_error:
+            return 410, {"error": "session unrecoverable: "
+                         + live.recovery_error}
         t0 = time.perf_counter()
         try:
             res = live.session.verdict(deadline=live.deadline())
@@ -369,6 +485,9 @@ class VerifierService:
         return 503, {"error": "session expired mid-request; retry"}
 
     def _seal_locked(self, live: _Live) -> Tuple[int, Dict[str, Any]]:
+        if live.recovery_error:
+            return 410, {"error": "session unrecoverable: "
+                         + live.recovery_error}
         if live.state == "sealed":
             return 200, live.seal_result
         try:
@@ -389,6 +508,31 @@ class VerifierService:
         self._update_gauges()
         return 200, sealed
 
+    def compact(self, name: str) -> Tuple[int, Dict[str, Any]]:
+        """Explicit journal compaction for one live session (the
+        ``POST /verifier/<s>/compact`` verb); auto-compaction via the
+        ``compact-bytes`` config key covers the steady state."""
+        for _ in range(2):
+            try:
+                live = self._get(name)
+            except ValueError as e:
+                return 400, {"error": str(e)}
+            if live is None:
+                return 404, {"error": f"no such session {name!r}"}
+            with live.lock:
+                if live.dead:
+                    continue
+                if live.recovery_error:
+                    return 410, {"error": "session unrecoverable: "
+                                 + live.recovery_error}
+                try:
+                    out = live.compact()
+                except Exception as e:  # noqa: BLE001
+                    return 503, {"error": f"{type(e).__name__}: {e}"}
+                live.persist()
+                return 200, out
+        return 503, {"error": "session expired mid-request; retry"}
+
     def expire(self, name: str) -> Tuple[int, Dict[str, Any]]:
         """Drop a session from memory; journal + session.json stay on
         disk (a later touch recovers it by replay).  The retired
@@ -406,6 +550,151 @@ class VerifierService:
         self._drop_session_series(name)
         self._update_gauges()
         return 200, {"expired": name}
+
+    # -- retention / maintenance (ISSUE 13) ---------------------------------
+
+    def _archive(self, name: str) -> bool:
+        """Move a sealed session's dir under ``<root>/_archive/`` —
+        journal + checkpoint + snapshot intact, but out of the session
+        scans, the warehouse ingest, and the /metrics surfaces."""
+        src = self._dir(name)
+        if not os.path.isdir(src):
+            return False
+        adir = os.path.join(self.root, ARCHIVE_DIR)
+        os.makedirs(adir, exist_ok=True)
+        dst = os.path.join(adir, name)
+        if os.path.exists(dst):
+            dst = f"{dst}.{int(time.time() * 1000)}"
+        try:
+            os.replace(src, dst)
+        except OSError as e:
+            logger.warning("verifier: archive of %s failed: %s",
+                           name, e)
+            return False
+        return True
+
+    def gc(self, now: Optional[float] = None) -> Dict[str, int]:
+        """Retention pass: expire open sessions idle past
+        ``gc-idle-s`` (journal stays, a later touch recovers them) and
+        archive sealed sessions idle past ``archive-sealed-s`` —
+        including on-disk sealed sessions from before a restart.  Both
+        knobs come from the service default config (or per-session
+        config); unset means that policy is off.  Keeps the long-lived
+        daemon's RSS and /metrics cardinality bounded: expired/archived
+        sessions' per-session gauges are retired with them."""
+        now = time.time() if now is None else now
+        stats = {"expired": 0, "archived": 0}
+        idle_s = _as_float(self.default_config.get("gc-idle-s"))
+        arch_s = _as_float(self.default_config.get("archive-sealed-s"))
+        # no early-out on unset defaults: the per-session loop still
+        # runs, so a session that carried its own gc-idle-s /
+        # archive-sealed-s in its open config gets retention too
+        with self._lock:
+            items = list(self._live.items())
+        for name, live in items:
+            with live.lock:
+                if live.dead:
+                    continue
+                cfg_idle = _as_float(live.config.get("gc-idle-s"),
+                                     idle_s)
+                cfg_arch = _as_float(
+                    live.config.get("archive-sealed-s"), arch_s)
+                sealed = live.state == "sealed"
+                idle = live.idle_s(now)
+            if sealed and cfg_arch is not None and idle > cfg_arch:
+                # per-name lock across expire→archive: a concurrent
+                # touch can't recover the session from disk between
+                # the two steps and be left writing through a dir the
+                # rename just moved under _archive/
+                with self._nlock(name):
+                    self.expire(name)
+                    if self._archive(name):
+                        stats["archived"] += 1
+            elif not sealed and cfg_idle is not None \
+                    and idle > cfg_idle:
+                self.expire(name)
+                stats["expired"] += 1
+        # sealed sessions left on disk by an earlier process life.
+        # Not gated on the DEFAULT arch knob: a session that carried
+        # its own archive-sealed-s in its open config must still
+        # archive after a restart, when only its persisted meta knows
+        # the knob
+        with self._lock:
+            live_names = set(self._live)
+        for name, meta in scan_sessions(self.base):
+            if name in live_names:
+                continue
+            upd = meta.get("updated")
+            mcfg = meta.get("config") if isinstance(
+                meta.get("config"), dict) else {}
+            m_arch = _as_float(mcfg.get("archive-sealed-s"), arch_s)
+            if meta.get("state") == "sealed" \
+                    and isinstance(upd, (int, float)) \
+                    and m_arch is not None \
+                    and now - upd > m_arch:
+                with self._nlock(name):
+                    with self._lock:
+                        if name in self._live:  # recovered since
+                            continue            # the scan
+                    if self._archive(name):
+                        stats["archived"] += 1
+        self._journal_gauge()
+        return stats
+
+    def sweep_dirty(self) -> Dict[str, int]:
+        """One multi-tenant batched sweep over every dirty live
+        session (docs/VERIFIER.md): many sessions' dirty regions, ONE
+        ``ops.cycle_sweep`` dispatch — the per-session host sweep stops
+        being the scaling wall."""
+        from . import sweep as sweep_mod
+
+        with self._lock:
+            lives = list(self._live.values())
+        return sweep_mod.batched_sweep(lives)
+
+    def maintain(self) -> Dict[str, Any]:
+        """One maintenance tick: batched sweep + GC + gauge refresh.
+        Every part is best-effort — a failing tick never takes the
+        service down."""
+        out: Dict[str, Any] = {}
+        try:
+            out["sweep"] = self.sweep_dirty()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("verifier maintenance sweep failed: %s", e)
+            out["sweep-error"] = str(e)
+        try:
+            out["gc"] = self.gc()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("verifier maintenance gc failed: %s", e)
+            out["gc-error"] = str(e)
+        return out
+
+    def start_maintenance(self, interval_s: float = 5.0) -> None:
+        """Run :meth:`maintain` on a daemon thread every
+        ``interval_s`` — the production-service mode ``cli serve
+        --ingest`` enables."""
+        if self._maint is not None:
+            return
+        self._maint_stop.clear()
+
+        def loop() -> None:
+            while not self._maint_stop.wait(interval_s):
+                self.maintain()
+
+        self._maint = threading.Thread(
+            target=loop, daemon=True, name="verifier-maintenance")
+        self._maint.start()
+
+    def _journal_gauge(self) -> None:
+        """Aggregate on-disk journal bytes across live sessions — the
+        quantity compaction bounds (ISSUE 13 acceptance: bounded, not
+        monotone)."""
+        with self._lock:
+            lives = list(self._live.values())
+        total = 0
+        for live in lives:
+            total += live.journal.disk_bytes()
+        _registry().gauge("verifier-journal-bytes").set(total)
 
     # -- listings / metrics -------------------------------------------------
 
@@ -440,6 +729,10 @@ class VerifierService:
         _registry().gauge("verifier-sessions-active").set(active)
 
     def close(self) -> None:
+        if self._maint is not None:
+            self._maint_stop.set()
+            self._maint.join(timeout=5)
+            self._maint = None
         with self._lock:
             lives = list(self._live.values())
             self._live.clear()
@@ -450,9 +743,22 @@ class VerifierService:
                 live.close("service-stop")
 
 
+def _as_float(v: Any, default: Optional[float] = None
+              ) -> Optional[float]:
+    if v is None:
+        return default
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return default
+    return f if f > 0 else default
+
+
 def scan_sessions(base: str) -> List[Tuple[str, Dict[str, Any]]]:
     """On-disk session snapshots under ``<store>/verifier/`` — the
-    read-only listing the web pages use when no service is attached."""
+    read-only listing the web pages use when no service is attached.
+    Skips the ``_archive/`` retention subtree (and anything else
+    ``_``/``.``-prefixed — not session dirs)."""
     root = os.path.join(base, VERIFIER_DIR)
     out: List[Tuple[str, Dict[str, Any]]] = []
     try:
@@ -461,7 +767,7 @@ def scan_sessions(base: str) -> List[Tuple[str, Dict[str, Any]]]:
         return out
     for n in names:
         d = os.path.join(root, n)
-        if not os.path.isdir(d):
+        if not os.path.isdir(d) or n.startswith(("_", ".")):
             continue
         meta = read_meta(d)
         if meta is None:

@@ -236,6 +236,10 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             parsed = urlparse(self.path)
             path = unquote(parsed.path)
+            if path.startswith("/fleet/artifact/"):
+                return self._fleet_artifact(
+                    path[len("/fleet/artifact/"):].strip("/"),
+                    parsed.query or "")
             if path.startswith("/fleet/"):
                 return self._fleet_post(path[len("/fleet/"):].strip("/"))
             if self.verifier is None:
@@ -270,6 +274,8 @@ class _Handler(BaseHTTPRequestHandler):
                     code, doc = self.verifier.open(name, cfg)
                 elif verb == "seal":
                     code, doc = self.verifier.seal(name)
+                elif verb == "compact":
+                    code, doc = self.verifier.compact(name)
                 elif verb == "expire":
                     code, doc = self.verifier.expire(name)
                 else:
@@ -1187,6 +1193,34 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
                                        {"error": "body must be a dict"})
         code, out = fn(doc)
         self._send_json(code, out)
+
+    def _fleet_artifact(self, run_id: str, query: str):
+        """``POST /fleet/artifact/<run-id>`` — the store-federation
+        upload seam (docs/FLEET.md): chunked run-dir upload, resumable
+        by byte cursor, digest-verified, idempotent."""
+        if self.fleet is None:
+            return self._send_json(
+                404, {"error": "no fleet coordinator (start with "
+                      "`fleet serve <spec.json>`)"})
+        from urllib.parse import parse_qs
+
+        from .fleet.artifacts import MAX_ARTIFACT_BYTES
+
+        # cap BEFORE buffering the body: the protocol-level total
+        # check runs after the read, which would let one oversized
+        # POST balloon the coordinator's RSS past the artifact cap
+        try:
+            clen = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            clen = 0
+        if clen > MAX_ARTIFACT_BYTES:
+            return self._send_json(
+                413, {"error": "request body exceeds the artifact "
+                      "size cap"})
+        params = {k: v[0] for k, v in parse_qs(query).items()}
+        code, doc = self.fleet.artifact(run_id, params,
+                                        self._read_body())
+        self._send_json(code, doc)
 
     def _fleet_status(self):
         if self.fleet is None:

@@ -31,6 +31,15 @@ host-attributed) as a single-process ``run_campaign`` of the identical
 spec + seed, with every verdict attributable and every observed
 worker window tick synced to the coordinator's authoritative set.
 
+Then the **federation + live-check round** (ISSUE 13 acceptance): an
+append campaign live-streamed into the coordinator's verifier
+(``--ingest``) by workers on PRIVATE store bases uploading run dirs
+over the artifact endpoint (``--upload``) — no shared filesystem —
+under chaos widened to the upload/live seams plus one kill -9 each
+side; verdicts must equal the single-process stored-history run, every
+run dir must land on the coordinator, and every non-degraded live
+session must seal incremental == batch.
+
 Usage::
 
     python scripts/soak_fleet.py --fast      # tier-1 smoke (the
@@ -89,15 +98,21 @@ def wait_status(url, pred, deadline_s, what):
                        f"{json.dumps(last, indent=1, default=str)}")
 
 
-def spawn_coordinator(base, spec_path, port, lease, env):
+def spawn_coordinator(base, spec_path, port, lease, env, extra=()):
     return subprocess.Popen(
         [sys.executable, "-m", "jepsen_tpu", "--store-dir", base,
          "fleet", "serve", spec_path, "--port", str(port),
-         "--lease", str(lease)],
+         "--lease", str(lease), *extra],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
-def spawn_worker(base, url, name, seed, fault_p, env):
+#: the control-plane fault sites client-side chaos targets; the
+#: federation round widens this to the upload + live-check seams
+CHAOS_SITES = "fleet.claim|fleet.heartbeat|fleet.complete"
+
+
+def spawn_worker(base, url, name, seed, fault_p, env, extra=(),
+                 sites=CHAOS_SITES):
     wenv = dict(env)
     # client-side chaos: drops (transients the retry policy clears) and
     # stalls on the control-plane seams only — the workload itself
@@ -105,11 +120,11 @@ def spawn_worker(base, url, name, seed, fault_p, env):
     # reference run
     wenv["JEPSEN_FAULTS"] = (
         f"seed={seed},p={fault_p},kinds=oom|stall,stall_s=0.02,"
-        "sites=fleet.claim|fleet.heartbeat|fleet.complete")
+        f"sites={sites}")
     return subprocess.Popen(
         [sys.executable, "-m", "jepsen_tpu", "--store-dir", base,
          "fleet", "work", "--coordinator", url, "--name", name,
-         "--poll", "0.1"],
+         "--poll", "0.1", *extra],
         env=wenv, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
@@ -237,6 +252,195 @@ def coordinated_chaos_round(args, env) -> list:
         shutil.rmtree(ref_base, ignore_errors=True)
     else:
         print(f"coordinated chaos round FAILED (store: {base})",
+              file=sys.stderr)
+    return failures
+
+
+def federation_round(args, env) -> list:
+    """Live verification at fleet scale (ISSUE 13 acceptance): an
+    append campaign whose cells stream their interpreters into the
+    coordinator's verifier (``--ingest``) while the workers run on
+    PRIVATE store bases and upload run dirs over the artifact endpoint
+    (``--upload``) — no shared filesystem anywhere — under
+    control-plane chaos widened to the upload + live-check seams, plus
+    one kill -9 each side.  Accepts iff verdicts equal the
+    single-process stored-history run of the same spec, every landed
+    run dir is browsable on the coordinator, and every non-degraded
+    live session sealed incremental == batch."""
+    import tempfile as _tf
+
+    from jepsen_tpu import campaign
+    from jepsen_tpu.campaign import core as ccore
+    from jepsen_tpu.campaign.index import Index
+    from jepsen_tpu.campaign.plan import expand
+    from jepsen_tpu.verifier import scan_sessions
+
+    failures = []
+    cells, n_workers = 4, 2
+    port = free_port()
+    url = f"http://127.0.0.1:{port}"
+    spec = {
+        "name": "fedlive", "workloads": ["append"],
+        "seeds": list(range(cells)),
+        "opts": {"time-limit": None, "ops": 240, "concurrency": 3,
+                 "client-latency": 0.002,
+                 # the live stream must ride out the coordinator's
+                 # kill -9 + restart window: generous outage budget
+                 "live-check": {"url": url, "budget-s": 20.0,
+                                "timeout-s": 3.0}},
+    }
+    cbase = _tf.mkdtemp(prefix="fleet-fed-")
+    wbases = {f"fw{i}": _tf.mkdtemp(prefix=f"fleet-fed-w{i}-")
+              for i in range(n_workers + 1)}  # +1 replacement
+    spec_path = os.path.join(cbase, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    sites = CHAOS_SITES + "|fleet.artifact|verifier.live"
+    lease = max(args.lease, 4.0)
+    coord = spawn_coordinator(cbase, spec_path, port, lease, env,
+                              extra=("--ingest",))
+    workers = {}
+    killed = []
+    try:
+        wait_status(url, lambda s: True, 60,
+                    "federation coordinator up")
+        for i in range(n_workers):
+            name = f"fw{i}"
+            workers[name] = spawn_worker(
+                wbases[name], url, name, args.seed * 77 + i,
+                args.fault_p, env, extra=("--upload",), sites=sites)
+
+        # kill -9 one worker while it holds a lease (its private base
+        # dies with it; the cell requeues and re-executes elsewhere)
+        def holding(s):
+            alive = [w for w, p in workers.items() if p.poll() is None]
+            for one in s.get("leases") or []:
+                if one["worker"] in alive:
+                    return one["worker"]
+            return None
+
+        s = wait_status(url, holding, 60,
+                        "a federation worker holding a lease")
+        victim = holding(s)
+        workers[victim].send_signal(signal.SIGKILL)
+        workers[victim].wait()
+        killed.append(victim)
+        print(f"federation: killed -9 worker {victim} mid-lease "
+              "(its run dirs die with its private base)")
+        sub = f"fw{n_workers}"
+        workers[sub] = spawn_worker(
+            wbases[sub], url, sub, args.seed * 77 + 50, args.fault_p,
+            env, extra=("--upload",), sites=sites)
+
+        # kill -9 the coordinator once something landed; uploads and
+        # live streams in flight resume against the restarted process
+        wait_status(url, lambda s: s["done"] >= 1, 120,
+                    "a federation cell done before coordinator kill")
+        coord.send_signal(signal.SIGKILL)
+        coord.wait()
+        print("federation: killed -9 coordinator mid-campaign "
+              "(mid-upload partials + live sessions must resume)")
+        time.sleep(0.5)
+        coord = spawn_coordinator(cbase, spec_path, port, lease, env,
+                                  extra=("--ingest",))
+        final = wait_status(url, lambda s: s["finished"], 300,
+                            "federation campaign finished")
+        print(f"federation campaign finished: {final['done']}/"
+              f"{final['total']} cells, "
+              f"{final['counts']['requeues']} requeues")
+        for w, p in workers.items():
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.terminate()
+    finally:
+        for p in list(workers.values()) + [coord]:
+            if p.poll() is None:
+                p.kill()
+
+    idx = Index(ccore.index_path("fedlive", cbase))
+    got = idx.latest_by_run()
+    spec_ids = {rs.run_id for rs in expand(spec)}
+    if set(got) != spec_ids:
+        failures.append(
+            f"federation: {len(spec_ids - set(got))} cell(s) lost, "
+            f"{len(set(got) - spec_ids)} unknown")
+    # every landed record's run dir must be browsable on the
+    # COORDINATOR's store — the workers' private bases are gone as far
+    # as this process is concerned
+    live_stats = {"ok": 0, "degraded": 0, "missing-dir": 0}
+    for rec in got.values():
+        rel = rec.get("dir")
+        d = os.path.join(cbase, rel) if rel else None
+        if not (d and os.path.isdir(d)):
+            live_stats["missing-dir"] += 1
+            failures.append(
+                f"federation: run dir for {rec.get('run')} never "
+                f"landed on the coordinator store ({rel})")
+            continue
+        try:
+            with open(os.path.join(d, "results.json")) as f:
+                res = json.load(f)
+        except (OSError, ValueError) as e:
+            failures.append(f"federation: unreadable results.json in "
+                            f"landed dir {rel}: {e}")
+            continue
+        lc = res.get("live-check") or {}
+        state = lc.get("state")
+        if state == "ok":
+            live_stats["ok"] += 1
+            if (lc.get("seal") or {}).get("equal") is not True:
+                failures.append(
+                    f"federation: live session {lc.get('session')} "
+                    f"sealed UNEQUAL to batch: {lc.get('seal')}")
+        elif state == "degraded":
+            live_stats["degraded"] += 1  # allowed: stored-history
+            # verdicts stand alone, equality asserted below
+        else:
+            failures.append(f"federation: cell {rec.get('run')} "
+                            f"carries no live-check stamp ({state})")
+    if live_stats["ok"] == 0:
+        failures.append("federation: every live session degraded — "
+                        "live checking never actually ran")
+    sealed = [m for _n, m in scan_sessions(cbase)
+              if m.get("state") == "sealed"]
+    bad_seals = [m["session"] for m in sealed
+                 if (m.get("seal") or {}).get("equal") is not True]
+    if len(sealed) < live_stats["ok"]:
+        failures.append(
+            f"federation: {live_stats['ok']} ok live stamps but only "
+            f"{len(sealed)} sealed sessions on the coordinator")
+    if bad_seals:
+        failures.append(f"federation: sealed sessions unequal to "
+                        f"batch: {bad_seals}")
+    # stored-history authority: fleet+live verdicts == single-process
+    # WITHOUT live checking, key for key
+    ref_spec = json.loads(json.dumps(spec))
+    del ref_spec["opts"]["live-check"]
+    ref_base = _tf.mkdtemp(prefix="fleet-fed-ref-")
+    ref = campaign.run_campaign(ref_spec, ref_base, workers=2)
+    ref_verdicts = {r["key"]: r["valid?"] for r in ref["rows"]}
+    got_verdicts = {r["key"]: r["valid?"] for r in got.values()}
+    if got_verdicts != ref_verdicts:
+        diff = {k: (got_verdicts.get(k), ref_verdicts.get(k))
+                for k in set(got_verdicts) | set(ref_verdicts)
+                if got_verdicts.get(k) != ref_verdicts.get(k)}
+        failures.append(f"federation: live-checked fleet != "
+                        f"single-process stored-history: {diff}")
+    if not failures:
+        print(f"federation round OK: {cells} live-checked cells over "
+              f"{n_workers} workers on private bases (no shared "
+              f"filesystem), worker + coordinator kill -9 — "
+              f"{live_stats['ok']} live sessions sealed incremental "
+              f"== batch ({live_stats['degraded']} degraded to "
+              f"stored-history), every run dir landed on the "
+              f"coordinator, verdicts == single-process")
+        shutil.rmtree(cbase, ignore_errors=True)
+        shutil.rmtree(ref_base, ignore_errors=True)
+        for d in wbases.values():
+            shutil.rmtree(d, ignore_errors=True)
+    else:
+        print(f"federation round FAILED (coordinator store: {cbase})",
               file=sys.stderr)
     return failures
 
@@ -418,6 +622,9 @@ def main():
     # -- the coordinated-chaos round (ISSUE 11 acceptance) ------------
     failures += coordinated_chaos_round(args, env)
 
+    # -- the federation + live-check round (ISSUE 13 acceptance) ------
+    failures += federation_round(args, env)
+
     wall = time.time() - t0
     if failures:
         for f in failures:
@@ -428,8 +635,9 @@ def main():
     print(f"fleet soak OK: {args.cells} cells x {args.workers} workers "
           f"under chaos (worker kill -9, coordinator kill -9 + "
           f"restart{', zombie freeze' if zombie else ''}) + a "
-          f"coordinated nemesis-schedule round — exactly one verdict "
-          f"per cell, distributed == single-process, in {wall:.1f}s")
+          f"coordinated nemesis-schedule round + a store-federation "
+          f"live-checking round — exactly one verdict per cell, "
+          f"distributed == single-process, in {wall:.1f}s")
     if args.store is None:
         shutil.rmtree(base, ignore_errors=True)
         shutil.rmtree(ref_base, ignore_errors=True)

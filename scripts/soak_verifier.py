@@ -1,28 +1,41 @@
 #!/usr/bin/env python
-"""Verifier soak: N concurrent clients x M segments under ingest chaos.
+"""Verifier soak: concurrent session waves under chaos, with
+/metrics-cited saturation curves (ISSUE 7, grown by ISSUE 13).
 
-ISSUE 7 satellite.  Spins up one in-process `VerifierService`, then N
-client threads each stream M history segments into their own session
-with a seeded `FaultPlan` firing synthetic transients (and stalls) on
-the guarded ``verifier.ingest`` / ``verifier.sweep`` seams.  Clients
-speak the real cursor protocol — a 503 (persistent injected fault
-after retries) is retried from the last acked cursor, which must be
-idempotent.  At the end every session is sealed and the run FAILS
-unless every seal reports ``incremental == batch``.
+Spins up one in-process `VerifierService` in production shape —
+maintenance thread (multi-tenant batched sweeps + GC), journal
+auto-compaction, sealed-session archival — then drives it with WAVES of
+concurrent client threads (a saturation curve: each wave doubles the
+session count).  Every client streams segments over the real cursor
+protocol while a seeded `FaultPlan` fires transients/stalls on the
+guarded ``verifier.ingest`` / ``verifier.sweep`` / ``verifier.seal``
+seams; clients also poll rolling verdicts mid-stream so
+verdict-freshness is a live quantity, and every session seals
+``incremental == batch`` at the end.
+
+Per wave, the soak samples the Prometheus exposition (the SAME text a
+scraper would see) and reports: sessions active, ingest ops/s,
+verdict-freshness p95, journal bytes.  The payload prints as one
+BENCH-shaped JSON line (ingestable via ``cli obs ingest --bench``).
+
+The run FAILS unless every session sealed equal, at least one
+compaction cycle ran (bounding journal bytes), and sealed sessions were
+archived (bounding /metrics series count).
 
 Usage::
 
-    python scripts/soak_verifier.py --fast          # tier-1 smoke
-    python scripts/soak_verifier.py                 # default soak
-    python scripts/soak_verifier.py --clients 8 --segments 20 \\
-        --txns 400 --fault-p 0.1 --seed 3           # the long one
+    python scripts/soak_verifier.py --fast           # tier-1 smoke
+    python scripts/soak_verifier.py                  # default soak
+    python scripts/soak_verifier.py --sessions 200 --txns 300 \\
+        --fault-p 0.05 --seed 3                      # the long one
 
-Exit 0 iff every session sealed equal.
+Exit 0 iff the acceptance holds.
 """
 
 import argparse
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -30,15 +43,18 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from jepsen_tpu import telemetry  # noqa: E402
 from jepsen_tpu.resilience import faults  # noqa: E402
+from jepsen_tpu.telemetry import prometheus  # noqa: E402
 from jepsen_tpu.verifier import VerifierService  # noqa: E402
 from jepsen_tpu.workloads import synth  # noqa: E402
 
 
-def client(svc, name, segments, txns, seed, inject, errors, stats):
+def client(svc, name, segments, txns, seed, inject, errors, stats,
+           verdict_every=0):
     """One streaming client: generate a history, chop it into
     line-boundary-agnostic byte segments, push them with cursor
-    resume, then seal."""
+    resume (polling the rolling verdict along the way), then seal."""
     h = synth.la_history(n_txns=txns, n_keys=6, concurrency=5,
                          seed=seed, fail_prob=0.05, info_prob=0.05)
     if inject:
@@ -48,6 +64,7 @@ def client(svc, name, segments, txns, seed, inject, errors, stats):
     seg_bytes = max(64, len(body) // segments)
     cur = 0
     retries = 0
+    sent_segs = 0
     while cur < len(body):
         # deliberately NOT line-aligned: the server acks only complete
         # lines and the client always resends from the acked cursor
@@ -68,6 +85,11 @@ def client(svc, name, segments, txns, seed, inject, errors, stats):
             # loop — only possible with absurdly tiny seg_bytes
             seg_bytes *= 2
         cur = max(cur, r["cursor"])
+        sent_segs += 1
+        if verdict_every and sent_segs % verdict_every == 0:
+            code, _v = svc.verdict(name)  # rolling verdict keeps the
+            # freshness gauge live; 503s here are chaos, ignored
+
     def retrying(fn, what):
         # 503 = a persistent injected fault survived the guard's own
         # retries; the chaos targets verifier.sweep/seal too, so the
@@ -96,65 +118,219 @@ def client(svc, name, segments, txns, seed, inject, errors, stats):
                   "retries-503": retries})
 
 
+# ---------------------------------------------------------------- metrics
+
+def scrape(reg):
+    """Parse the Prometheus exposition text into {name: value} and
+    {name: [labeled values]} — the saturation numbers are CITED from
+    the same surface a scraper reads, not from internals."""
+    text = prometheus.render_registry(reg)
+    flat, labeled = {}, {}
+    pat = re.compile(r"^(\w+)(\{[^}]*\})? (\S+)$")
+    for line in text:
+        if line.startswith("#"):
+            continue
+        m = pat.match(line)
+        if not m:
+            continue
+        name, labels, val = m.groups()
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        if labels:
+            labeled.setdefault(name, []).append(v)
+        else:
+            flat[name] = v
+    return flat, labeled
+
+
+def p95(vals):
+    if not vals:
+        return None
+    vs = sorted(vals)
+    return round(vs[min(len(vs) - 1, int(0.95 * (len(vs) - 1)))], 3)
+
+
+def run_wave(svc, n_sessions, args, wave_idx, errors, stats):
+    """One saturation-curve point: n_sessions concurrent clients,
+    metrics sampled from the exposition before/after."""
+    reg = telemetry.registry()
+    flat0, _ = scrape(reg)
+    ing0 = flat0.get("jepsen_verifier_ops_ingested_total", 0.0)
+    t0 = time.time()
+    injectors = [None, "inject_wr_cycle", "inject_g1a",
+                 "inject_rw_cycle"]
+    peak_fresh = []
+    stop_sample = threading.Event()
+
+    def sampler():
+        while not stop_sample.wait(0.2):
+            _f, lab = scrape(reg)
+            fr = lab.get("jepsen_verifier_verdict_freshness_s")
+            if fr:
+                peak_fresh.append(p95(fr))
+
+    st = threading.Thread(target=sampler, daemon=True)
+    st.start()
+    threads = [
+        threading.Thread(
+            target=client,
+            args=(svc, f"soak-w{wave_idx}-{i}", args.segments,
+                  args.txns, args.seed * 1000 + wave_idx * 100 + i,
+                  injectors[i % len(injectors)], errors, stats),
+            kwargs={"verdict_every": max(2, args.segments // 2)})
+        for i in range(n_sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop_sample.set()
+    st.join(timeout=2)
+    wall = time.time() - t0
+    # a wave can finish inside one maintenance interval: refresh the
+    # journal gauge so the curve row cites a real byte count
+    svc._journal_gauge()
+    flat1, lab1 = scrape(reg)
+    ing1 = flat1.get("jepsen_verifier_ops_ingested_total", 0.0)
+    return {
+        "sessions": n_sessions,
+        "wall_s": round(wall, 3),
+        "ingest_ops_s": round((ing1 - ing0) / max(wall, 1e-9), 1),
+        "verdict_freshness_p95_s": p95([v for v in peak_fresh
+                                        if v is not None]) or 0.0,
+        "journal_bytes": flat1.get("jepsen_verifier_journal_bytes"),
+        "sessions_active_peak": flat1.get(
+            "jepsen_verifier_sessions_active"),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--sessions", "--clients", type=int, default=24,
+                    dest="sessions",
+                    help="peak concurrent sessions (the last wave)")
     ap.add_argument("--segments", type=int, default=8)
     ap.add_argument("--txns", type=int, default=200)
     ap.add_argument("--fault-p", type=float, default=0.05)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--compact-bytes", type=int, default=16384,
+                    help="per-session journal budget before "
+                         "auto-compaction")
     ap.add_argument("--store", default=None,
                     help="store dir (default: a temp dir)")
+    ap.add_argument("--bench-out", default=None,
+                    help="also write the BENCH payload to this path")
     ap.add_argument("--fast", action="store_true",
-                    help="tier-1 smoke: 2 clients x 3 segments x 80 "
-                         "txns")
+                    help="tier-1 smoke: waves of 2+4 sessions x 4 "
+                         "segments x 80 txns")
     args = ap.parse_args()
     if args.fast:
-        args.clients, args.segments, args.txns = 2, 4, 80
-        args.fault_p = max(args.fault_p, 0.35)  # few calls: make chaos land
+        args.sessions, args.segments, args.txns = 4, 4, 80
+        args.fault_p = max(args.fault_p, 0.3)  # few calls: chaos lands
     base = args.store
     if base is None:
         import tempfile
 
         base = tempfile.mkdtemp(prefix="verifier-soak-")
-    svc = VerifierService(base)
+    svc = VerifierService(base, default_config={
+        "compact-bytes": args.compact_bytes,
+        # retention: sealed sessions archive promptly so the /metrics
+        # series count is bounded across waves, open-but-abandoned
+        # sessions expire
+        "archive-sealed-s": 0.5,
+        "gc-idle-s": 120.0,
+    })
+    svc.start_maintenance(interval_s=0.3)
     plan = faults.FaultPlan(
         seed=args.seed, p=args.fault_p,
         kinds=("oom", "xla", "stall"), stall_s=0.01,
         sites=("verifier.ingest", "verifier.sweep", "verifier.seal"))
-    injectors = [None, "inject_wr_cycle", "inject_g1a",
-                 "inject_rw_cycle"]
     errors, stats = [], []
+    # the saturation curve: doubling waves up to --sessions
+    waves = []
+    n = max(2, args.sessions // 4)
+    while n < args.sessions:
+        waves.append(n)
+        n *= 2
+    waves.append(args.sessions)
     t0 = time.time()
+    curve = []
+    reg = telemetry.registry()
+    series0 = len(scrape(reg)[1].get(
+        "jepsen_verifier_verdict_freshness_s", []))
     with faults.use(plan):
-        threads = [
-            threading.Thread(
-                target=client,
-                args=(svc, f"soak-{i}", args.segments, args.txns,
-                      args.seed * 1000 + i,
-                      injectors[i % len(injectors)], errors, stats))
-            for i in range(args.clients)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        for wi, n_sessions in enumerate(waves):
+            curve.append(run_wave(svc, n_sessions, args, wi, errors,
+                                  stats))
+            print(f"wave {wi}: {json.dumps(curve[-1])}", flush=True)
+    # let the maintenance loop archive the sealed sessions
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        flat, lab = scrape(reg)
+        series_now = len(lab.get("jepsen_verifier_verdict_freshness_s",
+                                 []))
+        if series_now == 0 and \
+                flat.get("jepsen_verifier_sessions_active", 1) == 0:
+            break
+        time.sleep(0.3)
+    flat, lab = scrape(reg)
     svc.close()
     wall = time.time() - t0
-    for s in sorted(stats, key=lambda s: s["session"]):
+    total = sum(w for w in waves) * args.txns
+    n_compactions = int(flat.get("jepsen_verifier_compactions_total",
+                                 0))
+    series_final = len(lab.get("jepsen_verifier_verdict_freshness_s",
+                               []))
+    journal_final = flat.get("jepsen_verifier_journal_bytes", 0)
+
+    for s in sorted(stats, key=lambda s: s["session"])[:8]:
         print(f"  {s['session']}: {s['txns']} txns valid?="
               f"{s['valid?']} anomalies={s['anomalies']} "
               f"503-retries={s['retries-503']}")
     print(f"faults injected: {len(plan.injected)} over "
-          f"{plan._n_calls} guarded calls")
-    if errors or len(stats) != args.clients:
+          f"{plan._n_calls} guarded calls; {n_compactions} journal "
+          f"compactions; freshness series {series0} -> {series_final} "
+          f"(retired on seal/archive); journal bytes now "
+          f"{journal_final}")
+    want = sum(waves)
+    if errors or len(stats) != want:
         for e in errors:
             print(f"FAIL: {e}", file=sys.stderr)
-        print(f"soak FAILED ({len(stats)}/{args.clients} sealed) "
+        print(f"soak FAILED ({len(stats)}/{want} sealed) "
               f"in {wall:.1f}s", file=sys.stderr)
         return 1
-    print(f"soak OK: {args.clients} clients x {args.segments} segments "
-          f"x {args.txns} txns, every session sealed incremental == "
-          f"batch, in {wall:.1f}s")
+    if args.compact_bytes and n_compactions == 0 and \
+            args.txns * 60 > args.compact_bytes:
+        print("FAIL: no compaction cycle ran (journal growth "
+              "unbounded)", file=sys.stderr)
+        return 1
+    if series_final != 0:
+        print(f"FAIL: {series_final} per-session freshness series "
+              "survived archival (metrics cardinality leak)",
+              file=sys.stderr)
+        return 1
+    payload = {
+        "metric": "verifier-soak-ingest",
+        "value": max(w["ingest_ops_s"] for w in curve),
+        "unit": "ops/s",
+        "n_txns": total,
+        "backend": "cpu",
+        "sessions_peak": args.sessions,
+        "wall_s": round(wall, 3),
+        "compactions": n_compactions,
+        "saturation": curve,
+        "verdict_freshness_p95_s": max(
+            w["verdict_freshness_p95_s"] for w in curve),
+    }
+    print("BENCH " + json.dumps(payload))
+    if args.bench_out:
+        with open(args.bench_out, "w") as f:
+            json.dump(payload, f, indent=1)
+    print(f"soak OK: waves {waves} x {args.segments} segments x "
+          f"{args.txns} txns under chaos — every session sealed "
+          f"incremental == batch, journals compacted, series retired, "
+          f"in {wall:.1f}s")
     return 0
 
 

@@ -691,3 +691,49 @@ def test_perf_gate_over_checker_spans_two_generations(tmp_path):
                                    "perfgate", "--span", span,
                                    "--min-runs", "3"])
         assert rc == 1, (span, rc)
+
+
+def test_perf_gate_applies_to_live_verifier_sweep_span(tmp_path):
+    """ISSUE 13 satellite: live-checked cells (in-proc verifier) land
+    their ``verifier.sweep`` spans in the run records, so `cli obs
+    gate` regression-gates the batched sweep path exactly like a
+    checker span — rc 0/1 on real data (never 2/inapplicable), rc 1
+    deterministically on a synthesized +60% generation."""
+    import time as _time
+
+    base = str(tmp_path)
+    spec = {
+        "name": "sweepgate", "workloads": ["append"],
+        "seeds": [0, 1, 2],
+        "opts": {"telemetry": True, "ops": 100, "time-limit": None,
+                 "concurrency": 2, "live-check": {"inproc": True}},
+    }
+    s1 = campaign.run_campaign(spec, base, workers=2)
+    assert s1["counts"].get("true") == 3
+    _time.sleep(1.1)  # generations are second-resolution timestamps
+    s2 = campaign.run_campaign(spec, base, workers=2, rerun=True)
+    assert s2["counts"].get("true") == 3
+
+    disp = cli.single_test_cmd(lambda o: {})
+    argv = ["--store-dir", base]
+    assert cli.run(disp, argv + ["obs", "ingest"]) == 0
+    rc = cli.run(disp, argv + ["obs", "gate", "--campaign",
+                               "sweepgate", "--span", "verifier.sweep",
+                               "--min-runs", "3"])
+    assert rc in (0, 1), rc
+    idx = Index(ccore.index_path("sweepgate", base))
+    assert all("verifier.sweep" in (r.get("spans") or {})
+               for r in idx.records)
+    last_gen = idx.records[-1]["gen"]
+    slow = [dict(r) for r in idx.records if r.get("gen") == last_gen]
+    for i, r in enumerate(slow):
+        r["run"] = f"slow-{i}"
+        r["gen"] = "zslow"
+        r["spans"] = {k: round(v * 1.6, 6)
+                      for k, v in (r.get("spans") or {}).items()}
+        idx.append(r)
+    assert cli.run(disp, argv + ["obs", "ingest"]) == 0
+    rc = cli.run(disp, argv + ["obs", "gate", "--campaign",
+                               "sweepgate", "--span", "verifier.sweep",
+                               "--min-runs", "3"])
+    assert rc == 1, rc

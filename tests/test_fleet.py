@@ -855,3 +855,339 @@ def test_fleet_soak_fast_chaos_acceptance():
     assert "killed -9 coordinator" in proc.stdout
     assert "coordinated chaos OK" in proc.stdout
     assert "witness windows match single-process" in proc.stdout
+    # the ISSUE 13 federation + live-check round rode along
+    assert "federation round OK" in proc.stdout
+    assert "no shared " in proc.stdout
+
+
+# ------------------------- store federation: artifact uploads (ISSUE 13)
+
+def _make_run_dir(root, name="a-test", ts="t1", extra=0):
+    d = os.path.join(root, name, ts)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "results.json"), "w") as f:
+        json.dump({"valid?": True, "n": extra}, f)
+    with open(os.path.join(d, "history.jsonl"), "w") as f:
+        for i in range(50 + extra):
+            f.write(json.dumps({"type": "ok", "i": i}) + "\n")
+    sub = os.path.join(d, "telemetry")
+    os.makedirs(sub, exist_ok=True)
+    with open(os.path.join(sub, "spans.json"), "w") as f:
+        f.write("{}")
+    return d, f"{name}/{ts}"
+
+
+def _tree(d):
+    out = {}
+    for root, _dirs, files in os.walk(d):
+        for fn in files:
+            p = os.path.join(root, fn)
+            with open(p, "rb") as f:
+                out[os.path.relpath(p, d)] = f.read()
+    return out
+
+
+def test_artifact_store_chunked_resumable_idempotent(tmp_path):
+    """The upload protocol: probe -> cursor, gap -> 409 carrying the
+    cursor, resend overlap skipped, digest-verified atomic landing,
+    re-upload of a landed run acked ``already``."""
+    from jepsen_tpu.fleet.artifacts import ArtifactStore, pack_run_dir
+
+    wbase, cbase = str(tmp_path / "worker"), str(tmp_path / "coord")
+    src, rel = _make_run_dir(wbase)
+    data, digest = pack_run_dir(src)
+    st = ArtifactStore(cbase)
+    # probe on an unknown run: nothing received, nothing landed
+    code, r = st.handle("r1", {}, b"")
+    assert (code, r) == (200, {"received": 0, "landed": False})
+    p = {"total": len(data), "digest": digest, "rel": rel}
+    # a gap is a 409 carrying the resume cursor
+    code, r = st.handle("r1", dict(p, offset=100), data[100:200])
+    assert code == 409 and r["received"] == 0
+    # chunks land in order; a resend below the cursor overlap-skips
+    code, r = st.handle("r1", dict(p, offset=0), data[:200])
+    assert code == 200 and r["received"] == 200
+    code, r = st.handle("r1", dict(p, offset=100), data[100:300])
+    assert code == 200 and r["received"] == 300
+    # kill -9 the "coordinator": a fresh ArtifactStore resumes from
+    # the fsync'd partial
+    st2 = ArtifactStore(cbase)
+    code, r = st2.handle("r1", {}, b"")
+    assert code == 200 and r == {"received": 300, "landed": False,
+                                 "rel": rel}
+    code, r = st2.handle("r1", dict(p, offset=300), data[300:])
+    assert code == 200 and r["landed"] is True
+    final = os.path.join(cbase, rel)
+    assert _tree(final) == _tree(src)  # digest-equal landing
+    # landing is idempotent: the staging partial is gone, a re-upload
+    # (a zombie worker's late attempt) is acked without rewriting
+    assert not os.path.exists(os.path.join(
+        cbase, "fleet", "staging", "r1.tar"))
+    code, r = st2.handle("r1", dict(p, offset=0), data[:200])
+    assert code == 200 and r.get("already") is True
+    # the landed dir is an ordinary store run dir
+    assert os.path.join(cbase, rel) in store.tests(base=cbase)
+
+
+def test_artifact_digest_mismatch_and_new_upload_discard(tmp_path):
+    """A digest mismatch at landing discards the partial (client
+    restarts from 0); a NEW upload of the same run id with a different
+    digest (the re-executed cell after a worker kill -9 mid-upload)
+    drops the stale partial instead of corrupting the tar."""
+    from jepsen_tpu.fleet.artifacts import ArtifactStore, pack_run_dir
+
+    wbase, cbase = str(tmp_path / "w"), str(tmp_path / "c")
+    src, rel = _make_run_dir(wbase)
+    data, digest = pack_run_dir(src)
+    st = ArtifactStore(cbase)
+    # whole body declared under a WRONG digest: discarded at landing
+    p_bad = {"total": len(data), "digest": "0" * 64, "rel": rel}
+    code, r = st.handle("r2", dict(p_bad, offset=0), data)
+    assert code == 409 and "digest" in r["error"] and r["received"] == 0
+    # stale partial from a dead worker's attempt (different content):
+    src2, _ = _make_run_dir(str(tmp_path / "w2"), extra=7)
+    data2, digest2 = pack_run_dir(src2)
+    p_old = {"total": len(data2), "digest": digest2, "rel": rel}
+    code, r = st.handle("r2", dict(p_old, offset=0), data2[:100])
+    assert code == 200 and r["received"] == 100
+    # ... the re-executed cell uploads the REAL artifact: the store
+    # notices total/digest changed and restarts clean
+    p_new = {"total": len(data), "digest": digest, "rel": rel}
+    code, r = st.handle("r2", dict(p_new, offset=0), data)
+    assert code == 200 and r["landed"] is True
+    assert _tree(os.path.join(cbase, rel)) == _tree(src)
+
+
+def test_artifact_reexecution_new_rel_lands_too(tmp_path):
+    """Landing is at-most-once per run DIR, not per run id: a
+    lease-lapse re-execution mints a new wall-clock timestamp, so its
+    upload of the same run id under a different ``rel`` must drop the
+    stale landed marker and land the new dir too — otherwise the
+    re-executor's verdict record points at a path that never arrives.
+    The resume probe answers the staged ``rel`` so a client can tell
+    whose partial/marker it is resuming."""
+    from jepsen_tpu.fleet.artifacts import ArtifactStore, pack_run_dir
+
+    wbase, cbase = str(tmp_path / "w"), str(tmp_path / "c")
+    src, rel = _make_run_dir(wbase, ts="t1")
+    data, digest = pack_run_dir(src)
+    st = ArtifactStore(cbase)
+    p = {"total": len(data), "digest": digest, "rel": rel}
+    code, r = st.handle("r1", dict(p, offset=0), data)
+    assert code == 200 and r["landed"] is True
+    # probe for the SAME dir: landed, carrying the rel
+    code, r = st.handle("r1", {}, b"")
+    assert code == 200 and r["landed"] is True and r["rel"] == rel
+    # re-execution: same run id, new timestamp dir
+    src2, rel2 = _make_run_dir(wbase, ts="t2", extra=3)
+    data2, digest2 = pack_run_dir(src2)
+    p2 = {"total": len(data2), "digest": digest2, "rel": rel2}
+    code, r = st.handle("r1", dict(p2, offset=0), data2)
+    assert code == 200 and r["landed"] is True and "already" not in r
+    assert _tree(os.path.join(cbase, rel)) == _tree(src)
+    assert _tree(os.path.join(cbase, rel2)) == _tree(src2)
+    # a LATE duplicate of the first dir still acks already (its run
+    # dir exists — _land's at-most-once path)
+    code, r = st.handle("r1", dict(p, offset=0), data)
+    assert code == 200 and r.get("already") is True
+
+
+def test_artifact_rejects_traversal_and_reserved_subtrees(tmp_path):
+    from jepsen_tpu.fleet.artifacts import ArtifactStore
+
+    st = ArtifactStore(str(tmp_path))
+    base_p = {"offset": 0, "total": 10, "digest": "d" * 64}
+    for rel in ("../evil/t", "a/../../b", "a", "a/b/c", ".hide/t",
+                "a/.incoming-t", "campaigns/t", "verifier/t",
+                "fleet/t"):
+        code, r = st.handle("r3", dict(base_p, rel=rel), b"x" * 10)
+        assert code == 400, rel
+    code, _r = st.handle("../run", {}, b"")
+    assert code == 400
+
+
+def test_artifact_refuses_hostile_tar_members(tmp_path):
+    """A digest-valid tar whose members escape the run dir (absolute
+    or ``..`` paths, links) must be refused at landing, leaving no
+    partial and no stray files."""
+    import hashlib
+    import io
+    import tarfile
+
+    from jepsen_tpu.fleet.artifacts import ArtifactStore
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        info = tarfile.TarInfo("../escape.txt")
+        payload = b"pwned"
+        info.size = len(payload)
+        tf.addfile(info, io.BytesIO(payload))
+    evil = buf.getvalue()
+    st = ArtifactStore(str(tmp_path))
+    p = {"offset": 0, "total": len(evil),
+         "digest": hashlib.sha256(evil).hexdigest(), "rel": "a-test/t9"}
+    code, r = st.handle("r4", p, evil)
+    assert code == 409 and "unpack" in r["error"]
+    assert not os.path.exists(os.path.join(str(tmp_path), "escape.txt"))
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "a-test", "t9"))
+
+
+def test_store_tests_skips_upload_staging_dirs(tmp_path):
+    """ISSUE 13 satellite: dot-prefixed dirs are in-flight atomic-
+    landing staging — `store.tests` (and the warehouse ingest riding
+    on it) must not read them as run dirs."""
+    base = str(tmp_path)
+    _make_run_dir(base, "a-test", "t1")
+    staged, _ = _make_run_dir(base, "a-test", ".incoming-t2")
+    assert [os.path.basename(d) for d in store.tests(base=base)] == \
+        ["t1"]
+    # ... and the warehouse ingest sees exactly the landed run
+    from jepsen_tpu.telemetry import warehouse
+
+    wh = warehouse.Warehouse(os.path.join(base, "w.sqlite"))
+    try:
+        wh.ingest_store(base)
+        _cols, rows = wh.query("SELECT dir FROM runs")
+    finally:
+        wh.close()
+    assert len(rows) == 1 and ".incoming" not in rows[0][0]
+
+
+_ARTIFACT_SERVER = """\
+import json, sys
+from jepsen_tpu import web
+from jepsen_tpu.fleet import FleetCoordinator
+base, port = sys.argv[1], int(sys.argv[2])
+spec = {"name": "fed", "workloads": ["noop"], "seeds": [0],
+        "opts": {"time-limit": 0.05}}
+coord = FleetCoordinator(spec, base, lease_s=5.0)
+web.serve(port=port, base=base, fleet=coord)
+"""
+
+
+def test_kill9_coordinator_mid_upload_resumable_then_lands(tmp_path):
+    """THE federation crash pin: kill -9 the coordinator mid-upload;
+    the staged partial survives, the restarted coordinator's probe
+    answers the durable cursor, the worker's client resumes from it,
+    and the landed dir is byte-equal to the source — never torn."""
+    import signal
+
+    from jepsen_tpu.fleet.artifacts import pack_run_dir
+
+    cbase = str(tmp_path / "coord")
+    wbase = str(tmp_path / "worker")
+    os.makedirs(cbase)
+    src, rel = _make_run_dir(wbase, extra=400)  # a few chunks' worth
+    data, digest = pack_run_dir(src)
+
+    def spawn(port):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _ARTIFACT_SERVER, cbase, str(port)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                _get(f"http://127.0.0.1:{port}", "/fleet/status",
+                     timeout=2)
+                return proc
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        raise AssertionError("artifact server did not come up")
+
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    url = f"http://127.0.0.1:{port}"
+    proc = spawn(port)
+    chunk = 1024
+    sent = 0
+    try:
+        # stream a strict prefix, then SIGKILL the server mid-upload
+        while sent < min(3 * chunk, len(data) // 2):
+            body = data[sent:sent + chunk]
+            r = _post_raw(url, f"/fleet/artifact/up1?offset={sent}"
+                          f"&total={len(data)}&digest={digest}"
+                          f"&rel={rel}", body)
+            assert r["received"] == sent + len(body)
+            sent = r["received"]
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+    part = os.path.join(cbase, "fleet", "staging", "up1.tar")
+    assert os.path.getsize(part) == sent  # the resumable partial
+    proc = spawn(port)  # the restarted coordinator, same store
+    try:
+        w = FleetWorker(url, wbase, name="up-w")
+        assert w.upload_artifact("up1", rel) is True
+        assert w.uploads_done == 1
+        assert _tree(os.path.join(cbase, rel)) == _tree(src)
+        # idempotent re-upload after the fact (zombie attempt)
+        assert w.upload_artifact("up1", rel) is True
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def _post_raw(url, path, body, timeout=10):
+    req = urllib.request.Request(
+        url + path, data=body,
+        headers={"Content-Type": "application/octet-stream"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode() or "{}")
+
+
+# -------------------- wall-clock t0 alignment (ISSUE 13 satellite)
+
+def test_claim_carries_t0_anchor_and_skew_visible(tmp_path):
+    """The first claim of a generation mints ONE absolute window
+    anchor (t0), broadcast with the coordinator's `now` for
+    clock-offset correction; the worker installs the corrected local
+    anchor into opts["nemesis-t0"], its heartbeat ticks report it, and
+    /fleet/status shows per-worker t0 skew vs the authoritative
+    anchor."""
+    from jepsen_tpu.campaign.plan import RunSpec
+
+    base = str(tmp_path)
+    coord = FleetCoordinator(SCHED_SPEC, base, lease_s=5.0)
+    try:
+        t_before = time.time()
+        code, r1 = coord.claim({"worker": "wa"})
+        assert code == 200
+        w1 = r1["windows"]
+        assert w1["t0"] >= t_before  # minted ahead: claim + lead
+        assert abs(w1["now"] - time.time()) < 2.0
+        # a second claim of the SAME generation shares the anchor;
+        # a different generation mints its own (same value is fine —
+        # anchors are per-generation, not globally unique)
+        code, r2 = coord.claim({"worker": "wb"})
+        g1, g2 = r1["spec"]["seed"], r2["spec"]["seed"]
+        if g1 == g2:
+            assert r2["windows"]["t0"] == w1["t0"]
+        # worker install: corrected anchor lands in the cell opts and
+        # in the tick payload
+        w = FleetWorker("http://127.0.0.1:1", base, name="wa")
+        rs = RunSpec.from_dict(r1["spec"])
+        w._install_windows(rs, w1)
+        assert abs(rs.opts["nemesis-t0"] - w1["t0"]) < 2.0  # same clock
+        ticks = w._window_ticks(time.monotonic())
+        assert ticks["t0"] == w.installed_windows["t0"]
+        # the tick lands skew on status
+        code, _hb = coord.heartbeat({
+            "worker": "wa", "renew": [r1["spec"]["run_id"]],
+            "windows": ticks})
+        code, s = coord.status()
+        ws = s["workers"]["wa"]["windows"]
+        assert isinstance(ws["t0-skew"], float)
+        assert ws["clock-synced"] is True  # same host, same clock
+        assert s["nemesis-schedule"]["t0-by-gen"][str(g1)] == w1["t0"]
+        assert str(g2) in s["nemesis-schedule"]["t0-by-gen"]
+    finally:
+        coord.close()
+

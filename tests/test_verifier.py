@@ -670,7 +670,392 @@ def test_soak_verifier_long():
     out = _run_soak(["--clients", "8", "--segments", "16",
                      "--txns", "400", "--fault-p", "0.1",
                      "--seed", "1"], timeout=560)
-    assert "8 clients" in out
+    assert '"sessions_peak": 8' in out
+
+
+# ----------------------- journal compaction + checkpoint (ISSUE 13)
+
+def _ref_verdict(h, models=("serializable",)):
+    ref = _feed(VerifierSession("ref", models), _ops(h), 10_000,
+                rolling=False)
+    return ref.verdict()
+
+
+def test_auto_compaction_bounds_journal_and_recovery_digest(tmp_path):
+    """A month-long session's journal must be BOUNDED, not monotone:
+    with ``compact-bytes`` set, streaming far more jsonl than the
+    budget keeps the on-disk journal under budget + one segment, the
+    logical cursor keeps ordinary resend semantics, and a restarted
+    service recovers checkpoint + suffix to the identical verdict
+    digest a fresh session reaches on the same ops."""
+    base = str(tmp_path)
+    h = synth.la_history(n_txns=400, n_keys=6, seed=7, fail_prob=0.05)
+    synth.inject_wr_cycle(h)
+    body = _jsonl(h)
+    budget, seg = 8192, 4096
+    svc = VerifierService(base, default_config={"compact-bytes": budget})
+    jpath = os.path.join(base, "verifier", "cp", "journal.jsonl")
+    sizes, cur = [], 0
+    while cur < len(body):
+        code, r = svc.ingest("cp", body[cur:cur + seg], cursor=cur)
+        assert code == 200
+        cur = r["cursor"]
+        sizes.append(os.path.getsize(jpath))
+    assert cur == len(body)  # the logical cursor ignores compaction
+    assert max(sizes) <= budget + seg
+    assert any(b < a for a, b in zip(sizes, sizes[1:]))  # not monotone
+    assert os.path.exists(os.path.join(base, "verifier", "cp",
+                                       "checkpoint.npz"))
+    # resend below the logical cursor: still an idempotent no-op even
+    # though those bytes were compacted off the disk journal
+    code, r = svc.ingest("cp", body[-seg:], cursor=len(body) - seg)
+    assert code == 200 and r["ops"] == 0 and r["cursor"] == len(body)
+    _code, v_live = svc.verdict("cp")
+    svc.close()
+    # restart: vectorized checkpoint restore + suffix replay
+    svc2 = VerifierService(base)
+    try:
+        code, v = svc2.verdict("cp")
+        assert code == 200
+        ref = _ref_verdict(h)
+        assert v["digest"] == verdict_digest(ref) == \
+            verdict_digest(v_live)
+        assert v["valid?"] is ref["valid?"] is False
+        # and the restored session keeps ACCEPTING: seal equals batch
+        assert svc2.seal("cp")[1]["equal"] is True
+    finally:
+        svc2.close()
+
+
+def test_compaction_crash_window_checkpoint_without_truncate(tmp_path):
+    """kill -9 BETWEEN the checkpoint write and the journal truncate
+    leaves both the full journal and a checkpoint; recovery must
+    replay only the suffix past the checkpoint cursor — nothing
+    doubles, digest identical."""
+    base = str(tmp_path)
+    h = synth.la_history(n_txns=240, n_keys=5, seed=11)
+    synth.inject_rw_cycle(h)
+    body = _jsonl(h)
+    half = len(body) // 2
+    svc = VerifierService(base)
+    code, r = svc.ingest("w1", body[:half], cursor=0)
+    assert code == 200
+    acked = r["cursor"]
+    live = svc._get("w1")
+    with live.lock:  # the first half of _Live.compact, then "kill -9"
+        cols, meta = live.session.checkpoint_state()
+        meta["cursor"] = live.journal.cursor
+        live.journal.write_checkpoint(cols, meta)
+    svc.close()
+    svc2 = VerifierService(base)
+    try:
+        # client resumes from its acked cursor; overlap skipped
+        code, r = svc2.ingest("w1", body[acked:], cursor=acked)
+        assert code == 200 and r["cursor"] == len(body)
+        code, v = svc2.verdict("w1")
+        assert v["digest"] == verdict_digest(_ref_verdict(h))
+        assert svc2.seal("w1")[1]["equal"] is True
+    finally:
+        svc2.close()
+
+
+def test_compaction_crash_window_torn_tail_after_compact(tmp_path):
+    """kill -9 mid-append on an already-compacted journal: recovery
+    truncates the torn tail back past the compaction header and
+    replays checkpoint + intact suffix to the identical digest."""
+    base = str(tmp_path)
+    h = synth.la_history(n_txns=200, n_keys=5, seed=3)
+    synth.inject_g1a(h)
+    body = _jsonl(h)
+    cut = (2 * len(body)) // 3
+    svc = VerifierService(base)
+    code, r = svc.ingest("w2", body[:cut], cursor=0)
+    assert code == 200
+    acked = r["cursor"]
+    code, out = svc.compact("w2")
+    assert code == 200
+    assert out["journal-bytes-after"] < out["journal-bytes-before"]
+    svc.close()
+    jpath = os.path.join(base, "verifier", "w2", "journal.jsonl")
+    with open(jpath, "ab") as f:
+        f.write(b'{"type": "ok", "proc')  # the torn line
+    svc2 = VerifierService(base)
+    try:
+        # the torn debris was never acked: cursor is still the acked
+        # logical offset, and the client's resend completes the stream
+        code, r = svc2.ingest("w2", body[acked:], cursor=acked)
+        assert code == 200 and r["cursor"] == len(body)
+        code, v = svc2.verdict("w2")
+        assert v["digest"] == verdict_digest(_ref_verdict(h))
+    finally:
+        svc2.close()
+
+
+def test_compact_endpoint_rejects_unknown_and_packed(tmp_path):
+    svc = VerifierService(str(tmp_path))
+    code, doc = svc.compact("nope")
+    assert code == 404
+    code, doc = svc.compact("../evil")
+    assert code == 400
+
+
+def test_session_names_cannot_shadow_infrastructure_dirs(tmp_path):
+    """Leading ``_``/``.`` are load-bearing prefixes (``_archive/``
+    retention, dot-prefixed staging) skipped by every scan — a session
+    there would journal into the retention subtree or be invisible to
+    listings and gc, so open must refuse them."""
+    svc = VerifierService(str(tmp_path))
+    for bad in ("_archive", "_mine", ".hidden"):
+        assert svc.open(bad)[0] == 400, bad
+
+
+def test_compacted_session_with_lost_checkpoint_quarantines(tmp_path):
+    """A compacted journal whose checkpoint is corrupt/missing cannot
+    rebuild the truncated prefix: recovery must QUARANTINE the session
+    (410 on ingest/verdict/seal/compact, ``recovery-error`` in the
+    snapshot) instead of serving normal-looking verdicts over a
+    suffix-only replay."""
+    base = str(tmp_path)
+    h = synth.la_history(n_txns=200, n_keys=5, seed=3)
+    body = _jsonl(h)
+    svc = VerifierService(base)
+    assert svc.ingest("qr", body, cursor=0)[0] == 200
+    assert svc.compact("qr")[0] == 200
+    # an uncompacted sibling for the control below
+    assert svc.ingest("whole", body, cursor=0)[0] == 200
+    svc.close()
+    with open(os.path.join(base, "verifier", "qr", "checkpoint.npz"),
+              "wb") as f:
+        f.write(b"garbage")
+    svc2 = VerifierService(base)
+    try:
+        assert svc2.verdict("qr")[0] == 410
+        assert svc2.ingest("qr", body)[0] == 410
+        assert svc2.seal("qr")[0] == 410
+        assert svc2.compact("qr")[0] == 410
+        code, snap = svc2.open("qr")
+        assert code == 200 and "recovery-error" in snap
+        # control: an uncompacted session with no checkpoint replays
+        # the whole journal and keeps serving
+        assert svc2.verdict("whole")[0] == 200
+    finally:
+        svc2.close()
+
+
+# ----------------------------------- GC / retention / archival
+
+def test_gc_expires_idle_and_archives_sealed(tmp_path):
+    """The retention pass: open sessions idle past ``gc-idle-s``
+    expire (journal stays — a later touch recovers them), sealed ones
+    idle past ``archive-sealed-s`` move under ``_archive/`` and leave
+    every listing surface; per-session gauge series retire with
+    them — the month-long daemon's /metrics cardinality is bounded."""
+    from jepsen_tpu.verifier import scan_sessions
+    from jepsen_tpu.verifier.service import ARCHIVE_DIR
+
+    base = str(tmp_path)
+    svc = VerifierService(base, default_config={
+        "gc-idle-s": 5.0, "archive-sealed-s": 5.0})
+    h = synth.la_history(n_txns=40, n_keys=3, seed=1)
+    svc.ingest("keep", _jsonl(h), cursor=0)
+    svc.ingest("idle", _jsonl(h), cursor=0)
+    svc.ingest("done", _jsonl(h), cursor=0)
+    assert svc.seal("done")[1]["equal"] is True
+    # "keep" stays fresh; the others idle past their budgets
+    live = svc._get("keep")
+    live.last_ingest = live.last_verdict_ts = time.time() + 60
+    stats = svc.gc(now=time.time() + 30)
+    assert stats == {"expired": 1, "archived": 1}
+    names = {n for n, _ in scan_sessions(base)}
+    assert "done" not in names          # archived out of the listings
+    assert {"keep", "idle"} <= names    # idle expired but on disk
+    assert os.path.isdir(os.path.join(base, "verifier", ARCHIVE_DIR,
+                                      "done"))
+    def series():
+        return {g["labels"].get("session")
+                for g in telemetry.registry().snapshot()["gauges"]
+                if g["name"] == "verifier-verdict-freshness-s"}
+
+    assert "idle" not in series() and "done" not in series()
+    # a later touch recovers the expired session by replay
+    code, v = svc.verdict("idle")
+    assert code == 200 and v["txns"] > 0
+    # sealed sessions already on disk from a PREVIOUS process life
+    # archive too: restart, re-seal nothing, just gc
+    svc.expire("idle")
+    svc.close()
+    svc2 = VerifierService(base, default_config={
+        "archive-sealed-s": 5.0})
+    try:
+        svc2.seal("keep")
+        svc2.expire("keep")  # sealed + on disk only
+        stats = svc2.gc(now=time.time() + 30)
+        assert stats["archived"] == 1
+        assert "keep" not in {n for n, _ in scan_sessions(base)}
+    finally:
+        svc2.close()
+
+
+# ----------------------------------- multi-tenant batched sweep
+
+def test_batched_sweep_matches_per_session_verdicts(tmp_path):
+    """Tentpole (d): many sessions' dirty regions through ONE
+    ``ops.cycle_sweep`` dispatch — sessions with cycle witnesses fall
+    back to their own exact sweep, clean ones commit without a
+    dispatch, and every verdict digest equals the per-session path's
+    bit for bit."""
+    base = str(tmp_path)
+    svc = VerifierService(base)
+    injections = [None, "inject_wr_cycle", None, "inject_rw_cycle",
+                  "inject_g1a", None]
+    hs = []
+    for i, inj in enumerate(injections):
+        h = synth.la_history(n_txns=120, n_keys=4, concurrency=4,
+                             seed=20 + i, fail_prob=0.05)
+        if inj:
+            getattr(synth, inj)(h)
+        hs.append(h)
+        # ingest WITHOUT a verdict: the dirty backlog stays pending
+        code, _r = svc.ingest(f"mt{i}", _jsonl(h), cursor=0)
+        assert code == 200
+    coll = telemetry.activate()
+    try:
+        stats = svc.sweep_dirty()
+        doc = telemetry.snapshot(coll)
+    finally:
+        telemetry.deactivate(coll)
+    assert stats["dirty"] == len(injections)
+    assert stats["dispatched"] == 1
+    assert stats["clean"] + stats["classified"] + stats["rebuild"] == \
+        stats["dirty"]
+    assert stats["classified"] >= 1  # the injected cycles classify
+    # the batched dispatch ran under ONE verifier.sweep span with
+    # batched=True — the span `cli obs gate` regression-gates
+    batched = [s for r in doc.get("spans", []) for s in _walk_spans(r)
+               if s["name"] == "verifier.sweep"
+               and (s.get("attrs") or {}).get("batched")]
+    assert len(batched) == 1
+    for i, h in enumerate(hs):
+        code, v = svc.verdict(f"mt{i}")
+        assert code == 200
+        assert v["digest"] == verdict_digest(_ref_verdict(h)), f"mt{i}"
+        assert svc.seal(f"mt{i}")[1]["equal"] is True
+    svc.close()
+
+
+def _walk_spans(sp):
+    yield sp
+    for c in sp.get("children") or []:
+        yield from _walk_spans(c)
+
+
+def test_batched_sweep_stale_snapshot_resweeps_not_commits(
+        tmp_path, monkeypatch):
+    """Race guard: a per-session sweep (an HTTP verdict) plus a fresh
+    ingest landing BETWEEN the batched snapshot and its commit (the
+    dispatch runs off-lock) makes the snapshot stale — the commit must
+    fall back to that session's exact sweep instead of blindly marking
+    the post-snapshot dirty edges as swept (which could silently skip
+    a cycle through them forever)."""
+    from jepsen_tpu.verifier import sweep as sweep_mod
+
+    svc = VerifierService(str(tmp_path))
+    h1 = synth.la_history(n_txns=150, n_keys=5, concurrency=4, seed=31)
+    body = _jsonl(h1)
+    cut = (3 * len(body)) // 5
+    h2 = synth.la_history(n_txns=100, n_keys=4, seed=32)
+    synth.inject_wr_cycle(h2)  # guarantees a region -> dispatch runs
+    code, r = svc.ingest("s1", body[:cut], cursor=0)
+    assert code == 200
+    acked = r["cursor"]
+    svc.ingest("s2", _jsonl(h2), cursor=0)
+    live1 = svc._get("s1")
+    real_dispatch = sweep_mod._dispatch
+    raced = {}
+
+    def hijack(regions, deadline, n_sessions):
+        out = real_dispatch(regions, deadline, n_sessions)
+        # while the batched pass holds no session locks: a concurrent
+        # verdict sweeps+commits s1's backlog, then new ops arrive
+        _c, v = svc.verdict("s1")
+        _c, r = svc.ingest("s1", body[acked:], cursor=acked)
+        raced["ok"] = r["cursor"] == len(body)
+        return out
+
+    monkeypatch.setattr(sweep_mod, "_dispatch", hijack)
+    stats = svc.sweep_dirty()
+    assert raced.get("ok") is True
+    assert stats["dispatched"] == 1
+    # s1's snapshot went stale: it must NOT be blind-committed
+    assert stats["clean"] == 0
+    assert stats["classified"] == 2  # s2 (witness) + s1 (stale)
+    for name, h in (("s1", h1), ("s2", h2)):
+        code, v = svc.verdict(name)
+        assert v["digest"] == verdict_digest(_ref_verdict(h)), name
+        assert svc.seal(name)[1]["equal"] is True
+    svc.close()
+
+
+# ----------------------------------- live checking (ISSUE 13)
+
+def _append_cell(base, opts):
+    from jepsen_tpu.campaign import core as ccore
+    from jepsen_tpu.campaign.plan import expand
+
+    spec = {"name": "lc", "workloads": ["append"], "seeds": [0],
+            "opts": dict({"ops": 80, "time-limit": None,
+                          "concurrency": 3}, **opts)}
+    [rs] = expand(spec)
+    rec = ccore.execute_run(rs, base)
+    with open(os.path.join(base, rec["dir"], "results.json")) as f:
+        return rec, json.load(f)
+
+
+def test_live_check_inproc_run_seals_equal(tmp_path):
+    """Tentpole (a), the happy path: a campaign cell with
+    ``live-check: {inproc: true}`` streams its interpreter's ops into
+    a verifier session DURING the run; at finish the rolling verdict
+    seals incremental == batch and the stamp carries the digest."""
+    rec, res = _append_cell(str(tmp_path), {"live-check": {"inproc": True}})
+    lc = res["live-check"]
+    assert lc["state"] == "ok"
+    assert lc["ops"] > 0 and lc["ops-dropped"] == 0
+    assert lc["seal"]["equal"] is True
+    assert lc["digest"] == lc["seal"]["digest"]
+    assert rec["valid?"] is True and lc["valid?"] is True
+    # the live session journaled + sealed under the run's store
+    from jepsen_tpu.verifier import scan_sessions
+
+    metas = dict(scan_sessions(str(tmp_path)))
+    assert metas[lc["session"]]["state"] == "sealed"
+
+
+def test_live_check_dead_verifier_degrades_run_unharmed(tmp_path):
+    """Graceful degradation at open: an unreachable verifier URL
+    degrades the live client immediately — the run completes normally
+    and the stored-history check stands alone."""
+    rec, res = _append_cell(str(tmp_path), {"live-check": {
+        "url": "http://127.0.0.1:9", "timeout-s": 0.5,
+        "budget-s": 0.5}})
+    lc = res["live-check"]
+    assert lc["state"] == "degraded" and lc.get("reason")
+    assert rec["valid?"] is True  # the stored-history verdict stands
+
+
+def test_live_check_partition_midrun_degrades_within_budget(tmp_path):
+    """Graceful degradation mid-run: a persistent fault on the
+    ``verifier.live`` seam (the chaos-tooling partition site) pushes
+    the client past its outage budget — feeding flips to a no-op, the
+    run completes, the stamp says degraded."""
+    plan = faults.FaultPlan(seed=0, sites=("verifier.live",),
+                            persistent=("verifier.live",))
+    with faults.use(plan):
+        rec, res = _append_cell(str(tmp_path), {"live-check": {
+            "inproc": True, "budget-s": 0.2, "flush-interval-s": 0.05}})
+    lc = res["live-check"]
+    assert lc["state"] == "degraded"
+    assert rec["valid?"] is True  # stored-history authority unharmed
+    assert plan.injected  # the partition actually fired
 
 
 # ------------------------------------------------- telemetry spans

@@ -705,6 +705,15 @@ def _golden_exposition(base):
     sw = reg.histogram("verifier-sweep-s", (0.001, 0.01, 0.1, 1.0, 10.0))
     for v in (0.005, 0.02, 0.02, 0.3):
         sw.observe(v)
+    # session lifecycle + live checking + store federation (ISSUE 13):
+    # journal bytes bounded by compaction, compaction count, degraded
+    # live streams, artifact uploads by protocol state
+    reg.gauge("verifier-journal-bytes").set(5120)
+    reg.counter("verifier-compactions").inc(3)
+    reg.counter("verifier-live-degraded").inc(1)
+    for state, n in (("started", 2), ("chunk", 9), ("resumed", 1),
+                     ("landed", 2), ("rejected", 1)):
+        reg.counter("fleet-artifact-uploads", state=state).inc(n)
     # fleet gauges (ISSUE 9 satellite): the coordinator's control-plane
     # view — workers alive by heartbeat freshness, active leases, cells
     # by state, requeue/duplicate counters attributed per worker
