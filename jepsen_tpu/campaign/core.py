@@ -133,6 +133,10 @@ def execute_run(rs: RunSpec, base: str) -> Dict[str, Any]:
         "run": rs.run_id, "key": rs.key, "campaign": rs.campaign,
         "workload": rs.workload_label, "fault": rs.fault_label,
         "seed": rs.seed,
+        # the distributed trace id (ISSUE 14): derived from the stable
+        # run id, so a lease-lapse re-execution's record carries the
+        # same trace as the attempt it replaced
+        "trace": test.get("trace-id"),
         "valid?": results.get("valid?", "unknown"),
         "error": flags["error"],
         "degraded": flags["degraded"],

@@ -486,6 +486,18 @@ def build_test(rs: RunSpec, base: str) -> dict:
     t["seed"] = rs.seed
     t["campaign"] = rs.campaign
     t["campaign-run-id"] = rs.run_id
+    # the distributed trace id (ISSUE 14): claim-carried for fleet
+    # cells, derived from the stable run id otherwise — either way the
+    # SAME id, so distributed and single-process cells stitch alike
+    from jepsen_tpu.telemetry import spans as _spans
+
+    t["trace-id"] = str(opts.get("trace-id")
+                        or _spans.trace_id_for(rs.run_id))
+    if opts.get("_fleet-host"):
+        # which fleet worker executes this cell — the live-check
+        # session's host attribution (verdict-freshness per host on
+        # the /fleet page) and the timeline's host column
+        t["fleet-host"] = str(opts["_fleet-host"])
     if opts.get("telemetry"):
         t["telemetry"] = True
     if opts.get("live-check"):

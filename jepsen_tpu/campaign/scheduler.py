@@ -85,10 +85,13 @@ def crash_record(rs: RunSpec, err: str, attempt: int,
                  wall_s: float = 0.0) -> Dict[str, Any]:
     """The attributable record for a run that died outside `core.run`'s
     own error handling — still a verdict, never a crash."""
+    from jepsen_tpu.telemetry import spans as _spans
+
     return {
         "run": rs.run_id, "key": rs.key, "campaign": rs.campaign,
         "workload": rs.workload_label, "fault": rs.fault_label,
         "seed": rs.seed, "valid?": "unknown", "error": err,
+        "trace": _spans.trace_id_for(rs.run_id),
         "degraded": None, "deadline": False, "dir": None,
         "ops": 0, "wall_s": round(wall_s, 3), "attempt": attempt,
         "spans": {},

@@ -384,9 +384,12 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
             print("fleet serve needs a campaign spec", file=sys.stderr)
             return 2
         try:
-            coord = FleetCoordinator(opts.spec, base,
-                                     lease_s=opts.lease,
-                                     run_deadline_s=opts.run_deadline)
+            retention = getattr(opts, "staging_retention", None)
+            coord = FleetCoordinator(
+                opts.spec, base, lease_s=opts.lease,
+                run_deadline_s=opts.run_deadline,
+                staging_retention_s=(retention if retention is not None
+                                     else 24 * 3600.0))
         except (OSError, ValueError) as e:
             print(f"fleet: bad spec {opts.spec!r}: {e}", file=sys.stderr)
             return 2
@@ -510,10 +513,48 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
     return 2
 
 
+def _render_timeline(tl: Dict[str, Any]) -> str:
+    """One stitched cross-host trace as a text waterfall (ISSUE 14):
+    ordered, host-attributed segments with offsets from the trace's
+    first event and proportional duration bars.  Geometry comes from
+    the shared `Warehouse.timeline_layout` (one layout, two
+    renderers), which is empty-safe for the only-orphans case."""
+    from .telemetry.warehouse import Warehouse
+
+    lay = Warehouse.timeline_layout(tl)
+    spans, hosts, wall = lay["spans"], lay["hosts"], lay["wall"]
+    lines = [f"trace {tl['trace-id']} — run {tl.get('run') or '?'} "
+             f"({len(spans)} spans, {len(hosts) or 1} host(s), "
+             f"{wall:.3f}s wall)"]
+    if spans:
+        lines.append(f"{'host':<14} {'segment':<28} {'start':>9} "
+                     f"{'dur':>9}  timeline")
+    width = 32
+    for s in spans:
+        left = int(round(s["frac_left"] * width))
+        bar = " " * min(left, width - 1) + "#" * max(
+            1, int(round(s["frac_width"] * width)))
+        lines.append(
+            f"{str(s.get('host') or '-'):<14} "
+            f"{str(s.get('name')):<28} {s['off']:>8.3f}s "
+            f"{s.get('dur_s') or 0.0:>8.3f}s  "
+            f"|{bar[:width]:<{width}}|")
+    orphans = tl.get("orphans") or []
+    if orphans:
+        lines.append("")
+        lines.append(f"ORPHAN spans ({len(orphans)} recorded against "
+                     "this run under a DIFFERENT trace id):")
+        for s in orphans:
+            lines.append(f"  {s.get('trace_id')} {s.get('name')} "
+                         f"host={s.get('host')}")
+    return "\n".join(lines)
+
+
 def obs_cmd(opts: argparse.Namespace) -> int:
-    """`obs ingest|rebuild|gate|sql|bench` — the sqlite telemetry
-    warehouse over the store dir (docs/TELEMETRY.md): build/refresh it,
-    query it, and gate span regressions statistically."""
+    """`obs ingest|rebuild|gate|sql|bench|timeline` — the sqlite
+    telemetry warehouse over the store dir (docs/TELEMETRY.md):
+    build/refresh it, query it, gate span regressions statistically,
+    and render stitched cross-host run timelines."""
     import glob as _glob
 
     from .telemetry import warehouse as wmod
@@ -563,6 +604,21 @@ def obs_cmd(opts: argparse.Namespace) -> int:
                   f"{str(r['unit']):<10} {r['vs_baseline'] or 0:>11.3f} "
                   f"{r['n_txns'] or 0:>9} {r['backend']}")
         return 0
+    if opts.action == "timeline":
+        if not opts.query:
+            print("obs: timeline needs a run id (or 32-hex trace id)",
+                  file=sys.stderr)
+            return 2
+        tl = wh.trace_timeline(opts.query)
+        if not tl["spans"] and not tl["orphans"]:
+            print(f"obs: no trace spans for {opts.query!r} (run "
+                  "`obs ingest` after the run lands; traced runs need "
+                  "telemetry or a fleet ledger)", file=sys.stderr)
+            return 2
+        print(_render_timeline(tl))
+        # orphans are a stitching failure worth a red exit: the run's
+        # artifacts disagree about which trace they belong to
+        return 1 if tl["orphans"] else 0
     if opts.action == "sql":
         if not opts.query:
             print("obs: sql needs a query argument", file=sys.stderr)
@@ -739,13 +795,15 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
     po = sub.add_parser("obs",
                         help="telemetry warehouse: ingest/rebuild the "
                              "sqlite index over the store, query it, "
-                             "and gate span regressions "
+                             "gate span regressions, and render "
+                             "stitched cross-host run timelines "
                              "(docs/TELEMETRY.md)")
     po.add_argument("action",
                     choices=("ingest", "rebuild", "gate", "sql",
-                             "bench"))
+                             "bench", "timeline"))
     po.add_argument("query", nargs="?",
-                    help="SQL for the sql action (read-only)")
+                    help="SQL for the sql action (read-only); run id "
+                         "or 32-hex trace id for the timeline action")
     po.add_argument("--bench", action="append", metavar="GLOB",
                     help="BENCH json file(s) to ingest alongside the "
                          "store (repeatable; glob ok)")
@@ -844,6 +902,14 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                           "the same port, so cells with "
                           '"live-check" opts stream here '
                           "(docs/VERIFIER.md)")
+    pfl.add_argument("--staging-retention", dest="staging_retention",
+                     type=float, default=None,
+                     help="serve: expire abandoned artifact-upload "
+                          "partials under <store>/fleet/staging/ "
+                          "after this many seconds (default 86400); "
+                          "staged bytes are visible either way as "
+                          "jepsen_fleet_artifact_staging_bytes on "
+                          "/metrics")
 
     def dispatch(opts: argparse.Namespace) -> int:
         if opts.cmd == "test":

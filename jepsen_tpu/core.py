@@ -164,26 +164,51 @@ def run(test: dict) -> dict:
            if telemetry.wanted_for(test) or profile_dir
            else telemetry.NOOP)
     recorder = None
+    # distributed trace context (ISSUE 14): a cell executed by a fleet
+    # worker (or any caller stamping test["trace-id"]) runs its whole
+    # body as ONE segment of the run's cross-host trace — stamped into
+    # span attrs, the event stream meta, and telemetry.json, and
+    # readable by the live-check client and every control-plane call
+    # made from this thread.  Derivable from the campaign run id too,
+    # so single-process campaign cells stitch identically.
+    tctx = None
+    tid = test.get("trace-id") or (
+        telemetry.trace_id_for(test["campaign-run-id"])
+        if test.get("campaign-run-id") else None)
+    if tid:
+        tctx = telemetry.trace_context(str(tid), "run")
+        test["trace-id"] = str(tid)
     if tel.enabled:
         test["telemetry-collector"] = tel
         # a full run always writes the unsuffixed artifacts, even for a
         # test map reloaded from a store dir that was later analyzed
         test.pop("telemetry-artifact-suffix", None)
         tel.annotate = bool(profile_dir)
+        tel.trace = tctx
         # the flight recorder: stream span/metric/resilience events to
         # <run-dir>/events.jsonl as they happen, so a killed run still
         # leaves a readable partial trace (docs/TELEMETRY.md)
         try:
+            import socket as _socket
+
             mb = test.get("events-max-bytes")
+            meta = {"name": test.get("name"),
+                    # fleet cells identify by worker name — one lane
+                    # per worker on the stitched timeline
+                    "host": test.get("fleet-host")
+                    or _socket.gethostname()}
+            if tctx is not None:
+                meta["trace-id"] = tctx.trace_id
             recorder = telemetry.attach_stream(
                 tel, store.test_dir(test),
-                meta={"name": test.get("name")},
+                meta=meta,
                 interval_s=float(
                     test.get("telemetry-sample-interval", 1.0)),
                 max_bytes=int(mb) if mb else None,
                 keep=test.get("events-keep"))
         except Exception as e:  # noqa: BLE001 — never fail a run for it
             logger.warning("flight recorder unavailable: %s", e)
+    prev_trace = telemetry.set_trace(tctx) if tctx is not None else None
     try:
         with profiling.trace(profile_dir):
             with tel.span("run", name=test.get("name"),
@@ -191,6 +216,8 @@ def run(test: dict) -> dict:
                           concurrency=test.get("concurrency")):
                 return _run_phases(test, tel)
     finally:
+        if tctx is not None:
+            telemetry.set_trace(prev_trace)
         if recorder is not None:
             recorder.close(
                 valid=(test.get("results") or {}).get("valid?"))

@@ -218,11 +218,23 @@ class ArtifactStore:
             meta = None
         if meta is None:
             os.makedirs(self.staging, exist_ok=True)
+            doc = {"run": run_id, "total": total,
+                   "digest": digest, "rel": rel,
+                   "started": round(time.time(), 3)}
+            try:
+                # trace stitching (ISSUE 14): the upload rides the
+                # run's trace — the web layer installed the incoming
+                # Jepsen-Trace header on this handler thread
+                from jepsen_tpu.telemetry import spans as spans_mod
+
+                ctx = spans_mod.current_trace()
+                if ctx is not None:
+                    doc["trace"] = ctx.trace_id
+            except Exception:  # noqa: BLE001 — observability only
+                pass
             tmp = meta_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({"run": run_id, "total": total,
-                           "digest": digest, "rel": rel,
-                           "started": round(time.time(), 3)}, f)
+                json.dump(doc, f)
             os.replace(tmp, meta_path)
             _count("started")
         if offset > received:
@@ -318,3 +330,78 @@ class ArtifactStore:
             os.remove(part)
         except OSError:
             pass
+
+    # -- staging retention (ISSUE 14 satellite) ------------------------------
+
+    def staging_bytes(self) -> int:
+        """Total bytes currently under ``<store>/fleet/staging/`` —
+        the leak a GC-less coordinator accumulates forever."""
+        total = 0
+        try:
+            for fn in os.listdir(self.staging):
+                try:
+                    total += os.path.getsize(
+                        os.path.join(self.staging, fn))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        return total
+
+    def gc(self, retention_s: float,
+           now: Optional[float] = None) -> Dict[str, int]:
+        """Expire permanently abandoned staged uploads: partials (and
+        landed markers) whose last activity — meta ``started`` /
+        ``landed-at``, or the part file's mtime, whichever is newest —
+        is older than `retention_s`.  A kill -9'd worker that never
+        comes back otherwise leaks its partial forever.  Refreshes the
+        ``fleet-artifact-staging-bytes`` gauge either way, so the leak
+        is visible on /metrics before it is collected."""
+        now = time.time() if now is None else now
+        removed = 0
+        try:
+            names = os.listdir(self.staging)
+        except OSError:
+            names = []
+        for fn in names:
+            if not fn.endswith(".json") or fn.endswith(".tmp"):
+                continue
+            run_id = fn[:-len(".json")]
+            part, meta_path = self._paths(run_id)
+            meta = self._meta(meta_path) or {}
+            latest = max(
+                [t for t in (meta.get("started"), meta.get("landed-at"))
+                 if isinstance(t, (int, float))] or [0.0])
+            try:
+                latest = max(latest, os.path.getmtime(part))
+            except OSError:
+                pass
+            if latest and now - latest > float(retention_s):
+                with self._run_lock(run_id):
+                    self._discard(run_id)
+                with self._locks_guard:
+                    self._run_locks.pop(run_id, None)
+                removed += 1
+                _count("expired")
+        # orphan part files whose sidecar meta never landed on disk
+        # (a crash between the two writes) age out on mtime alone
+        for fn in names:
+            if not fn.endswith(".tar"):
+                continue
+            p = os.path.join(self.staging, fn)
+            meta_p = p[:-len(".tar")] + ".json"
+            try:
+                if not os.path.exists(meta_p) and \
+                        now - os.path.getmtime(p) > float(retention_s):
+                    os.remove(p)
+                    removed += 1
+                    _count("expired")
+            except OSError:
+                pass
+        remaining = self.staging_bytes()
+        try:
+            _registry().gauge("fleet-artifact-staging-bytes").set(
+                remaining)
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+        return {"removed": removed, "staging-bytes": remaining}

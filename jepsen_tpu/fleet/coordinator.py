@@ -47,6 +47,7 @@ from jepsen_tpu.campaign import plan as plan_mod
 from jepsen_tpu.campaign.index import Index
 from jepsen_tpu.resilience import faults as faults_mod
 from jepsen_tpu.resilience.faults import FaultInjected
+from jepsen_tpu.telemetry import spans as spans_mod
 
 from .artifacts import ArtifactStore
 from .queue import WorkQueue, fleet_path
@@ -58,6 +59,21 @@ __all__ = ["FleetCoordinator"]
 #: a worker whose last heartbeat is older than this many leases is
 #: counted dead by the workers-alive gauge (it can still come back)
 ALIVE_LEASES = 3.0
+
+#: a worker silent for this many leases is dropped from the registry
+#: entirely — the cardinality bound (ISSUE 14): a fleet churning
+#: through register/expire cycles (worker names embed pids) must not
+#: grow the worker table, /fleet/status, or the federated /metrics
+#: surface monotonically
+PRUNE_LEASES = 40.0
+
+#: per-worker cap on federated metric rows accepted over heartbeat —
+#: the other half of the cardinality bound
+MAX_FEDERATED_SERIES = 48
+
+#: seconds between artifact-staging GC passes (ISSUE 14 satellite);
+#: the passes ride the heartbeat/status paths, no dedicated thread
+STAGING_GC_INTERVAL_S = 30.0
 
 #: wall-clock t0 alignment (ISSUE 13 satellite): a generation's window
 #: anchor is set this many seconds past its FIRST claim, so the other
@@ -86,7 +102,8 @@ class FleetCoordinator:
     def __init__(self, spec: Union[str, dict],
                  base: Optional[str] = None, *,
                  lease_s: float = 15.0,
-                 run_deadline_s: Optional[float] = None):
+                 run_deadline_s: Optional[float] = None,
+                 staging_retention_s: float = 24 * 3600.0):
         self.spec = plan_mod.load_spec(spec)
         self.base = base or store.BASE
         self.name = self.spec["name"]
@@ -117,6 +134,11 @@ class FleetCoordinator:
         #: store federation (ISSUE 13): the artifact-upload endpoint's
         #: staging + atomic landing
         self.artifacts = ArtifactStore(self.base)
+        #: staging retention (ISSUE 14 satellite): permanently
+        #: abandoned upload partials expire past this; GC rides the
+        #: heartbeat/status paths, throttled to one pass per interval
+        self.staging_retention_s = float(staging_retention_s)
+        self._staging_gc_at = 0.0
         if self.sched:
             for g in self.spec["seeds"]:
                 # pass the normalized block, not the whole spec — the
@@ -179,6 +201,23 @@ class FleetCoordinator:
         rec.setdefault("spec", self.spec_digest)
         if worker:
             rec.setdefault("fleet-worker", str(worker))
+        run = rec.get("run")
+        if run:
+            # trace stitching (ISSUE 14): the record always names its
+            # trace (derived from the stable run id — identical across
+            # retries), and the control-plane segments only the
+            # coordinator's ledger knows land as gateable spans next
+            # to the worker's checker spans (`obs gate --span
+            # fleet:enqueue-wait` works like any checker span)
+            rec.setdefault("trace", spans_mod.trace_id_for(str(run)))
+            t = self.queue.cell_times(str(run))
+            enq, clm = t.get("enqueued"), t.get("claimed")
+            spans = rec.setdefault("spans", {})
+            if isinstance(spans, dict) \
+                    and isinstance(enq, (int, float)) \
+                    and isinstance(clm, (int, float)) and clm >= enq:
+                spans.setdefault("fleet:enqueue-wait",
+                                 round(clm - enq, 6))
         return rec
 
     # -- shared endpoint plumbing -------------------------------------------
@@ -250,6 +289,14 @@ class FleetCoordinator:
                          "queued": c["queued"], "claimed": c["claimed"]}
         out = {"spec": spec, "lease-s": self.lease_s,
                "deadline": deadline}
+        # the trace broadcast (ISSUE 14): the claim carries the run's
+        # trace context — minted at enqueue time semantics (a pure
+        # function of the run id, so a re-claim after a lease lapse
+        # hands out the SAME trace), parented on the coordinator's
+        # claim segment
+        ctx = spans_mod.mint_trace(str(spec.get("run_id") or ""))
+        out["trace"] = dict(ctx.child("fleet:claim").to_dict(),
+                            header=ctx.header())
         if self.sched:
             # the window broadcast: the claim response is the
             # AUTHORITATIVE carrier of the cell generation's
@@ -304,6 +351,20 @@ class FleetCoordinator:
             if "state" in body:
                 hb.worker(str(worker), body.get("state"))
         out: Dict[str, Any] = {"ok": True, "lease-s": self.lease_s}
+        mx = body.get("metrics")
+        if worker is not None and isinstance(mx, list):
+            # metrics federation (ISSUE 14 tentpole b): the heartbeat
+            # doubles as the metrics push channel.  Rows are capped
+            # per worker (cardinality bound) and retire with worker
+            # liveness — the exposition only renders alive workers'
+            # snapshots, and the prune drops silent workers entirely
+            rows = [r for r in mx[:MAX_FEDERATED_SERIES]
+                    if isinstance(r, dict) and r.get("name")
+                    and isinstance(r.get("value"), (int, float))]
+            with self._lock:
+                if str(worker) in self.workers:
+                    self.workers[str(worker)]["metrics"] = {
+                        "rows": rows, "ts": round(time.time(), 3)}
         wins = body.get("windows")
         if worker is not None and "windows" in body and wins is None:
             with self._lock:  # cell done: the worker's windows retire
@@ -488,6 +549,53 @@ class FleetCoordinator:
                     total=int(total or 0), done=int(done or 0))
             return hb
 
+    def federated_metrics(self) -> Dict[str, Dict[str, Any]]:
+        """The fleet exposition's source (ISSUE 14 tentpole b): each
+        ALIVE worker's last pushed metrics snapshot, keyed by worker
+        name.  Dead workers' series retire here — the same
+        liveness-gated discipline as PR 13's per-session gauge
+        retirement, so a scrape's series set shrinks back as workers
+        expire instead of growing monotonically."""
+        now = time.time()
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for w, c in self.workers.items():
+                if now - c["last-seen"] > ALIVE_LEASES * self.lease_s:
+                    continue
+                mx = c.get("metrics")
+                if isinstance(mx, dict) and mx.get("rows"):
+                    out[w] = {"host": c.get("host"),
+                              "rows": list(mx["rows"]),
+                              "age-s": round(now - mx["ts"], 3)}
+        return out
+
+    def _prune_workers(self, now: float) -> None:
+        """Drop workers silent past PRUNE_LEASES from the registry —
+        bounds the worker table (names embed pids, so a churning fleet
+        mints new ones forever) and with it /fleet/status and the
+        federated metrics surface.  Caller holds self._lock."""
+        cutoff = PRUNE_LEASES * self.lease_s
+        for w in [w for w, c in self.workers.items()
+                  if now - c["last-seen"] > cutoff]:
+            del self.workers[w]
+
+    def gc_staging(self, now: Optional[float] = None) -> Dict[str, int]:
+        """One artifact-staging retention pass (ISSUE 14 satellite):
+        expire permanently abandoned upload partials, refresh the
+        ``fleet-artifact-staging-bytes`` gauge."""
+        return self.artifacts.gc(self.staging_retention_s, now=now)
+
+    def _maybe_gc_staging(self, now: float) -> None:
+        with self._lock:
+            due = now >= self._staging_gc_at
+            if due:
+                self._staging_gc_at = now + STAGING_GC_INTERVAL_S
+        if due:
+            try:
+                self.gc_staging(now)
+            except Exception:  # noqa: BLE001 — retention is best-effort
+                logger.debug("staging gc failed", exc_info=True)
+
     def _update_gauges(self) -> None:
         """The fleet's /metrics surface (live registry): workers alive
         by heartbeat freshness, active leases, cells by state."""
@@ -495,6 +603,7 @@ class FleetCoordinator:
             reg = _registry()
             now = time.time()
             with self._lock:
+                self._prune_workers(now)
                 alive = sum(
                     1 for c in self.workers.values()
                     if now - c["last-seen"] <= ALIVE_LEASES * self.lease_s)
@@ -521,6 +630,7 @@ class FleetCoordinator:
                 for f, n in open_by_fault.items():
                     reg.gauge("fleet-nemesis-windows-active",
                               campaign=self.name, fault=f).set(n)
+            self._maybe_gc_staging(now)
         except Exception:  # noqa: BLE001 — observability only
             logger.debug("fleet gauge update failed", exc_info=True)
 

@@ -166,6 +166,12 @@ class WorkQueue:
                 "claims": 0, "requeues": 0,
                 "completed_by": None, "record": None,
                 "record_digest": None,
+                # timeline bookkeeping (ISSUE 14): the ledger event
+                # timestamps, replay-stable (they come FROM the
+                # ledger), excluded from the state digest (derived
+                # observability, not queue state)
+                "enqueued_ts": ev.get("ts"),
+                "claimed_ts": None,
                 # in-memory only (not digested, not replayed): when the
                 # first affinity deferral parked this cell — the
                 # starvation-fallback clock
@@ -178,7 +184,8 @@ class WorkQueue:
             return  # event for an unknown cell: tolerate (old ledger)
         if k == "claim":
             cell.update(state="claimed", worker=ev.get("worker"),
-                        deadline=ev.get("deadline"))
+                        deadline=ev.get("deadline"),
+                        claimed_ts=ev.get("ts"))
             cell["claims"] += 1
         elif k == "renew":
             if cell["state"] == "claimed" and \
@@ -374,6 +381,18 @@ class WorkQueue:
         with self._lock:
             return [dict(self.cells[r]) for r in self._order
                     if self.cells[r]["state"] == "done"]
+
+    def cell_times(self, run: str) -> Dict[str, Any]:
+        """One cell's control-plane timing facts (ledger timestamps):
+        the material for the ``fleet:enqueue-wait`` segment the
+        coordinator stamps into index records (ISSUE 14)."""
+        with self._lock:
+            c = self.cells.get(run)
+            if c is None:
+                return {}
+            return {"enqueued": c.get("enqueued_ts"),
+                    "claimed": c.get("claimed_ts"),
+                    "claims": c["claims"], "requeues": c["requeues"]}
 
     def leases(self) -> List[Dict[str, Any]]:
         """Active claims: run / worker / lease deadline."""

@@ -46,10 +46,16 @@ from jepsen_tpu.campaign.plan import RunSpec
 from jepsen_tpu.campaign.scheduler import crash_record
 from jepsen_tpu.resilience import RetryPolicy
 from jepsen_tpu.resilience.policy import is_transient_http
+from jepsen_tpu.telemetry import spans as spans_mod
 
 logger = logging.getLogger("jepsen.fleet")
 
 __all__ = ["FleetWorker"]
+
+#: cap on the metric rows one heartbeat pushes (ISSUE 14 tentpole b) —
+#: must stay under the coordinator's MAX_FEDERATED_SERIES so nothing
+#: is silently dropped server-side
+MAX_PUSHED_SERIES = 48
 
 
 class FleetWorker:
@@ -98,6 +104,11 @@ class FleetWorker:
         #: the last installed window set (digest + descriptors) — what
         #: heartbeat ticks report while a scheduled cell runs
         self.installed_windows: Optional[Dict[str, Any]] = None
+        #: the in-flight cell's trace context (ISSUE 14): every
+        #: control-plane POST made while a cell runs carries it in the
+        #: Jepsen-Trace header — heartbeat/renew, artifact chunks,
+        #: complete all stitch onto the run's one trace
+        self._trace: Optional[spans_mod.TraceContext] = None
 
     # -- transport -----------------------------------------------------------
 
@@ -117,9 +128,13 @@ class FleetWorker:
         server's cursor, so they parse (stamped ``_conflict``) instead
         of raising."""
         def send() -> Dict[str, Any]:
+            headers = {"Content-Type": ctype}
+            tr = self._trace
+            if tr is not None:
+                headers[spans_mod.TRACE_HEADER] = tr.header()
             req = urllib.request.Request(
                 self.url + path, data=body,
-                headers={"Content-Type": ctype}, method="POST")
+                headers=headers, method="POST")
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.timeout_s) as r:
@@ -330,7 +345,7 @@ class FleetWorker:
                 self._post("fleet.release", "/fleet/release",
                            {"worker": self.name, "run": spec["run_id"]})
                 break
-            self._run_cell(spec, r.get("windows"))
+            self._run_cell(spec, r.get("windows"), r.get("trace"))
         logger.info("fleet worker %s done: %d cells completed "
                     "(%d duplicates discarded upstream)",
                     self.name, self.cells_done, self.duplicates)
@@ -414,14 +429,80 @@ class FleetWorker:
             out["t0"] = t0v
         return out
 
+    def metrics_snapshot(self) -> List[Dict[str, Any]]:
+        """The heartbeat's metrics payload (ISSUE 14 tentpole b): this
+        worker's own progress counters, process RSS, the jit
+        compile-cache stats, and a bounded slice of the process-wide
+        registry — what the coordinator re-exposes with ``host=``
+        labels so one scrape sees the whole fleet."""
+        rows: List[Dict[str, Any]] = [
+            {"name": "worker-cells-done", "kind": "counter",
+             "labels": {}, "value": self.cells_done},
+            {"name": "worker-uploads-done", "kind": "counter",
+             "labels": {}, "value": self.uploads_done},
+            {"name": "worker-duplicate-completions", "kind": "counter",
+             "labels": {}, "value": self.duplicates},
+        ]
+        try:
+            from jepsen_tpu.telemetry.stream import _rss_bytes
+
+            rss = _rss_bytes()
+            if rss:
+                rows.append({"name": "worker-rss-bytes",
+                             "kind": "gauge", "labels": {},
+                             "value": rss})
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+        try:
+            # compile-cost groundwork (ISSUE 14 satellite): the AOT
+            # cache PR's baseline, visible fleet-wide on one scrape
+            from jepsen_tpu.resilience.guard import compile_cache_stats
+
+            st = compile_cache_stats()
+            rows.append({"name": "jit-cache-entries", "kind": "gauge",
+                         "labels": {}, "value": st["entries"]})
+            rows.append({"name": "compile-cache-miss",
+                         "kind": "counter", "labels": {},
+                         "value": st["misses"]})
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from jepsen_tpu.telemetry import metrics as metrics_mod
+
+            snap = metrics_mod.registry().snapshot()
+            extra = [
+                dict(name=m["name"], kind=kind, labels=m["labels"],
+                     value=float(m["value"]))
+                for kind, group in (("counter", snap["counters"]),
+                                    ("gauge", snap["gauges"]))
+                for m in sorted(group, key=lambda m: (
+                    m["name"], str(sorted(m["labels"].items()))))
+                if isinstance(m.get("value"), (int, float))]
+            rows.extend(extra[:max(0, MAX_PUSHED_SERIES - len(rows))])
+        except Exception:  # noqa: BLE001
+            pass
+        return rows[:MAX_PUSHED_SERIES]
+
     def _run_cell(self, spec: Dict[str, Any],
-                  windows: Optional[Dict[str, Any]] = None) -> None:
+                  windows: Optional[Dict[str, Any]] = None,
+                  trace: Optional[Dict[str, Any]] = None) -> None:
         from jepsen_tpu.campaign.core import execute_run
 
         rs = RunSpec.from_dict(spec)
         rs.opts["_base"] = self.base
         self._install_windows(rs, windows)
         run_id = rs.run_id
+        # distributed trace (ISSUE 14): adopt the claim's trace id —
+        # equal to the locally derivable one (both are pure functions
+        # of the run id), so a claim from an older coordinator still
+        # traces.  The worker's own control-plane segment parents on
+        # the claim segment the coordinator handed out.
+        trace_id = str((trace or {}).get("trace-id")
+                       or spans_mod.trace_id_for(run_id))
+        rs.opts["trace-id"] = trace_id
+        self._trace = spans_mod.trace_context(trace_id,
+                                              f"fleet:worker:{self.name}")
+        t_claim = time.monotonic()
         state = {"run": run_id, "workload": rs.workload_label,
                  "fault": rs.fault_label, "seed": rs.seed,
                  "slot": None, "worker-host": socket.gethostname()}
@@ -440,6 +521,7 @@ class FleetWorker:
                     r = self._post("fleet.heartbeat", "/fleet/heartbeat",
                                    {"worker": self.name, "state": state,
                                     "windows": self._window_ticks(t0),
+                                    "metrics": self.metrics_snapshot(),
                                     "renew": [run_id]})
                     if run_id in (r.get("lost") or []):
                         lease_lost.set()
@@ -466,6 +548,7 @@ class FleetWorker:
             self._post("fleet.heartbeat", "/fleet/heartbeat",
                        {"worker": self.name, "state": state,
                         "windows": self._window_ticks(t0),
+                        "metrics": self.metrics_snapshot(),
                         "renew": [run_id]})
         except Exception:  # noqa: BLE001
             pass
@@ -504,6 +587,10 @@ class FleetWorker:
                 iw.pop("t0", None)
                 rs.opts.pop("nemesis-t0", None)
         t0 = time.monotonic()  # the window tick clock: workload start
+        # the claim→workload-start gap (ISSUE 14): claim transport,
+        # window install, and anchor wait — stamped as a gateable span
+        # on the index record next to the coordinator's enqueue-wait
+        claim_to_start_s = time.monotonic() - t_claim
         # mesh capability -> default-mesh shard count (PR 10 follow-on,
         # ISSUE 12 satellite): a cell pinning opts["mesh"] — or a worker
         # advertising one — runs its device checks sharded over exactly
@@ -541,8 +628,14 @@ class FleetWorker:
         # renewals the cell would spuriously requeue and re-execute
         # while this attempt is seconds from landing.
         try:
+            rec.setdefault("trace", trace_id)
+            sp = rec.setdefault("spans", {})
+            if isinstance(sp, dict):
+                sp.setdefault("fleet:claim-to-start",
+                              round(claim_to_start_s, 6))
             if (self.upload or rs.opts.get("artifact-upload")) \
                     and isinstance(rec.get("dir"), str):
+                t_up = time.monotonic()
                 try:
                     if not self.upload_artifact(run_id, rec["dir"]):
                         logger.warning(
@@ -553,6 +646,11 @@ class FleetWorker:
                     logger.warning("fleet worker %s: artifact upload "
                                    "of %s failed (%s)", self.name,
                                    run_id, e)
+                finally:
+                    if isinstance(sp, dict):
+                        sp.setdefault(
+                            "fleet:upload",
+                            round(time.monotonic() - t_up, 6))
             try:
                 r = self._post("fleet.complete", "/fleet/complete",
                                {"worker": self.name, "run": run_id,
@@ -582,6 +680,8 @@ class FleetWorker:
             try:
                 self._post("fleet.heartbeat", "/fleet/heartbeat",
                            {"worker": self.name, "state": None,
+                            "metrics": self.metrics_snapshot(),
                             "windows": None})
             except Exception:  # noqa: BLE001
                 pass
+            self._trace = None

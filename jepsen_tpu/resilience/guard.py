@@ -21,8 +21,9 @@ from __future__ import annotations
 
 import logging
 import sys
+import threading
 import time
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from jepsen_tpu.resilience import faults as faults_mod
 from jepsen_tpu.resilience.policy import (
@@ -35,7 +36,8 @@ from jepsen_tpu.resilience.policy import (
 logger = logging.getLogger("jepsen.resilience")
 
 __all__ = ["device_call", "with_fallback", "degrade_to_host",
-           "env_anomaly", "DEGRADED_HOST", "NO_PLAN"]
+           "env_anomaly", "DEGRADED_HOST", "NO_PLAN",
+           "compile_cache_stats", "reset_compile_cache_stats"]
 
 DEGRADED_HOST = "host-fallback"
 
@@ -82,6 +84,90 @@ def _annotate(**attrs: Any) -> None:
         sp.set_attr(**attrs)
 
 
+# ---------------------------------------------------------------------------
+# Compile-cost observability (ISSUE 14 satellite — ROADMAP item 2
+# groundwork).  jax.jit recompiles per argument-shape class; the first
+# call of a (site, shape-vocabulary) pair therefore pays compile +
+# execute while repeats pay execute only.  Tracking first sightings
+# process-wide gives the AOT-cache PR its measured baseline: how much
+# wall time is compile (`compile_s` span attrs, warehouse-queryable),
+# how many distinct executables the process accumulated
+# (`jit-cache-entries`), how often a new shape missed
+# (`compile-cache-miss`).
+# ---------------------------------------------------------------------------
+
+_compile_lock = threading.Lock()
+_seen_shapes: set = set()
+_compile_misses = 0
+
+
+def _shape_key(args: tuple, kw: dict) -> Tuple:
+    """The call's shape-class key: (shape, dtype) of every array-like
+    leaf one or two levels down — the same facts jax.jit keys its
+    executable cache on (weak types and static args aside, close
+    enough for attribution)."""
+    parts = []
+
+    def add(v: Any, depth: int) -> None:
+        shape = getattr(v, "shape", None)
+        if shape is not None:
+            parts.append((str(tuple(shape)),
+                          str(getattr(v, "dtype", ""))))
+        elif depth < 2 and isinstance(v, (list, tuple)):
+            for x in v[:8]:
+                add(x, depth + 1)
+
+    for a in args:
+        add(a, 0)
+    for v in kw.values():
+        add(v, 0)
+    return tuple(parts)
+
+
+def compile_cache_stats() -> Dict[str, int]:
+    """Process-wide jit shape-cache stats: distinct (site, shape)
+    classes seen (= executables the process holds warm) and total
+    first-sighting misses."""
+    with _compile_lock:
+        return {"entries": len(_seen_shapes),
+                "misses": _compile_misses}
+
+
+def reset_compile_cache_stats() -> None:
+    """Tests only — the live set mirrors jax's own cache, which is not
+    reset between runs either."""
+    global _compile_misses
+    with _compile_lock:
+        _seen_shapes.clear()
+        _compile_misses = 0
+
+
+def _peek_shape(site: str, args: tuple, kw: dict) -> Optional[Tuple]:
+    """This call's shape-class key — WITHOUT recording it.  The commit
+    happens only after the attempt SUCCEEDS (:func:`_commit_shape`): a
+    transient failure before compile completed must leave the shape
+    unseen, so the retry that actually pays the compile is the one
+    booked as ``compile_s``."""
+    try:
+        return (site,) + _shape_key(args, kw)
+    except Exception:  # noqa: BLE001 — exotic args must not fail a call
+        return None
+
+
+def _commit_shape(key: Optional[Tuple]) -> bool:
+    """Record a successfully-executed shape class; True if this commit
+    was its first."""
+    global _compile_misses
+    if key is None:
+        return False
+    with _compile_lock:
+        if key in _seen_shapes:
+            return False
+        _seen_shapes.add(key)
+        _compile_misses += 1
+        return True
+
+
 def _stamp_device_time(site: str, fn: Callable, args: tuple,
                        kw: dict) -> Any:
     """Run one device attempt, stamping its block-until-ready wall time
@@ -92,6 +178,7 @@ def _stamp_device_time(site: str, fn: Callable, args: tuple,
     propagate to the caller's retry/fallback classifier."""
     from jepsen_tpu import telemetry
 
+    shape_key = _peek_shape(site, args, kw)
     t0 = time.perf_counter_ns()
     out = fn(*args, **kw)
     jx = sys.modules.get("jax")
@@ -105,14 +192,30 @@ def _stamp_device_time(site: str, fn: Callable, args: tuple,
         # device_call's retry/fallback classifier instead of returning
         # the poisoned value as success
     dt = time.perf_counter_ns() - t0
+    # commit only now: the attempt survived its sync point, so THIS is
+    # the attempt that compiled (a transient failure above leaves the
+    # shape unseen for the retry to claim)
+    first = _commit_shape(shape_key)
     sp = telemetry.current()
     if sp is not None and sp.attrs is not None:
         try:
             sp.attrs["device_time_ns"] = \
                 int(sp.attrs.get("device_time_ns", 0)) + dt
+            # compile vs execute attribution (ISSUE 14 satellite): a
+            # first-call-per-shape attempt's wall is compile-dominated
+            # — stamped separately so "where did this cell's 40 s go"
+            # can answer "32 s of it was XLA compiles"
+            k = "compile_s" if first else "execute_s"
+            sp.attrs[k] = float(sp.attrs.get(k, 0.0)) + dt / 1e9
         except Exception:  # noqa: BLE001 — noop-span attrs are shared
             pass
-    telemetry.registry().counter("device-time-ns", site=site).inc(dt)
+    reg = telemetry.registry()
+    reg.counter("device-time-ns", site=site).inc(dt)
+    if first:
+        reg.counter("compile-cache-miss", site=site).inc()
+    with _compile_lock:
+        n = len(_seen_shapes)
+    reg.gauge("jit-cache-entries").set(n)
     return out
 
 

@@ -141,11 +141,26 @@ def _save_telemetry(test: dict, d: str) -> None:
 
         # an analyze pass writes telemetry-analyze.json / trace-analyze
         # .json so the original run's artifacts survive the re-check
-        telemetry.write_run(d, coll, meta={
+        import socket
+
+        meta = {
             "name": test.get("name"),
             "start-time": test.get("start-time"),
             "concurrency": test.get("concurrency"),
-        }, suffix=test.get("telemetry-artifact-suffix", ""))
+            # cross-host stitching (ISSUE 14): which host executed,
+            # which run/trace this artifact belongs to.  A fleet
+            # cell's identity is its WORKER name (the same host label
+            # the fleet ledger and live-check session carry), so one
+            # worker's segments land on one timeline lane
+            "host": test.get("fleet-host") or socket.gethostname(),
+        }
+        if test.get("campaign-run-id"):
+            meta["run-id"] = test["campaign-run-id"]
+        if test.get("trace-id"):
+            meta["trace-id"] = test["trace-id"]
+        telemetry.write_run(d, coll, meta=meta,
+                            suffix=test.get("telemetry-artifact-suffix",
+                                            ""))
     except Exception as e:  # noqa: BLE001 — telemetry must not fail a save
         logger.warning("telemetry export failed: %s", e)
 

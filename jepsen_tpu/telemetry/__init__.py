@@ -47,17 +47,26 @@ from .stream import event as stream_event
 # — importing sqlite3 here would tax every `import jepsen_tpu`.
 from .spans import (
     NOOP,
+    TRACE_HEADER,
     Collector,
     NoopCollector,
     PhaseTimer,
     Span,
+    TraceContext,
     activate,
     active,
     current,
+    current_trace,
     deactivate,
     enabled,
+    mint_trace,
+    parse_trace_header,
     phases,
+    set_trace,
     span,
+    trace_context,
+    trace_id_for,
+    trace_scope,
     traced,
 )
 
@@ -69,6 +78,9 @@ __all__ = [
     "wanted_for", "export", "metrics", "spans", "stream",
     "attach_stream", "stream_event", "read_events", "Heartbeat",
     "HttpHeartbeat",
+    "TraceContext", "TRACE_HEADER", "mint_trace", "trace_id_for",
+    "trace_context", "parse_trace_header", "current_trace",
+    "set_trace", "trace_scope",
 ]
 
 def registry() -> Registry:

@@ -75,7 +75,7 @@ def snapshot(collector: Collector,
     collector.close_open_spans()
     reg = (registry or getattr(collector, "registry", None)
            or _metrics.registry())
-    return {
+    doc = {
         "version": 1,
         "epoch_ns": collector.epoch_ns,
         "perf0_ns": collector.perf0_ns,
@@ -83,6 +83,12 @@ def snapshot(collector: Collector,
         "spans": [span_to_dict(r) for r in collector.roots],
         "metrics": reg.snapshot(),
     }
+    trace = getattr(collector, "trace", None)
+    if trace is not None:
+        # the distributed trace triple (ISSUE 14): what the warehouse
+        # stitches cross-host timelines on
+        doc["trace"] = trace.to_dict()
+    return doc
 
 
 def chrome_trace(collector: Collector,

@@ -30,8 +30,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import metrics as _metrics
 
 __all__ = ["exposition", "render_registry", "render_heartbeats",
-           "render_warehouse", "metric_name", "escape_label_value",
-           "CONTENT_TYPE"]
+           "render_warehouse", "render_fleet", "metric_name",
+           "escape_label_value", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -265,16 +265,71 @@ def render_warehouse(wh: Any) -> List[str]:
     return doc.render()
 
 
+def render_fleet(fleet: Any) -> List[str]:
+    """Metrics federation (ISSUE 14 tentpole b): the fleet
+    coordinator's view of every ALIVE worker's last pushed metrics
+    snapshot, as ``jepsen_fleet_host_*`` series with a ``host=`` label
+    (one scrape of the coordinator sees the whole fleet) plus
+    ``jepsen_fleet_rollup_*`` sums across hosts.  Cardinality is
+    bounded by construction: the coordinator caps rows per worker, and
+    a worker's series RETIRE with its liveness — expired workers
+    simply stop being rendered (the same discipline as PR 13's
+    per-session gauge retirement)."""
+    doc = _Doc()
+    try:
+        fed = fleet.federated_metrics()
+    except Exception:  # noqa: BLE001 — federation is best-effort
+        return []
+    doc.family("jepsen_fleet_fed_workers_reporting", "gauge",
+               "alive workers whose metrics snapshot is being "
+               "federated").append(
+        f"jepsen_fleet_fed_workers_reporting {len(fed)}")
+    rollup: Dict[Tuple[str, str, str], float] = {}
+    for w in sorted(fed):
+        for r in fed[w].get("rows") or []:
+            raw = str(r.get("name") or "")
+            kind = "counter" if r.get("kind") == "counter" else "gauge"
+            try:
+                v = float(r.get("value"))
+            except (TypeError, ValueError):
+                continue
+            name = metric_name(raw, "jepsen_fleet_host_")
+            if kind == "counter" and not name.endswith("_total"):
+                name += "_total"
+            labels = dict(r.get("labels") or {})
+            labels["host"] = w
+            doc.family(name, kind,
+                       f"fleet-federated worker {kind} {raw}").append(
+                f"{name}{_labels_str(labels)} {_fmt_value(v)}")
+            key = (raw, kind, json.dumps(r.get("labels") or {},
+                                         sort_keys=True))
+            rollup[key] = rollup.get(key, 0.0) + v
+    for (raw, kind, lbl) in sorted(rollup):
+        name = metric_name(raw, "jepsen_fleet_rollup_")
+        if kind == "counter" and not name.endswith("_total"):
+            name += "_total"
+        doc.family(name, kind,
+                   f"fleet rollup (sum over alive hosts) of {raw}"
+                   ).append(
+            f"{name}{_labels_str(json.loads(lbl))} "
+            f"{_fmt_value(rollup[(raw, kind, lbl)])}")
+    return doc.render()
+
+
 def exposition(base: Optional[str] = None,
                registry: Optional[_metrics.Registry] = None,
-               now: Optional[float] = None) -> str:
-    """The full ``/metrics`` document: live registry + campaign
+               now: Optional[float] = None,
+               fleet: Any = None) -> str:
+    """The full ``/metrics`` document: live registry + federated fleet
+    worker series (when a coordinator is attached) + campaign
     heartbeats + warehouse rollups (each section present only when its
     source exists).  Always ends with a newline."""
     from . import registry as active_registry
 
     reg = registry if registry is not None else active_registry()
     lines = render_registry(reg)
+    if fleet is not None:
+        lines += render_fleet(fleet)
     if base:
         lines += render_heartbeats(base, now=now)
         try:

@@ -22,14 +22,146 @@ absolute time.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import hashlib
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["Span", "Collector", "NoopCollector", "NOOP", "active",
            "activate", "deactivate", "span", "traced", "enabled",
-           "current"]
+           "current", "TraceContext", "TRACE_HEADER", "mint_trace",
+           "trace_id_for", "parse_trace_header", "current_trace",
+           "set_trace", "trace_scope"]
+
+# ---------------------------------------------------------------------------
+# Distributed trace context (ISSUE 14 tentpole a)
+#
+# One W3C-style (trace_id, span_id, parent_id) triple follows a run
+# across every control-plane seam — coordinator claim/complete,
+# verifier ingest/verdict/seal, artifact uploads — in a ``Jepsen-Trace``
+# header.  The trace id is a PURE FUNCTION of the run id (minted at
+# enqueue, stable across retries/resends and lease-lapse re-executions),
+# so every process that knows which run it is working on derives the
+# same id without coordination, and the warehouse can stitch a
+# cross-host timeline from artifacts that never traveled together.
+# ---------------------------------------------------------------------------
+
+#: the HTTP header carrying the trace triple across control-plane seams
+TRACE_HEADER = "Jepsen-Trace"
+
+
+def trace_id_for(run_id: str) -> str:
+    """The run's trace id: 32 hex chars, deterministically derived from
+    the stable run id — NOT per-attempt, so a retried claim, a resent
+    chunk, or a lease-lapse re-execution all land on ONE trace."""
+    return hashlib.sha256(
+        ("jepsen-trace:" + str(run_id)).encode()).hexdigest()[:32]
+
+
+def _span_id(*parts: str) -> str:
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+class TraceContext:
+    """One point on a distributed trace: ``trace_id`` names the run's
+    whole cross-host story, ``span_id`` this segment, ``parent_id`` the
+    segment that caused it (empty at the root)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: str = ""):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    def child(self, name: str) -> "TraceContext":
+        """A deterministic child segment: same trace, a span id derived
+        from (trace, parent, name) — two hosts naming the same segment
+        of the same run agree on its identity."""
+        return TraceContext(self.trace_id,
+                            _span_id(self.trace_id, self.span_id, name),
+                            self.span_id)
+
+    def header(self) -> str:
+        """``Jepsen-Trace`` header value (W3C traceparent-shaped):
+        ``00-<trace_id>-<span_id>-01``."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def to_dict(self) -> Dict[str, str]:
+        out = {"trace-id": self.trace_id, "span-id": self.span_id}
+        if self.parent_id:
+            out["parent-id"] = self.parent_id
+        return out
+
+    def __repr__(self) -> str:
+        return (f"<TraceContext {self.trace_id[:8]}../{self.span_id}"
+                f"{' <- ' + self.parent_id if self.parent_id else ''}>")
+
+
+def mint_trace(run_id: str) -> TraceContext:
+    """The run's ROOT trace context, minted at enqueue (or at
+    single-process execute) — seeded from the run id, so every mint of
+    the same run is the same trace."""
+    tid = trace_id_for(run_id)
+    return TraceContext(tid, _span_id(tid, "root"))
+
+
+def trace_context(trace_id: str, segment: str = "run") -> TraceContext:
+    """A named segment context on an EXISTING trace (the receiver side
+    of a propagated trace id): deterministic span id from (trace,
+    segment), parented on the trace root."""
+    tid = str(trace_id)
+    return TraceContext(tid, _span_id(tid, segment),
+                        _span_id(tid, "root"))
+
+
+def parse_trace_header(value: Optional[str]) -> Optional["TraceContext"]:
+    """Parse a ``Jepsen-Trace`` header back into a context; the
+    header's span id becomes the receiver's ``parent_id`` (the sender's
+    segment caused whatever the receiver does next).  Malformed values
+    parse to None — a bad header must never fail a control-plane
+    request."""
+    if not value:
+        return None
+    parts = str(value).strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    try:
+        int(parts[1], 16), int(parts[2], 16)
+    except ValueError:
+        return None
+    return TraceContext(parts[1], parts[2], parts[2])
+
+
+_trace_tls = threading.local()
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The trace context installed on THIS thread (None outside any
+    traced request/run)."""
+    return getattr(_trace_tls, "ctx", None)
+
+
+def set_trace(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install `ctx` as this thread's trace context; returns the
+    previous one (restore it when done, or use :func:`trace_scope`)."""
+    prev = getattr(_trace_tls, "ctx", None)
+    _trace_tls.ctx = ctx
+    return prev
+
+
+@contextlib.contextmanager
+def trace_scope(ctx: Optional[TraceContext]):
+    """``with trace_scope(ctx): ...`` — the handler-side seam: parse
+    the incoming header, run the handler under it, restore."""
+    prev = set_trace(ctx)
+    try:
+        yield ctx
+    finally:
+        set_trace(prev)
 
 
 class Span:
@@ -134,6 +266,10 @@ class Collector:
     enabled = True
     stream: Optional[Any] = None
     annotate = False
+    #: the run's distributed trace context (ISSUE 14): when set, root
+    #: spans carry trace_id/span_id attrs and the export stamps the
+    #: triple into telemetry.json for warehouse stitching
+    trace: Optional[TraceContext] = None
 
     def __init__(self):
         from .metrics import Registry
@@ -175,6 +311,11 @@ class Collector:
         if stack:
             stack[-1].children.append(sp)
         else:
+            if self.trace is not None:
+                # roots only: per-span stamping would bloat the export
+                # for zero stitch value (children inherit by nesting)
+                sp.attrs.setdefault("trace_id", self.trace.trace_id)
+                sp.attrs.setdefault("span_id", self.trace.span_id)
             with self._lock:
                 self.roots.append(sp)
         stack.append(sp)
@@ -232,6 +373,7 @@ class NoopCollector:
     registry = None  # telemetry.registry() falls back to the default
     stream = None
     annotate = False
+    trace = None
 
     def span(self, name: str, /, **attrs: Any) -> _NoopSpan:
         return _NOOP_SPAN

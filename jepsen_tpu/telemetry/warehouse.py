@@ -56,7 +56,7 @@ __all__ = ["Warehouse", "warehouse_path", "open_if_exists", "for_ledger",
            "WAREHOUSE_FILE", "SCHEMA_VERSION"]
 
 WAREHOUSE_FILE = "warehouse.sqlite"
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS meta(
@@ -73,7 +73,8 @@ CREATE TABLE IF NOT EXISTS campaign_records(
     error TEXT, degraded TEXT, deadline INTEGER,
     dir TEXT, ops INTEGER, wall_s REAL,
     gen TEXT, spec TEXT, ts TEXT,
-    witness TEXT);                  -- JSON witness summary, or NULL
+    witness TEXT,                   -- JSON witness summary, or NULL
+    trace TEXT);                    -- distributed trace id (ISSUE 14)
 CREATE INDEX IF NOT EXISTS cr_ledger_key ON campaign_records(ledger, key, id);
 CREATE INDEX IF NOT EXISTS cr_ledger_run ON campaign_records(ledger, run, id);
 CREATE TABLE IF NOT EXISTS record_spans(
@@ -141,7 +142,9 @@ CREATE TABLE IF NOT EXISTS fleet_events(
     id INTEGER PRIMARY KEY,
     ledger TEXT NOT NULL,           -- store-relative fleet ledger path
     ev TEXT, run TEXT, worker TEXT, reason TEXT, ts REAL,
-    deadline REAL);
+    deadline REAL,
+    spans TEXT);                    -- complete events: the record's
+                                    -- fleet:* segment durations (JSON)
 CREATE INDEX IF NOT EXISTS fe_ledger_ev ON fleet_events(ledger, ev, id);
 CREATE INDEX IF NOT EXISTS fe_worker ON fleet_events(ledger, worker, id);
 -- materialized per-worker rollup (the "which host's cells requeue
@@ -160,6 +163,23 @@ CREATE TABLE IF NOT EXISTS bench(
     metric TEXT, value REAL, unit TEXT, vs_baseline REAL,
     n_txns INTEGER, backend TEXT, wall_s REAL,
     compile_or_warmup_s REAL, doc TEXT NOT NULL);
+-- cross-host timeline stitching (ISSUE 14, schema v4): one row per
+-- host-attributed trace segment, stitched from fleet ledgers (enqueue
+-- wait / attempts / execute), landed run dirs (telemetry.json phase
+-- spans on absolute time), and verifier session snapshots (live
+-- sessions).  trace_id is a pure function of the run id, so segments
+-- from artifacts that never traveled together join on it.
+CREATE TABLE IF NOT EXISTS trace_spans(
+    id INTEGER PRIMARY KEY,
+    trace_id TEXT NOT NULL,
+    origin TEXT NOT NULL,          -- ingest unit, for per-unit wipes
+    source TEXT NOT NULL,          -- fleet | run | verifier
+    run TEXT, host TEXT,
+    name TEXT NOT NULL,
+    t0 REAL, t1 REAL, dur_s REAL);
+CREATE INDEX IF NOT EXISTS tsp_trace ON trace_spans(trace_id, t0, id);
+CREATE INDEX IF NOT EXISTS tsp_run ON trace_spans(run);
+CREATE INDEX IF NOT EXISTS tsp_origin ON trace_spans(origin);
 """
 
 #: every row-holding table, in wipe order (rebuild / per-unit deletes)
@@ -167,7 +187,8 @@ _DATA_TABLES = ("record_spans", "flip_rollup", "span_rollup",
                 "span_gen_rollup", "campaign_records", "ledgers",
                 "run_spans", "run_metrics", "witnesses", "runs",
                 "events", "event_cursors", "verifier_sessions",
-                "fleet_events", "fleet_worker_rollup", "bench")
+                "fleet_events", "fleet_worker_rollup", "trace_spans",
+                "bench")
 
 
 def warehouse_path(base: str) -> str:
@@ -216,6 +237,19 @@ class Warehouse:
             if "status" not in cols:
                 self.db.execute("ALTER TABLE runs ADD COLUMN status "
                                 "TEXT NOT NULL DEFAULT 'done'")
+            # v3 -> v4 migration: fleet_events.spans (the worker's
+            # fleet:* segment durations ride the complete event into
+            # the trace_spans view) and campaign_records.trace
+            fcols = {r[1] for r in self.db.execute(
+                "PRAGMA table_info(fleet_events)").fetchall()}
+            if "spans" not in fcols:
+                self.db.execute(
+                    "ALTER TABLE fleet_events ADD COLUMN spans TEXT")
+            ccols = {r[1] for r in self.db.execute(
+                "PRAGMA table_info(campaign_records)").fetchall()}
+            if "trace" not in ccols:
+                self.db.execute("ALTER TABLE campaign_records "
+                                "ADD COLUMN trace TEXT")
             self.db.execute(
                 "INSERT OR REPLACE INTO meta(key, value) VALUES "
                 "('schema_version', ?)", (str(SCHEMA_VERSION),))
@@ -407,8 +441,9 @@ class Warehouse:
         cur = self.db.execute(
             "INSERT INTO campaign_records(ledger, campaign, run, key, "
             "workload, fault, seed, valid, error, degraded, deadline, "
-            "dir, ops, wall_s, gen, spec, ts, witness) "
-            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            "dir, ops, wall_s, gen, spec, ts, witness, trace) "
+            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, "
+            "?, ?, ?)",
             (ledger, rec.get("campaign"), rec.get("run"), rec.get("key"),
              rec.get("workload"), rec.get("fault"),
              json.dumps(rec.get("seed")),
@@ -417,7 +452,8 @@ class Warehouse:
              1 if rec.get("deadline") else 0,
              rec.get("dir"), rec.get("ops"), rec.get("wall_s"),
              rec.get("gen"), rec.get("spec"), rec.get("ts"),
-             json.dumps(w) if isinstance(w, dict) else None))
+             json.dumps(w) if isinstance(w, dict) else None,
+             rec.get("trace")))
         rid = cur.lastrowid
         spans = rec.get("spans") or {}
         if isinstance(spans, dict):
@@ -479,12 +515,20 @@ class Warehouse:
             valid, flags = self._run_results(d)
             status = "running" if valid is _ABSENT else "done"
             spans, metrics = self._run_telemetry(d)
+            traces = self._run_trace_rows(d, rel)
             wit = self._run_witness(d)
             with self.db:
                 for tbl in ("runs", "run_spans", "run_metrics",
                             "witnesses"):
                     self.db.execute(
                         f"DELETE FROM {tbl} WHERE dir = ?", (rel,))
+                self.db.execute(
+                    "DELETE FROM trace_spans WHERE origin = ?", (rel,))
+                if traces:
+                    self.db.executemany(
+                        "INSERT INTO trace_spans(trace_id, origin, "
+                        "source, run, host, name, t0, t1, dur_s) "
+                        "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)", traces)
                 self.db.execute(
                     "INSERT INTO runs(dir, name, ts, digest, valid, "
                     "error, degraded, deadline, status, ingested_at) "
@@ -589,6 +633,59 @@ class Warehouse:
                 rows.append(("histogram-sum", h["name"], lbl(h),
                              float(h["sum"])))
         return spans, rows
+
+    @staticmethod
+    def _run_trace_rows(d: str, rel: str) -> List[Tuple]:
+        """Host-attributed trace segments from a run dir's
+        telemetry.json (ISSUE 14): the run root plus its direct phase
+        children (workload, check:*, live-check.finish, store.save_1
+        ...), placed on ABSOLUTE time via the collector's wall-clock
+        anchor — so they interleave correctly with the fleet ledger's
+        control-plane segments on one timeline.  Runs without a trace
+        block (pre-v14 artifacts, non-traced runs) contribute
+        nothing."""
+        try:
+            with open(os.path.join(d, "telemetry.json")) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return []
+        if not isinstance(doc, dict):
+            return []
+        trace = doc.get("trace") or {}
+        tid = trace.get("trace-id")
+        epoch = doc.get("epoch_ns")
+        perf0 = doc.get("perf0_ns")
+        if not tid or not isinstance(epoch, (int, float)) \
+                or not isinstance(perf0, (int, float)):
+            return []
+        meta = doc.get("meta") or {}
+        host = meta.get("host")
+        run = meta.get("run-id")
+        rows: List[Tuple] = []
+
+        def abs_s(t_ns: Any) -> Optional[float]:
+            if not isinstance(t_ns, (int, float)):
+                return None
+            return round((epoch + (t_ns - perf0)) / 1e9, 6)
+
+        def add(sp: Dict[str, Any], depth: int) -> None:
+            t0 = abs_s(sp.get("t0_ns"))
+            dur = sp.get("dur_ns")
+            name = str(sp.get("name"))
+            if t0 is not None and isinstance(dur, (int, float)):
+                rows.append((tid, rel, "run", run, host,
+                             name if depth == 0 else f"run:{name}",
+                             t0, round(t0 + dur / 1e9, 6),
+                             round(dur / 1e9, 6)))
+            if depth < 1:
+                for c in sp.get("children") or []:
+                    add(c, depth + 1)
+
+        for r in doc.get("spans") or []:
+            add(r, 0)
+        return rows[:64]  # phase-level rows only; leaves stay in
+        # telemetry.json (the timeline answers "where did the 40 s
+        # go", not "render the whole span forest")
 
     @staticmethod
     def _run_witness(d: str) -> Optional[Dict[str, Any]]:
@@ -732,6 +829,7 @@ class Warehouse:
         from jepsen_tpu.verifier import scan_sessions
 
         rows = []
+        traces: List[Tuple[str, List[Tuple]]] = []
         for name, meta in scan_sessions(base):
             v = meta.get("verdict") or {}
             seal = meta.get("seal") or {}
@@ -743,6 +841,23 @@ class Warehouse:
                 meta.get("digest"),
                 (1 if seal.get("equal") else 0) if seal else None,
                 meta.get("updated")))
+            # timeline stitching (ISSUE 14): a session whose config
+            # carries its run's trace id contributes one live-session
+            # segment (opened → last update, i.e. the window the live
+            # sweeps overlapped the workload)
+            cfg = meta.get("config") if isinstance(meta.get("config"),
+                                                   dict) else {}
+            tid = cfg.get("trace-id")
+            opened, upd = meta.get("opened"), meta.get("updated")
+            origin = "verifier/" + name
+            seg: List[Tuple] = []
+            if tid and isinstance(opened, (int, float)) \
+                    and isinstance(upd, (int, float)) and upd >= opened:
+                seg.append((str(tid), origin, "verifier", None,
+                            cfg.get("host"),
+                            "verifier:live-session", round(opened, 6),
+                            round(upd, 6), round(upd - opened, 6)))
+            traces.append((origin, seg))
         if not rows:
             return 0
         with self._lock, self.db:
@@ -751,6 +866,15 @@ class Warehouse:
                 "valid, anomalies, txns, ops, segments, digest, "
                 "seal_equal, updated) "
                 "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)", rows)
+            for origin, seg in traces:
+                self.db.execute(
+                    "DELETE FROM trace_spans WHERE origin = ?",
+                    (origin,))
+                if seg:
+                    self.db.executemany(
+                        "INSERT INTO trace_spans(trace_id, origin, "
+                        "source, run, host, name, t0, t1, dur_s) "
+                        "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)", seg)
         return len(rows)
 
     def verifier_sessions(self) -> List[Dict[str, Any]]:
@@ -779,16 +903,111 @@ class Warehouse:
         (the ``ledgers`` table keys on the store-relative path, which
         is disjoint from campaign ledgers' ``campaigns/...``)."""
         def insert(rel: str, ev: Dict[str, Any]) -> None:
+            extra = None
+            if ev.get("ev") == "complete" and \
+                    isinstance(ev.get("record"), dict):
+                sp = ev["record"].get("spans")
+                keep = {k: v for k, v in sp.items()
+                        if str(k).startswith("fleet:")
+                        and isinstance(v, (int, float))} \
+                    if isinstance(sp, dict) else {}
+                if keep:
+                    extra = json.dumps(keep)
             self.db.execute(
                 "INSERT INTO fleet_events(ledger, ev, run, worker, "
-                "reason, ts, deadline) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                "reason, ts, deadline, spans) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
                 (rel, ev.get("ev"), ev.get("run"), ev.get("worker"),
-                 ev.get("reason"), ev.get("ts"), ev.get("deadline")))
+                 ev.get("reason"), ev.get("ts"), ev.get("deadline"),
+                 extra))
+
+        def flush(rel: str) -> None:
+            self._refresh_fleet_rollup(rel)
+            self._refresh_fleet_traces(rel)
 
         return self._ingest_jsonl(path, base,
                                   wipe=self._wipe_fleet_ledger,
                                   insert=insert,
-                                  flush=self._refresh_fleet_rollup)
+                                  flush=flush)
+
+    def _refresh_fleet_traces(self, rel: str) -> None:
+        """Rebuild the ledger's control-plane trace segments (ISSUE
+        14): per run, ``fleet:enqueue-wait`` (enqueue → first claim,
+        the coordinator's segment), one ``fleet:attempt`` per claim
+        that lapsed/released (claim → requeue, attributed to the
+        claiming worker), and ``fleet:execute`` (final claim →
+        complete, attributed to the completing worker).  Recomputed
+        wholesale per ingest batch — the pairing needs the whole event
+        sequence, and the rows are few (a handful per cell)."""
+        from .spans import trace_id_for
+
+        self.db.execute(
+            "DELETE FROM trace_spans WHERE origin = ?", (rel,))
+        rows = self.db.execute(
+            "SELECT ev, run, worker, ts, spans FROM fleet_events "
+            "WHERE ledger = ? AND run IS NOT NULL ORDER BY id",
+            (rel,)).fetchall()
+        out: List[Tuple] = []
+        state: Dict[str, Dict[str, Any]] = {}
+        for ev, run, worker, ts, extra in rows:
+            if not isinstance(ts, (int, float)):
+                continue
+            st = state.setdefault(run, {"enqueued": None, "claim": None,
+                                        "first_claim": None})
+            tid = trace_id_for(run)
+            if ev == "enqueue" and st["enqueued"] is None:
+                st["enqueued"] = ts
+            elif ev == "claim":
+                st["claim"] = (ts, worker)
+                if st["first_claim"] is None:
+                    st["first_claim"] = ts
+                    if isinstance(st["enqueued"], (int, float)) \
+                            and ts >= st["enqueued"]:
+                        out.append((tid, rel, "fleet", run, None,
+                                    "fleet:enqueue-wait",
+                                    st["enqueued"], ts,
+                                    round(ts - st["enqueued"], 6)))
+            elif ev == "requeue" and st["claim"] is not None:
+                c_ts, c_w = st["claim"]
+                st["claim"] = None
+                if ts >= c_ts:
+                    out.append((tid, rel, "fleet", run, c_w,
+                                "fleet:attempt", c_ts, ts,
+                                round(ts - c_ts, 6)))
+            elif ev == "complete" and st["claim"] is not None:
+                c_ts, _c_w = st["claim"]
+                st["claim"] = None
+                if ts >= c_ts:
+                    out.append((tid, rel, "fleet", run, worker,
+                                "fleet:execute", c_ts, ts,
+                                round(ts - c_ts, 6)))
+                    # the worker-measured segments ride the complete
+                    # event's record: claim-to-start anchors forward
+                    # from the claim, upload backward from the
+                    # completion — absolute placement from the
+                    # coordinator's ledger clock, durations from the
+                    # worker's monotonic clock
+                    try:
+                        durs = json.loads(extra) if extra else {}
+                    except ValueError:
+                        durs = {}
+                    d = durs.get("fleet:claim-to-start")
+                    if isinstance(d, (int, float)) and 0 <= d \
+                            and c_ts + d <= ts:
+                        out.append((tid, rel, "fleet", run, worker,
+                                    "fleet:claim-to-start", c_ts,
+                                    round(c_ts + d, 6), round(d, 6)))
+                    d = durs.get("fleet:upload")
+                    if isinstance(d, (int, float)) and 0 <= d \
+                            and ts - d >= c_ts:
+                        out.append((tid, rel, "fleet", run, worker,
+                                    "fleet:upload", round(ts - d, 6),
+                                    ts, round(d, 6)))
+        if out:
+            self.db.executemany(
+                "INSERT INTO trace_spans(trace_id, origin, source, "
+                "run, host, name, t0, t1, dur_s) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)", out)
 
     def _refresh_fleet_rollup(self, rel: str) -> None:
         self.db.execute(
@@ -808,6 +1027,8 @@ class Warehouse:
                         (rel,))
         self.db.execute(
             "DELETE FROM fleet_worker_rollup WHERE ledger = ?", (rel,))
+        self.db.execute("DELETE FROM trace_spans WHERE origin = ?",
+                        (rel,))
         self.db.execute("DELETE FROM ledgers WHERE path = ?", (rel,))
 
     def fleet_worker_rollup(self, ledger_rel: str
@@ -991,7 +1212,7 @@ class Warehouse:
             rows = self.db.execute(
                 "SELECT r.run, r.key, r.workload, r.fault, r.seed, "
                 "r.valid, r.error, r.degraded, r.deadline, r.dir, "
-                "r.ops, r.wall_s, r.gen, r.ts, r.witness "
+                "r.ops, r.wall_s, r.gen, r.ts, r.witness, r.trace "
                 "FROM campaign_records r JOIN ("
                 "  SELECT run, MAX(id) AS mid FROM campaign_records"
                 "  WHERE ledger = ? AND valid IS NOT NULL"
@@ -999,7 +1220,7 @@ class Warehouse:
                 "ON r.id = t.mid", (ledger_rel,)).fetchall()
         out: Dict[str, Dict[str, Any]] = {}
         for (run, key, wl, fl, seed, valid, error, degraded, deadline,
-             d, ops, wall_s, gen, ts, wit) in rows:
+             d, ops, wall_s, gen, ts, wit, trace) in rows:
             out[run] = {
                 "run": run, "key": key, "workload": wl, "fault": fl,
                 "seed": _loads(seed) if seed is not None else None,
@@ -1008,6 +1229,7 @@ class Warehouse:
                 "deadline": bool(deadline), "dir": d, "ops": ops,
                 "wall_s": wall_s, "gen": gen, "ts": ts,
                 "witness": json.loads(wit) if wit else None,
+                "trace": trace,
             }
         return out
 
@@ -1037,6 +1259,82 @@ class Warehouse:
             if isinstance(w, dict) and w.get("ops"):
                 out.setdefault(key, []).append({"gen": gen, "witness": w})
         return out
+
+    # -- cross-host timelines (ISSUE 14 tentpole c) --------------------------
+
+    def trace_timeline(self, run_or_trace: str) -> Dict[str, Any]:
+        """One run's stitched cross-host timeline.  Accepts a run id
+        (the trace id derives from it) or a 32-hex trace id.  Returns
+        ``{"trace-id", "run", "spans": [...], "orphans": [...]}`` —
+        spans ordered by absolute start time, each host-attributed;
+        ``orphans`` are rows recorded against this RUN under a
+        DIFFERENT trace id (the acceptance's zero-orphans check: a
+        relanded/replayed run must stitch to ONE trace)."""
+        from .spans import trace_id_for
+
+        key = str(run_or_trace)
+        is_tid = len(key) == 32 and all(
+            c in "0123456789abcdef" for c in key)
+        tid = key if is_tid else trace_id_for(key)
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT trace_id, source, run, host, name, t0, t1, "
+                "dur_s FROM trace_spans WHERE trace_id = ? OR run = ? "
+                "ORDER BY t0, id", (tid, key)).fetchall()
+        cols = ("trace_id", "source", "run", "host", "name", "t0",
+                "t1", "dur_s")
+        spans, orphans = [], []
+        run = None if is_tid else key
+        for r in rows:
+            d = dict(zip(cols, r))
+            if run is None and d.get("run"):
+                run = d["run"]
+            (spans if d["trace_id"] == tid else orphans).append(d)
+        return {"trace-id": tid, "run": run, "spans": spans,
+                "orphans": orphans}
+
+    @staticmethod
+    def timeline_layout(tl: Dict[str, Any]) -> Dict[str, Any]:
+        """Waterfall geometry for one :meth:`trace_timeline` result —
+        THE shared layout both renderers (cli ``obs timeline`` and the
+        web ``/timeline`` page) consume, so bar math can't drift
+        between them.  Empty-safe: a timeline with only orphan rows
+        (every artifact disagreed with the derived trace id) lays out
+        zero spans but still reports hosts/wall defaults, so the
+        renderers can show the orphan diagnostic instead of crashing."""
+        spans = tl.get("spans") or []
+        t0s = [s["t0"] for s in spans
+               if isinstance(s.get("t0"), (int, float))]
+        t1s = [s["t1"] for s in spans
+               if isinstance(s.get("t1"), (int, float))]
+        t_min = min(t0s) if t0s else 0.0
+        wall = max((max(t1s) - t_min) if t1s else 0.0, 1e-9)
+        rows = []
+        for s in spans:
+            t0 = s.get("t0")
+            off = (t0 - t_min) if isinstance(t0, (int, float)) else 0.0
+            dur = s.get("dur_s") or 0.0
+            rows.append(dict(s, off=round(off, 6),
+                             frac_left=min(max(off / wall, 0.0), 1.0),
+                             frac_width=min(max(dur / wall, 0.0), 1.0)))
+        return {
+            "t_min": t_min, "wall": wall, "spans": rows,
+            "hosts": sorted({str(s.get("host")) for s in spans
+                             if s.get("host")}),
+        }
+
+    def traces(self, limit: int = 50) -> List[Dict[str, Any]]:
+        """Recent stitched traces, newest first: one row per trace id
+        with its run, span count, distinct hosts, and wall span."""
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT trace_id, MAX(run), COUNT(*), "
+                "COUNT(DISTINCT host), MIN(t0), MAX(t1) "
+                "FROM trace_spans GROUP BY trace_id "
+                "ORDER BY MIN(t0) DESC LIMIT ?", (int(limit),)).fetchall()
+        return [{"trace-id": tid, "run": run, "spans": n,
+                 "hosts": hosts, "t0": t0, "t1": t1}
+                for tid, run, n, hosts, t0, t1 in rows]
 
     # -- rollups (the /metrics exposition) -----------------------------------
 

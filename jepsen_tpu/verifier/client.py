@@ -43,6 +43,7 @@ from typing import Any, Dict, Optional
 from jepsen_tpu import resilience, store
 from jepsen_tpu.resilience import RetryPolicy
 from jepsen_tpu.resilience.policy import is_transient_http
+from jepsen_tpu.telemetry import spans as spans_mod
 
 logger = logging.getLogger("jepsen.verifier")
 
@@ -89,6 +90,15 @@ class LiveCheck:
             self._url = target.rstrip("/")
         else:
             self._svc = target
+        # distributed trace (ISSUE 14): the session rides its run's
+        # trace — from the open config's trace-id (fleet cells), else
+        # whatever trace core.run installed on this thread.  Captured
+        # here because the sender runs on its own thread, where the
+        # thread-local would be empty.
+        tid = (open_config or {}).get("trace-id")
+        self._trace: Optional[spans_mod.TraceContext] = (
+            spans_mod.trace_context(str(tid), "verifier:live") if tid
+            else spans_mod.current_trace())
         self._lock = threading.Lock()
         self._buf = bytearray()      # unacked bytes (suffix of stream)
         self._cursor = 0             # acked logical stream offset
@@ -112,12 +122,15 @@ class LiveCheck:
 
     def _call(self, what: str, fn) -> Any:
         """One guarded verifier call: fault site ``verifier.live``,
-        transient retries per the seeded policy.  Raises when retries
-        are exhausted — the caller accounts the outage, this just
-        names the verb (`what`) in the diagnostic."""
+        transient retries per the seeded policy, run under the
+        session's trace context (so the in-proc service's trace
+        adoption sees it even from the sender thread).  Raises when
+        retries are exhausted — the caller accounts the outage, this
+        just names the verb (`what`) in the diagnostic."""
         try:
-            return resilience.device_call(LIVE_SITE, fn,
-                                          policy=self.retry)
+            with spans_mod.trace_scope(self._trace):
+                return resilience.device_call(LIVE_SITE, fn,
+                                              policy=self.retry)
         except Exception as e:
             logger.debug("live-check %s: %s failed (%s)",
                          self.session, what, e)
@@ -128,6 +141,8 @@ class LiveCheck:
         req = urllib.request.Request(
             self._url + path, data=body if method == "POST" else None,
             method=method)
+        if self._trace is not None:
+            req.add_header(spans_mod.TRACE_HEADER, self._trace.header())
         with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
             return json.loads(r.read().decode() or "{}")
 
@@ -357,6 +372,16 @@ def live_check_for(test: dict) -> Optional[LiveCheck]:
 
         target = VerifierService(store._base(test))
         own_svc = True
+    open_config = dict(cfg.get("config") or {})
+    # trace + host attribution (ISSUE 14): the session's journal
+    # metadata names the run's trace and the executing fleet host, so
+    # the warehouse can stitch live-sweep segments into the run's
+    # cross-host timeline and the /fleet page can show per-host
+    # verdict freshness
+    if test.get("trace-id"):
+        open_config.setdefault("trace-id", str(test["trace-id"]))
+    if test.get("fleet-host"):
+        open_config.setdefault("host", str(test["fleet-host"]))
     lc = LiveCheck(
         target, str(session),
         seal=bool(cfg.get("seal", True)),
@@ -364,6 +389,6 @@ def live_check_for(test: dict) -> Optional[LiveCheck]:
         flush_ops=int(cfg.get("flush-ops", 256)),
         flush_interval_s=float(cfg.get("flush-interval-s", 0.25)),
         timeout_s=float(cfg.get("timeout-s", 3.0)),
-        open_config=cfg.get("config"))
+        open_config=open_config or None)
     lc._own_svc = own_svc
     return lc

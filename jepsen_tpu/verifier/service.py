@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu import resilience, store, telemetry
 from jepsen_tpu.resilience import Deadline, DeadlineExceeded
+from jepsen_tpu.telemetry import spans as spans_mod
 from jepsen_tpu.telemetry.stream import EventStream
 
 from .journal import (
@@ -341,6 +342,21 @@ class VerifierService:
         logger.info("verifier: recovered session %s (%d journaled ops)",
                     live.name, n)
 
+    @staticmethod
+    def _adopt_trace(live: _Live) -> None:
+        """Stitch the session onto its run's distributed trace (ISSUE
+        14): the first request arriving with a trace — open config
+        carrying ``trace-id``, or a ``Jepsen-Trace`` header the web
+        layer installed on this handler thread — pins the session's
+        trace id into its config (persisted in session.json, the
+        journal session metadata) and the event stream."""
+        if live.config.get("trace-id"):
+            return
+        ctx = spans_mod.current_trace()
+        if ctx is not None:
+            live.config["trace-id"] = ctx.trace_id
+            live.stream.emit("trace", trace=ctx.trace_id)
+
     def open(self, name: str, config: Optional[Dict[str, Any]] = None
              ) -> Tuple[int, Dict[str, Any]]:
         try:
@@ -348,6 +364,7 @@ class VerifierService:
         except ValueError as e:
             return 400, {"error": str(e)}
         with live.lock:
+            self._adopt_trace(live)
             live.persist()
             return 200, live.snapshot()
 
@@ -388,6 +405,7 @@ class VerifierService:
 
     def _ingest_locked(self, live: _Live, body: bytes,
                        cursor: Optional[int]) -> Tuple[int, Dict[str, Any]]:
+        self._adopt_trace(live)
         jr = live.journal
         if cursor is not None:
             cursor = int(cursor)
@@ -721,6 +739,31 @@ class VerifierService:
                                session=name)
         except Exception:  # noqa: BLE001 — observability cleanup only
             pass
+
+    def host_freshness(self) -> Dict[str, Dict[str, Any]]:
+        """Per-host verdict freshness over OPEN live sessions whose
+        config names the executing host (fleet cells stamp it via
+        their ``fleet-host``) — the /fleet dashboard's ingest-lag
+        column (ISSUE 14 satellite).  Freshness is measured entirely
+        on this service's clock (last ingest vs last verdict), so it
+        needs no worker clock correction."""
+        with self._lock:
+            lives = list(self._live.values())
+        out: Dict[str, Dict[str, Any]] = {}
+        for live in lives:
+            with live.lock:
+                if live.dead or live.seal_result is not None:
+                    continue
+                host = live.config.get("host")
+                if not host:
+                    continue
+                fresh = round(max(0.0, live.last_ingest
+                                  - live.last_verdict_ts), 3)
+            cur = out.setdefault(str(host),
+                                 {"freshness-s": 0.0, "sessions": 0})
+            cur["sessions"] += 1
+            cur["freshness-s"] = max(cur["freshness-s"], fresh)
+        return out
 
     def _update_gauges(self) -> None:
         with self._lock:

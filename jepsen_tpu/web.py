@@ -221,6 +221,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._fleet_page()
             if path == "/fleet/status":
                 return self._fleet_status()
+            if path.startswith("/timeline/"):
+                return self._timeline(path[len("/timeline/"):])
             self._send(404, b"not found", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
             pass
@@ -232,7 +234,19 @@ class _Handler(BaseHTTPRequestHandler):
         """The verifier ingest surface (docs/VERIFIER.md) and the
         fleet control plane (docs/FLEET.md) — each only routed when
         the server was started with that service attached (``cli
-        serve --ingest`` / ``cli fleet serve``)."""
+        serve --ingest`` / ``cli fleet serve``).  Every POST runs
+        under the request's ``Jepsen-Trace`` context (ISSUE 14): the
+        header, when present, is parsed and installed thread-locally
+        so the coordinator, verifier, and artifact store stitch the
+        request onto its run's distributed trace."""
+        from .telemetry import spans as spans_mod
+
+        ctx = spans_mod.parse_trace_header(
+            self.headers.get(spans_mod.TRACE_HEADER))
+        with spans_mod.trace_scope(ctx):
+            self._do_post()
+
+    def _do_post(self):
         try:
             parsed = urlparse(self.path)
             path = unquote(parsed.path)
@@ -536,11 +550,13 @@ anomalies: <code>{html.escape(", ".join(w.get("anomaly-types") or ()))}
 
     def _metrics(self):
         """Prometheus text exposition (docs/TELEMETRY.md): the live
-        registry's counters/gauges/histograms, campaign heartbeat
-        freshness, and warehouse rollup gauges."""
+        registry's counters/gauges/histograms, federated fleet worker
+        series (ISSUE 14: ``host=``-labeled, retired with worker
+        liveness), campaign heartbeat freshness, and warehouse rollup
+        gauges."""
         from .telemetry import prometheus as prom
 
-        body = prom.exposition(base=self.base)
+        body = prom.exposition(base=self.base, fleet=self.fleet)
         self._send(200, body.encode(), prom.CONTENT_TYPE)
 
     def _campaigns(self):
@@ -628,6 +644,12 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
                               f'witness ({w["ops"]} ops)" '
                               f'href="/run/{quote(str(r["dir"]))}/witness">'
                               f'w:{w["ops"]}</a>')
+                if r.get("run") and r.get("trace"):
+                    # trace-stamped cells (ISSUE 14) link to their
+                    # stitched cross-host waterfall
+                    badge += (f' <a class="b b-other" title="cross-'
+                              f'host timeline" href="/timeline/'
+                              f'{quote(str(r["run"]))}">tl</a>')
                 tds.append(f'<td style="text-align:center">{badge}</td>')
             rows.append(f"<tr><td>{html.escape(wl)}</td>"
                         f"<td>{html.escape(fl)}</td>{''.join(tds)}</tr>")
@@ -1222,12 +1244,36 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
                                         self._read_body())
         self._send_json(code, doc)
 
+    def _fleet_status_doc(self):
+        """The coordinator's status, enriched with the co-hosted
+        verifier's per-host verdict freshness (ISSUE 14 satellite):
+        the one join the fleet dashboard was missing — last heartbeat
+        age says a worker is ALIVE, verdict freshness says its
+        live-check stream is actually being VERIFIED (ingest lag on
+        the verifier's own clock, no worker clock correction
+        needed)."""
+        code, doc = self.fleet.status()
+        if code == 200 and self.verifier is not None:
+            try:
+                fresh = self.verifier.host_freshness()
+                if fresh:
+                    doc["verifier-freshness"] = fresh
+                for w, row in (doc.get("workers") or {}).items():
+                    if w in fresh:
+                        row["verdict-freshness-s"] = \
+                            fresh[w]["freshness-s"]
+                        row["live-sessions"] = fresh[w]["sessions"]
+            except Exception:  # noqa: BLE001 — decorative join
+                logger.debug("verifier freshness join failed",
+                             exc_info=True)
+        return code, doc
+
     def _fleet_status(self):
         if self.fleet is None:
             return self._send_json(
                 404, {"error": "no fleet coordinator (start with "
                       "`fleet serve <spec.json>`)"})
-        code, doc = self.fleet.status()
+        code, doc = self._fleet_status_doc()
         self._send_json(code, doc)
 
     def _fleet_page(self):
@@ -1238,10 +1284,17 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
             return self._send(404, b"no fleet coordinator (start with "
                               b"`fleet serve <spec.json>`)",
                               "text/plain")
-        code, s = self.fleet.status()
+        code, s = self._fleet_status_doc()
         if code != 200:
             return self._send_json(code, s)
         c = s.get("counts") or {}
+
+        def _fresh_cell(d):
+            f = d.get("verdict-freshness-s")
+            if f is None:
+                return "&mdash;"
+            n = d.get("live-sessions")
+            return (f"{f}s over {n} session(s)" if n else f"{f}s")
 
         def _wwin(d):
             """Installed-window cell: digest + open positions, red when
@@ -1266,6 +1319,7 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
             f"<td>{d.get('device-slots')}</td>"
             f"<td>{d.get('age-s')}s</td>"
             f"<td>{'alive' if d.get('alive') else 'silent'}</td>"
+            f"<td>{_fresh_cell(d)}</td>"
             f"<td>{_wwin(d)}</td></tr>"
             for w, d in sorted((s.get("workers") or {}).items()))
         lrows = "".join(
@@ -1319,12 +1373,98 @@ completions discarded &middot; queue digest
 <h2>workers</h2>
 <table><tr><th>worker</th><th>host</th><th>backend</th>
 <th>device slots</th><th>last seen</th><th></th>
+<th>verdict freshness</th>
 <th>installed windows</th></tr>{wrows or
-'<tr><td colspan="7">(none registered)</td></tr>'}</table>
+'<tr><td colspan="8">(none registered)</td></tr>'}</table>
 <h2>active leases</h2>
 <table><tr><th>run</th><th>worker</th><th>deadline</th></tr>{lrows or
 '<tr><td colspan="3">(none)</td></tr>'}</table>
 {sched_html}
+</body></html>"""
+        self._send(200, doc.encode())
+
+    def _timeline(self, key: str):
+        """``/timeline/<run-id>`` (ISSUE 14 tentpole c): the run's
+        stitched cross-host waterfall — enqueue wait, claim latency,
+        execute phases, live-sweep overlap, upload, landing — one bar
+        per host-attributed segment on absolute time, from the
+        warehouse's ``trace_spans`` view (`cli obs ingest` feeds it)."""
+        from .telemetry import warehouse as wmod
+
+        key = unquote(key).rstrip("/")
+        if not key:
+            return self._send(404, b"timeline needs a run id",
+                              "text/plain")
+        wh = wmod.open_if_exists(self.base)
+        if wh is None:
+            return self._send(
+                404, b"no warehouse (run `cli obs ingest` first)",
+                "text/plain")
+        tl = wh.trace_timeline(key)
+        if not tl.get("spans") and not tl.get("orphans"):
+            return self._send(
+                404, b"no trace spans for this run (run `cli obs "
+                b"ingest` after it lands; traced runs need telemetry "
+                b"or a fleet ledger)", "text/plain")
+        lay = wmod.Warehouse.timeline_layout(tl)
+        spans, hosts, wall = lay["spans"], lay["hosts"], lay["wall"]
+        palette = ("#6b8fc9", "#74b474", "#c9a35a", "#b07fc9",
+                   "#c97b7b", "#6bbcbc")
+        color = {h: palette[i % len(palette)]
+                 for i, h in enumerate(hosts)}
+        rows = []
+        for s in spans:
+            dur = s.get("dur_s") or 0.0
+            left = 100.0 * s["frac_left"]
+            width = max(100.0 * s["frac_width"], 0.3)
+            host = str(s.get("host") or "-")
+            rows.append(
+                "<tr>"
+                f"<td><code>{html.escape(host)}</code></td>"
+                f"<td><code>{html.escape(str(s.get('name')))}</code>"
+                f"</td><td>{s['off']:+.3f}s</td><td>{dur:.3f}s</td>"
+                f'<td class="lane"><div class="bar" style="margin-left:'
+                f"{left:.2f}%;width:{min(width, 100.0 - left):.2f}%;"
+                f'background:{color.get(host, "#999")}"></div></td>'
+                "</tr>")
+        orphans = tl.get("orphans") or []
+        orphan_html = ""
+        if orphans:
+            items = "".join(
+                f"<li><code>{html.escape(str(o.get('trace_id')))}"
+                f"</code> {html.escape(str(o.get('name')))} "
+                f"host={html.escape(str(o.get('host')))}</li>"
+                for o in orphans)
+            orphan_html = (
+                '<h2 style="color:#b03030">orphan spans</h2>'
+                "<p>recorded against this run under a DIFFERENT trace "
+                "id — the stitching contract (one run, one trace) is "
+                f"broken</p><ul>{items}</ul>")
+        legend = " ".join(
+            f'<span class="b" style="background:{color[h]}">'
+            f"{html.escape(h)}</span>" for h in hosts)
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>timeline — {html.escape(key)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; width: 100%; }}
+td, th {{ border: 1px solid #bbb; padding: 3px 8px;
+          white-space: nowrap; }}
+td.lane {{ width: 55%; background: #f6f6f6; }}
+.bar {{ height: 12px; border-radius: 2px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/">&larr; runs</a></p>
+<h1>timeline — <code>{html.escape(str(tl.get("run") or key))}</code></h1>
+<p>trace <code>{html.escape(str(tl["trace-id"]))}</code> &middot;
+{len(spans)} spans over {len(hosts) or 1} host(s) &middot;
+{wall:.3f}s wall &middot; {legend}</p>
+<table><tr><th>host</th><th>segment</th><th>start</th><th>dur</th>
+<th>timeline</th></tr>{"".join(rows)}</table>
+{orphan_html}
+<p>per-segment durations are queryable: <code>cli obs sql "SELECT
+host, name, dur_s FROM trace_spans WHERE run = '{html.escape(str(
+    tl.get("run") or key))}' ORDER BY t0"</code>; gate control-plane
+segments like any span: <code>cli obs gate --span
+fleet:claim-to-start</code> (docs/TELEMETRY.md)</p>
 </body></html>"""
         self._send(200, doc.encode())
 

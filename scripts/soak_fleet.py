@@ -284,6 +284,10 @@ def federation_round(args, env) -> list:
         "seeds": list(range(cells)),
         "opts": {"time-limit": None, "ops": 240, "concurrency": 3,
                  "client-latency": 0.002,
+                 # telemetry on: the uploaded run dirs then carry the
+                 # trace-stamped telemetry.json the timeline assertion
+                 # (ISSUE 14) stitches host-attributed phases from
+                 "telemetry": True,
                  # the live stream must ride out the coordinator's
                  # kill -9 + restart window: generous outage budget
                  "live-check": {"url": url, "budget-s": 20.0,
@@ -427,6 +431,55 @@ def federation_round(args, env) -> list:
                 if got_verdicts.get(k) != ref_verdicts.get(k)}
         failures.append(f"federation: live-checked fleet != "
                         f"single-process stored-history: {diff}")
+    # -- timeline completeness (ISSUE 14 acceptance): the coordinator
+    # AND the verifier died kill -9 mid-campaign above, yet every
+    # relanded/replayed run's stitched timeline must carry ONE trace
+    # id (derived from the stable run id) with zero orphan spans, and
+    # cover the control-plane + execute + upload story end to end
+    from jepsen_tpu.telemetry import spans as spans_mod
+    from jepsen_tpu.telemetry import warehouse as wmod
+
+    wh = wmod.open_or_create(cbase)
+    wh.ingest_store(cbase)
+    stitched = 0
+    for rec in got.values():
+        run = rec.get("run")
+        tl = wh.trace_timeline(run)
+        want = spans_mod.trace_id_for(run)
+        tids = {s["trace_id"] for s in tl["spans"]}
+        if tl["orphans"] or (tids and tids != {want}):
+            failures.append(
+                f"federation: timeline for {run} is not single-trace: "
+                f"{len(tl['orphans'])} orphan span(s), trace ids "
+                f"{sorted(tids | {o['trace_id'] for o in tl['orphans']})}")
+            continue
+        names = {s["name"] for s in tl["spans"]}
+        need = {"fleet:enqueue-wait", "fleet:claim-to-start",
+                "fleet:execute", "fleet:upload", "run:workload"}
+        missing = need - names
+        if missing:
+            failures.append(
+                f"federation: timeline for {run} is missing "
+                f"segments {sorted(missing)} (has {sorted(names)})")
+            continue
+        if rec.get("trace") != want:
+            failures.append(
+                f"federation: index record for {run} carries trace "
+                f"{rec.get('trace')} != derived {want}")
+            continue
+        stitched += 1
+    if stitched == 0:
+        failures.append("federation: no run produced a complete "
+                        "stitched timeline")
+    # live-sweep overlap: at least the sealed (non-degraded) sessions
+    # must contribute trace-stitched live-session segments
+    live_segs = wh.query(
+        "SELECT COUNT(*) FROM trace_spans "
+        "WHERE name = 'verifier:live-session'")[1][0][0]
+    if live_stats["ok"] and not live_segs:
+        failures.append(
+            f"federation: {live_stats['ok']} ok live sessions but no "
+            "verifier:live-session trace segments stitched")
     if not failures:
         print(f"federation round OK: {cells} live-checked cells over "
               f"{n_workers} workers on private bases (no shared "
@@ -434,7 +487,10 @@ def federation_round(args, env) -> list:
               f"{live_stats['ok']} live sessions sealed incremental "
               f"== batch ({live_stats['degraded']} degraded to "
               f"stored-history), every run dir landed on the "
-              f"coordinator, verdicts == single-process")
+              f"coordinator, verdicts == single-process; "
+              f"{stitched}/{cells} stitched timelines single-trace "
+              f"with zero orphan spans ({live_segs} live-session "
+              f"segments)")
         shutil.rmtree(cbase, ignore_errors=True)
         shutil.rmtree(ref_base, ignore_errors=True)
         for d in wbases.values():

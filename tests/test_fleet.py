@@ -858,6 +858,10 @@ def test_fleet_soak_fast_chaos_acceptance():
     # the ISSUE 13 federation + live-check round rode along
     assert "federation round OK" in proc.stdout
     assert "no shared " in proc.stdout
+    # ISSUE 14: trace ids survive chaos — the relanded/replayed runs'
+    # stitched timelines carry ONE trace id with zero orphan spans
+    assert "stitched timelines single-trace" in proc.stdout
+    assert "zero orphan spans" in proc.stdout
 
 
 # ------------------------- store federation: artifact uploads (ISSUE 13)

@@ -1,0 +1,585 @@
+"""Fleet-wide observability (ISSUE 14).
+
+The tentpole contracts under test:
+
+- **distributed trace context**: trace ids are a pure function of the
+  run id (stable across retries/resends), travel the control plane in
+  the ``Jepsen-Trace`` header, and land in span attrs, telemetry.json,
+  index records, verifier session metadata, and the warehouse;
+- **metrics federation**: workers push metric snapshots on the
+  heartbeat channel; the coordinator's /metrics re-exposes them with
+  ``host=`` labels plus fleet rollups, and the series RETIRE with
+  worker liveness (cardinality stays flat under register/expire
+  churn);
+- **timeline stitching**: the warehouse's ``trace_spans`` view stitches
+  fleet ledgers, run telemetry, and verifier sessions into one
+  host-attributed waterfall per run (`cli obs timeline`, web
+  ``/timeline/<run-id>``), with orphan detection;
+- satellites: compile-cost attribution on device_call spans,
+  artifact-staging GC, per-host verdict freshness on /fleet.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.telemetry import spans as spans_mod
+from jepsen_tpu.telemetry import warehouse as wmod
+
+
+# ------------------------------------------------- trace context core
+
+def test_trace_id_is_pure_function_of_run_id():
+    a = spans_mod.trace_id_for("append-s0-abc")
+    assert a == spans_mod.trace_id_for("append-s0-abc")
+    assert a != spans_mod.trace_id_for("append-s1-abc")
+    assert len(a) == 32 and int(a, 16) >= 0
+
+
+def test_mint_parse_header_round_trip():
+    ctx = spans_mod.mint_trace("run-1")
+    hdr = ctx.header()
+    assert hdr == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = spans_mod.parse_trace_header(hdr)
+    assert back is not None
+    assert back.trace_id == ctx.trace_id
+    assert back.parent_id == ctx.span_id
+    # malformed headers parse to None, never raise
+    for bad in (None, "", "zz", "00-short-x-01", "00-" + "g" * 32
+                + "-" + "0" * 16 + "-01"):
+        assert spans_mod.parse_trace_header(bad) is None
+
+
+def test_child_and_segment_contexts_deterministic():
+    ctx = spans_mod.mint_trace("run-1")
+    c1, c2 = ctx.child("claim"), ctx.child("claim")
+    assert c1.span_id == c2.span_id and c1.parent_id == ctx.span_id
+    seg = spans_mod.trace_context(ctx.trace_id, "run")
+    assert seg.trace_id == ctx.trace_id
+    assert seg.span_id != ctx.span_id
+
+
+def test_trace_scope_is_thread_local_and_restores():
+    assert spans_mod.current_trace() is None
+    ctx = spans_mod.mint_trace("run-1")
+    with spans_mod.trace_scope(ctx):
+        assert spans_mod.current_trace() is ctx
+        with spans_mod.trace_scope(None):
+            assert spans_mod.current_trace() is None
+        assert spans_mod.current_trace() is ctx
+    assert spans_mod.current_trace() is None
+
+
+def test_collector_stamps_trace_on_roots_and_snapshot():
+    from jepsen_tpu.telemetry import export as tel_export
+
+    coll = telemetry.Collector()
+    coll.trace = spans_mod.trace_context(
+        spans_mod.trace_id_for("run-1"), "run")
+    with coll.span("run"):
+        with coll.span("inner"):
+            pass
+    doc = tel_export.snapshot(coll)
+    assert doc["trace"]["trace-id"] == spans_mod.trace_id_for("run-1")
+    root = doc["spans"][0]
+    assert root["attrs"]["trace_id"] == coll.trace.trace_id
+    assert "trace_id" not in root["children"][0]["attrs"]
+
+
+def test_core_run_derives_trace_from_campaign_run_id(tmp_path):
+    from jepsen_tpu import core
+
+    t = core.noop_test(name="tr")
+    t["store-dir"] = str(tmp_path)
+    t["telemetry"] = True
+    t["campaign-run-id"] = "cell-7"
+    done = core.run(t)
+    d = __import__("jepsen_tpu.store", fromlist=["store"]).test_dir(done)
+    with open(os.path.join(d, "telemetry.json")) as f:
+        doc = json.load(f)
+    assert doc["trace"]["trace-id"] == spans_mod.trace_id_for("cell-7")
+    assert doc["meta"]["host"]
+    assert doc["meta"]["run-id"] == "cell-7"
+
+
+# --------------------------------------- compile-cost groundwork
+
+def test_compile_vs_execute_attribution_on_device_call_spans():
+    import numpy as np
+
+    from jepsen_tpu import resilience
+    from jepsen_tpu.resilience import guard
+
+    guard.reset_compile_cache_stats()
+    coll = telemetry.activate()
+    try:
+        x = np.zeros((4, 4))
+        with coll.span("device-site") as sp:
+            resilience.device_call("obs.test", lambda v: v, x)
+            assert "compile_s" in sp.attrs and "execute_s" not in sp.attrs
+            resilience.device_call("obs.test", lambda v: v, x)
+            assert "execute_s" in sp.attrs
+            # a NEW shape is a fresh miss
+            resilience.device_call("obs.test", lambda v: v,
+                                   np.zeros((8, 4)))
+        st = guard.compile_cache_stats()
+        assert st == {"entries": 2, "misses": 2}
+        reg = telemetry.registry()
+        assert reg.gauge("jit-cache-entries").value == 2
+        assert reg.counter("compile-cache-miss", site="obs.test").value \
+            == 2
+    finally:
+        telemetry.deactivate(coll)
+        guard.reset_compile_cache_stats()
+
+
+# --------------------------------------------- metrics federation
+
+class _FakeQueue:
+    def counts(self):
+        return {"queued": 0, "claimed": 0, "done": 0}
+
+
+def _mk_coordinator(tmp_path, lease_s=5.0):
+    from jepsen_tpu.fleet import FleetCoordinator
+
+    spec = {"name": "fed", "workloads": ["noop"], "seeds": [0],
+            "opts": {}}
+    return FleetCoordinator(spec, str(tmp_path), lease_s=lease_s)
+
+
+def _hb(coord, worker, rows):
+    code, out = coord.heartbeat({"worker": worker, "metrics": rows})
+    assert code == 200, out
+
+
+def test_federated_metrics_host_labels_rollups_and_retirement(tmp_path):
+    from jepsen_tpu.telemetry import prometheus as prom
+
+    coord = _mk_coordinator(tmp_path, lease_s=0.2)
+    coord.register({"worker": "w1", "host": "h1"})
+    coord.register({"worker": "w2", "host": "h2"})
+    rows = [{"name": "worker-cells-done", "kind": "counter",
+             "labels": {}, "value": 3},
+            {"name": "worker-rss-bytes", "kind": "gauge",
+             "labels": {}, "value": 1000.0}]
+    _hb(coord, "w1", rows)
+    _hb(coord, "w2", [dict(rows[0], value=5)])
+    expo = prom.exposition(base=str(tmp_path), fleet=coord)
+    assert ('jepsen_fleet_host_worker_cells_done_total{host="w1"} 3'
+            in expo)
+    assert ('jepsen_fleet_host_worker_cells_done_total{host="w2"} 5'
+            in expo)
+    assert "jepsen_fleet_rollup_worker_cells_done_total 8" in expo
+    assert 'jepsen_fleet_host_worker_rss_bytes{host="w1"} 1000' in expo
+    assert "jepsen_fleet_fed_workers_reporting 2" in expo
+    # liveness retirement: silence both workers past ALIVE_LEASES —
+    # their series stop rendering without any explicit removal call
+    with coord._lock:
+        for c in coord.workers.values():
+            c["last-seen"] -= 10.0
+    expo = prom.exposition(base=str(tmp_path), fleet=coord)
+    assert "jepsen_fleet_host_" not in expo
+    assert "jepsen_fleet_fed_workers_reporting 0" in expo
+
+
+def test_federation_cardinality_flat_under_worker_churn(tmp_path):
+    """Satellite (CI): series count stays FLAT as workers churn
+    through register/expire cycles — the exposition never grows with
+    the number of workers that EVER existed, and the worker table
+    itself is pruned past PRUNE_LEASES."""
+    from jepsen_tpu.fleet import coordinator as coord_mod
+    from jepsen_tpu.telemetry import prometheus as prom
+
+    coord = _mk_coordinator(tmp_path, lease_s=0.05)
+    counts = []
+    for gen in range(6):
+        name = f"churn-{gen}"
+        coord.register({"worker": name, "host": name})
+        _hb(coord, name, [{"name": "worker-cells-done",
+                           "kind": "counter", "labels": {},
+                           "value": gen}])
+        expo = prom.exposition(base=str(tmp_path), fleet=coord)
+        counts.append(sum(1 for ln in expo.splitlines()
+                          if ln.startswith("jepsen_fleet_host_")
+                          and not ln.startswith("#")))
+        # expire this generation before the next registers
+        with coord._lock:
+            for c in coord.workers.values():
+                c["last-seen"] -= 100 * coord_mod.PRUNE_LEASES
+    assert counts == [counts[0]] * len(counts), counts
+    coord._update_gauges()  # prune pass
+    with coord._lock:
+        assert not coord.workers  # every churned worker pruned
+
+
+def test_worker_metrics_snapshot_shape_and_cap(tmp_path):
+    from jepsen_tpu.fleet import FleetWorker
+    from jepsen_tpu.fleet.worker import MAX_PUSHED_SERIES
+
+    w = FleetWorker("http://127.0.0.1:1", str(tmp_path), name="w")
+    rows = w.metrics_snapshot()
+    assert 0 < len(rows) <= MAX_PUSHED_SERIES
+    names = {r["name"] for r in rows}
+    assert {"worker-cells-done", "worker-uploads-done",
+            "jit-cache-entries", "compile-cache-miss"} <= names
+    for r in rows:
+        assert r["kind"] in ("counter", "gauge")
+        assert isinstance(r["value"], (int, float))
+        assert isinstance(r["labels"], dict)
+
+
+# ------------------------------------------------ staging GC
+
+def test_artifact_staging_gc_expires_abandoned_partials(tmp_path):
+    from jepsen_tpu.fleet.artifacts import ArtifactStore
+
+    st = ArtifactStore(str(tmp_path))
+    os.makedirs(st.staging, exist_ok=True)
+    now = time.time()
+
+    def stage(run_id, started, landed=False):
+        with open(os.path.join(st.staging, run_id + ".tar"), "wb") as f:
+            f.write(b"x" * 64)
+        os.utime(os.path.join(st.staging, run_id + ".tar"),
+                 (started, started))
+        doc = {"run": run_id, "total": 128, "digest": "d",
+               "rel": "a/t", "started": started}
+        if landed:
+            doc["landed"] = True
+            doc["landed-at"] = started
+        with open(os.path.join(st.staging, run_id + ".json"), "w") as f:
+            json.dump(doc, f)
+
+    stage("old-abandoned", now - 1000)
+    stage("old-landed-marker", now - 1000, landed=True)
+    stage("fresh", now - 10)
+    out = st.gc(retention_s=100, now=now)
+    assert out["removed"] == 2
+    left = sorted(os.listdir(st.staging))
+    assert left == ["fresh.json", "fresh.tar"]
+    assert out["staging-bytes"] > 0
+    assert telemetry.registry().gauge(
+        "fleet-artifact-staging-bytes").value == out["staging-bytes"]
+    # everything fresh: nothing removed, gauge still refreshed
+    assert st.gc(retention_s=100, now=now)["removed"] == 0
+
+
+# ----------------------------------------- warehouse timeline stitching
+
+def _write_fleet_ledger(base, name="fl", run="r-0", worker="w0",
+                        t0=1000.0, spans=None, requeue=False):
+    d = os.path.join(str(base), "fleet")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, name + ".jsonl")
+    evs = [{"ev": "enqueue", "run": run, "ts": t0}]
+    t = t0 + 0.5
+    if requeue:
+        evs.append({"ev": "claim", "run": run, "worker": "dead",
+                    "ts": t})
+        evs.append({"ev": "requeue", "run": run, "worker": "dead",
+                    "reason": "lease-expired", "ts": t + 1.0})
+        t += 1.5
+    evs.append({"ev": "claim", "run": run, "worker": worker, "ts": t})
+    rec = {"run": run, "valid?": True}
+    if spans:
+        rec["spans"] = spans
+    evs.append({"ev": "complete", "run": run, "worker": worker,
+                "record": rec, "ts": t + 2.0})
+    with open(path, "a") as f:
+        for ev in evs:
+            f.write(json.dumps(ev) + "\n")
+    return path
+
+
+def test_fleet_ledger_stitches_trace_segments(tmp_path):
+    path = _write_fleet_ledger(
+        tmp_path, run="cell-1", worker="w0", requeue=True,
+        spans={"fleet:claim-to-start": 0.25, "fleet:upload": 0.5,
+               "check:la": 1.0})
+    wh = wmod.open_or_create(str(tmp_path))
+    wh.ingest_fleet_ledger(path, str(tmp_path))
+    tl = wh.trace_timeline("cell-1")
+    assert tl["trace-id"] == spans_mod.trace_id_for("cell-1")
+    assert not tl["orphans"]
+    by_name = {s["name"]: s for s in tl["spans"]}
+    assert by_name["fleet:enqueue-wait"]["dur_s"] == 0.5
+    assert by_name["fleet:attempt"]["host"] == "dead"
+    assert by_name["fleet:execute"]["host"] == "w0"
+    assert by_name["fleet:execute"]["dur_s"] == 2.0
+    assert by_name["fleet:claim-to-start"]["dur_s"] == 0.25
+    assert by_name["fleet:upload"]["dur_s"] == 0.5
+    # non-fleet spans from the record do NOT leak into the timeline
+    assert "check:la" not in by_name
+    # re-ingest is idempotent (recompute, not accumulate)
+    wh.ingest_fleet_ledger(path, str(tmp_path))
+    _write_fleet_ledger(tmp_path, run="cell-2", t0=2000.0)
+    wh.ingest_fleet_ledger(path, str(tmp_path))
+    tl = wh.trace_timeline("cell-1")
+    assert len(tl["spans"]) == len(by_name)
+
+
+def test_run_dir_trace_rows_on_absolute_time(tmp_path):
+    d = os.path.join(str(tmp_path), "a-test", "t1")
+    os.makedirs(d)
+    tid = spans_mod.trace_id_for("cell-9")
+    doc = {
+        "version": 1, "epoch_ns": 1_000_000_000_000,
+        "perf0_ns": 500_000,
+        "meta": {"name": "a-test", "host": "hostA",
+                 "run-id": "cell-9"},
+        "trace": {"trace-id": tid, "span-id": "s" * 16},
+        "spans": [{"name": "run", "t0_ns": 500_000,
+                   "dur_ns": 2_000_000_000, "attrs": {},
+                   "children": [
+                       {"name": "workload", "t0_ns": 600_000,
+                        "dur_ns": 1_000_000_000, "attrs": {},
+                        "children": [
+                            {"name": "leaf", "t0_ns": 700_000,
+                             "dur_ns": 1, "attrs": {},
+                             "children": []}]}]}],
+        "metrics": {"counters": [], "gauges": [], "histograms": []},
+    }
+    with open(os.path.join(d, "telemetry.json"), "w") as f:
+        json.dump(doc, f)
+    with open(os.path.join(d, "results.json"), "w") as f:
+        json.dump({"valid?": True}, f)
+    wh = wmod.open_or_create(str(tmp_path))
+    wh.ingest_run_dir(d, str(tmp_path))
+    tl = wh.trace_timeline("cell-9")
+    by_name = {s["name"]: s for s in tl["spans"]}
+    assert set(by_name) == {"run", "run:workload"}  # leaves excluded
+    assert by_name["run"]["t0"] == 1000.0
+    assert by_name["run"]["dur_s"] == 2.0
+    assert by_name["run"]["host"] == "hostA"
+    assert by_name["run:workload"]["run"] == "cell-9"
+
+
+def test_orphan_spans_detected(tmp_path):
+    # two ledgers complete the SAME run id... impossible via one
+    # queue, but a mis-stitched artifact (wrong trace id) must show
+    path = _write_fleet_ledger(tmp_path, run="cell-1")
+    wh = wmod.open_or_create(str(tmp_path))
+    wh.ingest_fleet_ledger(path, str(tmp_path))
+    with wh._lock, wh.db:
+        wh.db.execute(
+            "INSERT INTO trace_spans(trace_id, origin, source, run, "
+            "host, name, t0, t1, dur_s) VALUES (?, ?, ?, ?, ?, ?, ?, "
+            "?, ?)",
+            ("f" * 32, "bogus", "run", "cell-1", "hX", "run", 1000.0,
+             1001.0, 1.0))
+    tl = wh.trace_timeline("cell-1")
+    assert len(tl["orphans"]) == 1
+    assert tl["orphans"][0]["trace_id"] == "f" * 32
+    assert all(s["trace_id"] == tl["trace-id"] for s in tl["spans"])
+
+
+def test_verifier_session_snapshot_stitches(tmp_path):
+    tid = spans_mod.trace_id_for("cell-3")
+    vdir = os.path.join(str(tmp_path), "verifier", "s3")
+    os.makedirs(vdir)
+    with open(os.path.join(vdir, "session.json"), "w") as f:
+        json.dump({"session": "s3", "state": "sealed", "opened": 100.0,
+                   "updated": 105.5, "txns": 4, "ops": 10,
+                   "segments": 1,
+                   "config": {"trace-id": tid, "host": "w7"}}, f)
+    wh = wmod.open_or_create(str(tmp_path))
+    assert wh.ingest_verifier_sessions(str(tmp_path)) == 1
+    tl = wh.trace_timeline("cell-3")
+    assert [s["name"] for s in tl["spans"]] == ["verifier:live-session"]
+    s = tl["spans"][0]
+    assert s["host"] == "w7" and s["dur_s"] == 5.5
+    # re-ingest upserts (no duplicate segments)
+    wh.ingest_verifier_sessions(str(tmp_path))
+    assert len(wh.trace_timeline("cell-3")["spans"]) == 1
+
+
+def test_cli_obs_timeline_renders_and_flags_orphans(tmp_path, capsys):
+    from jepsen_tpu import cli
+
+    path = _write_fleet_ledger(tmp_path, run="cell-1", worker="w0")
+    wh = wmod.open_or_create(str(tmp_path))
+    wh.ingest_fleet_ledger(path, str(tmp_path))
+    disp = cli.single_test_cmd(lambda o: {})
+    argv = ["--store-dir", str(tmp_path), "obs", "timeline"]
+    assert cli.run(disp, argv + ["cell-1"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet:enqueue-wait" in out and "fleet:execute" in out
+    assert spans_mod.trace_id_for("cell-1") in out
+    # a trace id works as the key too
+    assert cli.run(disp, argv
+                   + [spans_mod.trace_id_for("cell-1")]) == 0
+    capsys.readouterr()
+    assert cli.run(disp, argv + ["no-such-run"]) == 2
+    # orphans flip the exit code red
+    with wh._lock, wh.db:
+        wh.db.execute(
+            "INSERT INTO trace_spans(trace_id, origin, source, run, "
+            "host, name, t0, t1, dur_s) VALUES (?, ?, ?, ?, ?, ?, ?, "
+            "?, ?)", ("e" * 32, "bogus", "run", "cell-1", None, "x",
+                      1000.0, 1001.0, 1.0))
+    capsys.readouterr()
+    assert cli.run(disp, argv + ["cell-1"]) == 1
+    assert "ORPHAN" in capsys.readouterr().out
+
+
+def test_timeline_with_only_orphan_spans_reports_not_crashes(
+        tmp_path, capsys):
+    """A run whose every artifact disagrees with the derived trace id
+    lays out ZERO stitched spans — the renderers must show the orphan
+    diagnostic (exit 1 / the red section), not die on min() of an
+    empty sequence."""
+    from jepsen_tpu import cli
+
+    wh = wmod.open_or_create(str(tmp_path))
+    with wh._lock, wh.db:
+        wh.db.execute(
+            "INSERT INTO trace_spans(trace_id, origin, source, run, "
+            "host, name, t0, t1, dur_s) VALUES (?, ?, ?, ?, ?, ?, ?, "
+            "?, ?)", ("d" * 32, "bogus", "run", "lonely", "h", "run",
+                      1000.0, 1001.0, 1.0))
+    tl = wh.trace_timeline("lonely")
+    assert not tl["spans"] and len(tl["orphans"]) == 1
+    lay = wmod.Warehouse.timeline_layout(tl)
+    assert lay["spans"] == [] and lay["hosts"] == []
+    disp = cli.single_test_cmd(lambda o: {})
+    rc = cli.run(disp, ["--store-dir", str(tmp_path), "obs",
+                        "timeline", "lonely"])
+    assert rc == 1
+    assert "ORPHAN" in capsys.readouterr().out
+
+
+def test_compile_attribution_lands_on_the_attempt_that_succeeds():
+    """A transient failure on a shape's first attempt must NOT consume
+    the first-sighting: the retry that actually pays the compile is
+    the one booked as compile_s / compile-cache-miss."""
+    import numpy as np
+
+    from jepsen_tpu import resilience
+    from jepsen_tpu.resilience import RetryPolicy, guard
+
+    guard.reset_compile_cache_stats()
+    coll = telemetry.activate()
+    try:
+        x = np.zeros((3, 3))
+        calls = {"n": 0}
+
+        def flaky(v):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                e = RuntimeError("RESOURCE_EXHAUSTED: transient")
+                e.transient = True  # the classifier's explicit verdict
+                raise e
+            return v
+
+        pol = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                          max_delay_s=0.0)
+        with coll.span("site") as sp:
+            resilience.device_call("obs.flaky", flaky, x, policy=pol)
+        assert calls["n"] == 2
+        assert "compile_s" in sp.attrs  # the SUCCESSFUL attempt's wall
+        assert guard.compile_cache_stats()["misses"] == 1
+    finally:
+        telemetry.deactivate(coll)
+        guard.reset_compile_cache_stats()
+
+
+# --------------------------------- the trace across a live fleet seam
+
+@pytest.fixture()
+def fleet_server(tmp_path):
+    from jepsen_tpu import web
+    from jepsen_tpu.fleet import FleetCoordinator
+    from jepsen_tpu.verifier import VerifierService
+
+    spec = {"name": "obsfl", "workloads": ["set"], "seeds": [0],
+            "opts": {"time-limit": 0.1, "telemetry": True}}
+    coord = FleetCoordinator(spec, str(tmp_path), lease_s=10.0)
+    ver = VerifierService(str(tmp_path))
+    srv = web.serve(port=0, base=str(tmp_path), background=True,
+                    fleet=coord, verifier=ver)
+    try:
+        yield coord, ver, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.server_close()
+        ver.close()
+        coord.close()
+
+
+def test_fleet_worker_end_to_end_single_trace(fleet_server, tmp_path):
+    """One cell through a real coordinator + worker over HTTP: the
+    claim carries the trace, the record carries it, the run dir's
+    telemetry carries it, gateable ``fleet:*`` spans land on the
+    record, and the stitched timeline is single-trace with zero
+    orphans (the acceptance, in-process edition)."""
+    coord, _ver, _srv, url = fleet_server
+    from jepsen_tpu.fleet import FleetWorker
+
+    w = FleetWorker(url, str(tmp_path), name="obs-w0", poll_s=0.05)
+    assert w.run() == 1
+    run_id = next(iter(coord._done_ids))
+    want = spans_mod.trace_id_for(run_id)
+    rec = coord.idx.latest_by_run()[run_id]
+    assert rec["trace"] == want
+    assert "fleet:claim-to-start" in rec["spans"]
+    assert "fleet:enqueue-wait" in rec["spans"]
+    wh = wmod.open_or_create(str(tmp_path))
+    wh.ingest_store(str(tmp_path))
+    tl = wh.trace_timeline(run_id)
+    assert tl["trace-id"] == want and not tl["orphans"]
+    names = {s["name"] for s in tl["spans"]}
+    assert {"fleet:enqueue-wait", "fleet:claim-to-start",
+            "fleet:execute", "run:workload"} <= names
+    assert {s["trace_id"] for s in tl["spans"]} == {want}
+    # one worker = ONE timeline lane: the run dir's telemetry carries
+    # the fleet worker name as its host, same as the ledger segments
+    assert {s["host"] for s in tl["spans"]
+            if s["source"] == "run"} == {"obs-w0"}
+    # the web waterfall renders it
+    with urllib.request.urlopen(f"{url}/timeline/{run_id}") as r:
+        page = r.read().decode()
+    assert want in page and "fleet:execute" in page
+
+
+def test_verifier_adopts_trace_from_header(fleet_server, tmp_path):
+    _coord, ver, _srv, url = fleet_server
+    ctx = spans_mod.mint_trace("cell-x")
+    req = urllib.request.Request(
+        f"{url}/ingest/hsess?cursor=0",
+        data=b'{"type": "invoke", "process": 0, "f": "txn", '
+             b'"value": []}\n',
+        headers={spans_mod.TRACE_HEADER: ctx.header()}, method="POST")
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read().decode())["ops"] == 1
+    sessions = {s["session"]: s for s in ver.sessions()}
+    assert sessions["hsess"]["config"]["trace-id"] == ctx.trace_id
+    # persisted into the on-disk session.json (journal session meta)
+    with open(os.path.join(str(tmp_path), "verifier", "hsess",
+                           "session.json")) as f:
+        assert json.load(f)["config"]["trace-id"] == ctx.trace_id
+
+
+def test_fleet_status_surfaces_verdict_freshness_per_host(
+        fleet_server, tmp_path):
+    coord, ver, _srv, url = fleet_server
+    coord.register({"worker": "fw1", "host": "fw1"})
+    code, _ = ver.open("livesess", {"host": "fw1"})
+    assert code == 200
+    code, _ = ver.ingest(
+        "livesess",
+        b'{"type": "invoke", "process": 0, "f": "txn", "value": []}\n')
+    assert code == 200
+    with urllib.request.urlopen(url + "/fleet/status") as r:
+        doc = json.loads(r.read().decode())
+    assert "fw1" in doc["verifier-freshness"]
+    row = doc["workers"]["fw1"]
+    assert isinstance(row["verdict-freshness-s"], (int, float))
+    assert row["live-sessions"] == 1
+    # the HTML dashboard shows the column
+    with urllib.request.urlopen(url + "/fleet") as r:
+        page = r.read().decode()
+    assert "verdict freshness" in page

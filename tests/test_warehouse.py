@@ -684,11 +684,32 @@ GOLDEN = os.path.join(os.path.dirname(__file__), "data",
                       "prometheus-golden.txt")
 
 
+class _GoldenFleet:
+    """A deterministic stand-in for the coordinator's federated-
+    metrics surface (ISSUE 14): two alive workers' pushed snapshots."""
+
+    def federated_metrics(self):
+        return {
+            "w1": {"host": "h1", "age-s": 1.0, "rows": [
+                {"name": "worker-cells-done", "kind": "counter",
+                 "labels": {}, "value": 3},
+                {"name": "jit-cache-entries", "kind": "gauge",
+                 "labels": {}, "value": 7},
+            ]},
+            "w2": {"host": "h2", "age-s": 2.0, "rows": [
+                {"name": "worker-cells-done", "kind": "counter",
+                 "labels": {}, "value": 5},
+                {"name": "jit-cache-entries", "kind": "gauge",
+                 "labels": {}, "value": 4},
+            ]},
+        }
+
+
 def _golden_exposition(base):
     """A deterministic exposition: fixed registry (including the ISSUE 7
-    verifier instruments), one heartbeat at a pinned age, and a
-    warehouse with one ledger + one running run + one verifier session
-    + one bench row."""
+    verifier instruments), the ISSUE 14 federated fleet series, one
+    heartbeat at a pinned age, and a warehouse with one ledger + one
+    running run + one verifier session + one bench row."""
     reg = metrics.Registry()
     reg.counter("ops-invoked", worker=0).inc(42)
     reg.counter("resilience-faults-injected", site="elle.infer").inc(3)
@@ -732,6 +753,11 @@ def _golden_exposition(base):
     reg.gauge("fleet-nemesis-windows-active", campaign="soak",
               fault="partition").set(0)
     reg.counter("fleet-affinity-deferrals", worker="w1").inc(3)
+    # fleet observability (ISSUE 14): staging retention + compile-cost
+    # groundwork gauges on the coordinator/worker registries
+    reg.gauge("fleet-artifact-staging-bytes").set(4096)
+    reg.gauge("jit-cache-entries").set(11)
+    reg.counter("compile-cache-miss", site="elle.infer").inc(2)
     cdir = os.path.join(str(base), "campaigns")
     os.makedirs(cdir, exist_ok=True)
     with open(os.path.join(cdir, "soak.live.json"), "w") as f:
@@ -755,7 +781,7 @@ def _golden_exposition(base):
                      "unit": "ops/s", "n_txns": 1000000,
                      "backend": "cpu"}, "BENCH_r05.json")
     return prometheus.exposition(base=str(base), registry=reg,
-                                 now=1000.0)
+                                 now=1000.0, fleet=_GoldenFleet())
 
 
 def test_prometheus_exposition_matches_golden(tmp_path):
