@@ -216,6 +216,36 @@ def _span_durations_s(doc):
     return out
 
 
+def _memory_section():
+    """Peak-memory snapshot for a completed rung (ISSUE 16): process
+    RSS + the kernel's VmHWM high watermark, and per-device
+    bytes-in-use / peak from ``memory_stats()`` — so a rung's footprint
+    rides in the BENCH payload (and the warehouse) next to its ops/s.
+    Never fails the bench."""
+    try:
+        from jepsen_tpu.telemetry.stream import (_device_memory_stats,
+                                                 _hwm_bytes, _rss_bytes)
+
+        out = {}
+        rss = _rss_bytes()
+        if rss:
+            out["rss_bytes"] = rss
+        hwm = _hwm_bytes()
+        if hwm or rss:
+            out["rss_peak_bytes"] = max(hwm or 0, rss or 0)
+        devices = {}
+        for dev, (used, pk) in _device_memory_stats().items():
+            row = {"bytes_in_use": used}
+            if pk is not None:
+                row["peak_bytes_in_use"] = pk
+            devices[dev] = row
+        if devices:
+            out["devices"] = devices
+        return out or None
+    except Exception:  # noqa: BLE001 — observability only
+        return None
+
+
 def _run_size(n_txns: int, repeats: int):
     """One ladder rung: returns the result payload (raises on failure)."""
     import jax
@@ -305,6 +335,9 @@ def _run_size(n_txns: int, repeats: int):
             "check_ops_per_s": round(ops_per_sec, 1),
         },
     }
+    memory = _memory_section()
+    if memory is not None:
+        out["memory"] = memory
     if shard_rows is not None:
         out["shards"] = shard_rows
     if streaming is not None:

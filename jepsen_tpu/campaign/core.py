@@ -78,19 +78,28 @@ def result_flags(results: Any) -> Dict[str, Any]:
     return out
 
 
-def _spans_from_dir(d: Optional[str], cap: int = 48) -> Dict[str, float]:
-    """Per-span total durations (seconds) from a run's telemetry.json —
-    the material for the index's span-duration trend queries.  Missing
-    or unreadable telemetry is just an empty dict."""
+def _read_telemetry(d: Optional[str]) -> Optional[Dict[str, Any]]:
+    """The run dir's parsed telemetry.json, or None — read ONCE per
+    record build (spans + phases + counters all come from it)."""
     if not d:
-        return {}
+        return None
     path = os.path.join(d, "telemetry.json")
     if not os.path.exists(path):
-        return {}
+        return None
     try:
         with open(path) as f:
             doc = json.load(f)
     except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def _spans_from_doc(doc: Optional[Dict[str, Any]],
+                    cap: int = 48) -> Dict[str, float]:
+    """Per-span total durations (seconds) from a run's telemetry doc —
+    the material for the index's span-duration trend queries.  Missing
+    or unreadable telemetry is just an empty dict."""
+    if not doc:
         return {}
     out: Dict[str, float] = {}
 
@@ -107,6 +116,74 @@ def _spans_from_dir(d: Optional[str], cap: int = 48) -> Dict[str, float]:
         out = dict(sorted(out.items(),  # expensive stages, not leaf noise
                           key=lambda kv: -kv[1])[:cap])
     return {k: round(v, 6) for k, v in out.items()}
+
+
+def _spans_from_dir(d: Optional[str], cap: int = 48) -> Dict[str, float]:
+    return _spans_from_doc(_read_telemetry(d), cap)
+
+
+def _phases_from_doc(doc: Optional[Dict[str, Any]],
+                     cap: int = 48) -> Dict[str, Dict[str, float]]:
+    """Per-span phase self-time buckets (ISSUE 16): ``{span-name:
+    {bucket: seconds}}`` summed over the forest — the ledger-side half
+    of the forensics parity contract (the warehouse explodes the same
+    attrs into ``span_profile``; `obs diff` must reach one verdict from
+    either)."""
+    if not doc:
+        return {}
+    from jepsen_tpu.telemetry import PHASE_BUCKETS
+
+    out: Dict[str, Dict[str, float]] = {}
+
+    def walk(sp: Dict[str, Any]) -> None:
+        attrs = sp.get("attrs") or {}
+        for b in PHASE_BUCKETS:
+            v = attrs.get(b)
+            if isinstance(v, (int, float)) and v:
+                cell = out.setdefault(sp["name"], {})
+                cell[b] = cell.get(b, 0.0) + float(v)
+        for c in sp.get("children") or []:
+            walk(c)
+
+    for r in doc.get("spans", []):
+        walk(r)
+    if len(out) > cap:
+        out = dict(sorted(
+            out.items(),
+            key=lambda kv: -sum(kv[1].values()))[:cap])
+    return {name: {b: round(v, 6) for b, v in cell.items()}
+            for name, cell in out.items()}
+
+
+#: counters whose per-run deltas the forensics report attributes a
+#: regression to (compile misses, retries, fallbacks, anomalies) —
+#: allowlisted so index records stay small
+_FORENSIC_COUNTERS = ("compile-cache-miss", "resilience-retries",
+                      "resilience-fallbacks", "resilience-env-anomalies",
+                      "scheduler-requeues")
+
+
+def _counters_from_doc(doc: Optional[Dict[str, Any]]
+                       ) -> Dict[str, float]:
+    """Allowlisted counter totals (plus sweep-dispatch counts) from the
+    run's metric snapshot, keyed ``name{k=v,...}`` so label-level deltas
+    ("compile-cache-miss{site=elle.infer} 0→14") survive the ledger."""
+    if not doc:
+        return {}
+    m = doc.get("metrics") or {}
+    out: Dict[str, float] = {}
+    for c in m.get("counters") or []:
+        name = c.get("name")
+        if name not in _FORENSIC_COUNTERS or not c.get("value"):
+            continue
+        lbl = ",".join(f"{k}={v}" for k, v in
+                       sorted((c.get("labels") or {}).items()))
+        out[f"{name}{{{lbl}}}" if lbl else name] = float(c["value"])
+    for h in m.get("histograms") or []:
+        if h.get("name") == "verifier-sweep-s" and h.get("count"):
+            out["verifier-sweeps"] = (
+                out.get("verifier-sweeps", 0.0) + float(h["count"]))
+    return out
 
 
 def execute_run(rs: RunSpec, base: str) -> Dict[str, Any]:
@@ -144,8 +221,15 @@ def execute_run(rs: RunSpec, base: str) -> Dict[str, Any]:
         "dir": rel,
         "ops": ops,
         "wall_s": round(time.monotonic() - t0, 3),
-        "spans": _spans_from_dir(d),
     }
+    doc = _read_telemetry(d)
+    rec["spans"] = _spans_from_doc(doc)
+    phases = _phases_from_doc(doc)
+    if phases:
+        rec["phases"] = phases
+    counters = _counters_from_doc(doc)
+    if counters:
+        rec["counters"] = counters
     if rs.opts.get("nemesis-windows"):
         # the installed window set's identity: what the soak compares
         # between a fleet-distributed cell and its single-process twin,

@@ -329,6 +329,39 @@ class Index:
             by_gen.setdefault(g, []).append(dur)
         return [(g, round(_percentile(by_gen[g], 95), 6)) for g in order]
 
+    def forensic_records(self) -> List[tuple]:
+        """``(gen, spans, phases, counters)`` per record in append
+        order — the backend-shared input of
+        :mod:`jepsen_tpu.telemetry.forensics` (``obs diff`` / ``obs
+        gate --explain``).  Warehouse and jsonl scan MUST return the
+        identical shape so both paths reach the same verdict."""
+        wh = self._warehouse()
+        if wh is not None:
+            return wh[0].forensic_records(wh[1])
+        return [(r.get("gen"), r.get("spans") or {},
+                 r.get("phases") or {}, r.get("counters") or {})
+                for r in self.records]
+
+    def profile(self) -> List[Dict[str, Any]]:
+        """Per-(site, shape-class, host) device-call profile aggregated
+        over the campaign's run dirs — ``cli obs profile``'s data.
+        Warehouse-backed from the ``span_profile`` table when fresh;
+        the fallback re-reads each run dir's telemetry.json through the
+        same extraction (``forensics.profile_from_doc``)."""
+        wh = self._warehouse()
+        if wh is not None:
+            return wh[0].campaign_profile(wh[1])
+        from jepsen_tpu.telemetry.forensics import profile_rows_from_dirs
+
+        base = os.path.dirname(os.path.dirname(os.path.abspath(self.path)))
+        dirs, seen = [], set()
+        for r in self.records:
+            d = r.get("dir")
+            if d and d not in seen:
+                seen.add(d)
+                dirs.append(d)
+        return profile_rows_from_dirs(base, dirs)
+
     # -- rollups ------------------------------------------------------------
 
     def latest_by_run(self) -> Dict[str, Dict[str, Any]]:

@@ -141,6 +141,19 @@ class Scheduler:
             q.put((i, rs))
         results: List[Optional[Dict[str, Any]]] = [None] * len(specs)
         lock = threading.Lock()
+        # queue-wait accounting (ISSUE 16 phase taxonomy): a parked run
+        # stamps its park time; the dequeue that finally proceeds books
+        # the gap.  Entries are only ever touched by the thread holding
+        # that queue item, so plain dicts suffice.
+        parked: Dict[int, float] = {}
+        waited: Dict[int, float] = {}
+        nparks: Dict[int, int] = {}
+
+        def park(i: int, rs: RunSpec) -> None:
+            parked[i] = time.monotonic()
+            nparks[i] = nparks.get(i, 0) + 1
+            q.put((i, rs))
+            time.sleep(0.02)
 
         def work() -> None:
             while True:
@@ -148,6 +161,10 @@ class Scheduler:
                     i, rs = q.get_nowait()
                 except queue.Empty:
                     return
+                t_park = parked.pop(i, None)
+                if t_park is not None:
+                    waited[i] = (waited.get(i, 0.0)
+                                 + (time.monotonic() - t_park))
                 slot = None
                 if rs.device:
                     # never BLOCK a worker on a slot: a slotless device
@@ -157,8 +174,7 @@ class Scheduler:
                     # when only device work remains
                     slot = self.slots.try_acquire()
                     if slot is None:
-                        q.put((i, rs))
-                        time.sleep(0.02)
+                        park(i, rs)
                         continue
                 # wanted_for, not a bare opts check: the process-wide
                 # telemetry.enable()/JEPSEN_TELEMETRY opt-ins make
@@ -171,8 +187,7 @@ class Scheduler:
                     # same park-don't-block rule for the telemetry token
                     if slot is not None:
                         self.slots.release(slot)
-                    q.put((i, rs))
-                    time.sleep(0.02)
+                    park(i, rs)
                     continue
                 # Heartbeat methods never raise (see its no-raise
                 # guarantee) — no defensive wrapping here
@@ -203,6 +218,21 @@ class Scheduler:
                         hb.worker(wname, None)
                 if hb is not None:
                     hb.record_done(rs.run_id, rec.get("valid?"))
+                qw = waited.pop(i, None)
+                if qw:
+                    try:
+                        ph = rec.setdefault("phases", {}).setdefault(
+                            "run", {})
+                        ph["queue_wait_s"] = round(
+                            float(ph.get("queue_wait_s") or 0.0) + qw, 6)
+                        n = nparks.pop(i, 1)
+                        cn = rec.setdefault("counters", {})
+                        cn["scheduler-requeues"] = (
+                            float(cn.get("scheduler-requeues") or 0) + n)
+                        telemetry.registry().counter(
+                            "scheduler-requeues").inc(n)
+                    except Exception:  # noqa: BLE001 — accounting only
+                        pass
                 with lock:
                     results[i] = rec
                     if on_result is not None:

@@ -551,10 +551,11 @@ def _render_timeline(tl: Dict[str, Any]) -> str:
 
 
 def obs_cmd(opts: argparse.Namespace) -> int:
-    """`obs ingest|rebuild|gate|sql|bench|timeline` — the sqlite
-    telemetry warehouse over the store dir (docs/TELEMETRY.md):
+    """`obs ingest|rebuild|gate|sql|bench|timeline|profile|diff` — the
+    sqlite telemetry warehouse over the store dir (docs/TELEMETRY.md):
     build/refresh it, query it, gate span regressions statistically,
-    and render stitched cross-host run timelines."""
+    render stitched cross-host run timelines, and run the performance
+    observatory (device-call profiles, cross-generation forensics)."""
     import glob as _glob
 
     from .telemetry import warehouse as wmod
@@ -586,8 +587,13 @@ def obs_cmd(opts: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 2
         return 0
+    if opts.action in ("gate", "profile", "diff"):
+        # campaign analytics: Index answers from the warehouse when it
+        # is fresh and falls back to the jsonl scan otherwise, so these
+        # work (identically) with or without an ingested warehouse
+        return _obs_campaign_cmd(opts, base)
     wh = wmod.open_if_exists(base)
-    if wh is None and opts.action != "gate":
+    if wh is None:
         print(f"obs: no warehouse at {wmod.warehouse_path(base)} "
               "(run `obs ingest` first)", file=sys.stderr)
         return 2
@@ -632,22 +638,83 @@ def obs_cmd(opts: argparse.Namespace) -> int:
         for r in rows:
             print("\t".join(str(v) for v in r))
         return 0
-    if opts.action == "gate":
-        if not opts.campaign or not opts.span:
-            print("obs: gate needs --campaign and --span",
-                  file=sys.stderr)
-            return 2
-        from .telemetry import gate as gate_mod
+    print(f"obs: unknown action {opts.action!r}", file=sys.stderr)
+    return 2
 
+
+def _obs_campaign_cmd(opts: argparse.Namespace, base: str) -> int:
+    """`obs gate|profile|diff` — the campaign-scoped observatory
+    queries (docs/TELEMETRY.md "Performance observatory").  Exit codes:
+    0 pass / rendered, 1 regression, 2 cannot evaluate; for a multi-
+    span gate the rc is the WORST single-span verdict (regression >
+    insufficient-data > pass)."""
+    import json as _json
+
+    from .campaign.core import index_path
+    from .campaign.index import Index
+    from .telemetry import forensics
+    from .telemetry import gate as gate_mod
+
+    campaign = opts.campaign or opts.query
+    if not campaign:
+        print(f"obs: {opts.action} needs a campaign (positional or "
+              "--campaign)", file=sys.stderr)
+        return 2
+    if opts.action == "profile":
+        rows = Index(index_path(campaign, base)).profile()
+        if not rows:
+            print(f"obs: no device-call profile for campaign "
+                  f"{campaign!r} (profiles come from runs recorded "
+                  "with telemetry; re-run `obs ingest` after runs "
+                  "land)", file=sys.stderr)
+            return 2
+        print(f"obs profile: campaign {campaign} "
+              f"({len(rows)} site/shape cells)")
+        print(forensics.render_profile(rows))
+        return 0
+    if opts.action == "diff":
+        report = forensics.run_diff(
+            base, campaign, from_gen=opts.from_gen, to_gen=opts.to_gen,
+            spans=opts.span or None, alpha=opts.alpha,
+            threshold=opts.threshold, min_runs=opts.min_runs)
+        print(forensics.render_diff(report))
+        if opts.json_out:
+            with open(opts.json_out, "w") as f:
+                _json.dump(report, f, indent=2, sort_keys=True)
+            print(f"report written: {opts.json_out}")
+        return {"pass": 0, "regression": 1}.get(report.get("status"), 2)
+    # gate: repeated --span flags, each an exact name or a * glob
+    if not opts.span:
+        print("obs: gate needs --campaign and --span", file=sys.stderr)
+        return 2
+    idx = Index(index_path(campaign, base))
+    records = idx.forensic_records()
+    known = {n for _g, sp, _p, _c in records for n in sp}
+    wanted = forensics.resolve_spans(known, opts.span)
+    if not wanted:
+        print(f"obs: --span {opts.span} matched no recorded span of "
+              f"campaign {campaign!r} (known: "
+              f"{', '.join(sorted(known)) or 'none'})", file=sys.stderr)
+        return 2
+    statuses = []
+    for i, span in enumerate(wanted):
         res = gate_mod.run_gate(
-            base, opts.campaign, opts.span,
+            base, campaign, span,
             from_gen=opts.from_gen, to_gen=opts.to_gen,
             alpha=opts.alpha, threshold=opts.threshold,
             min_runs=opts.min_runs)
+        statuses.append(res.get("status"))
+        if i:
+            print()
         print(gate_mod.render_gate(res))
-        return {"pass": 0, "regression": 1}.get(res.get("status"), 2)
-    print(f"obs: unknown action {opts.action!r}", file=sys.stderr)
-    return 2
+        if opts.explain and res.get("status") == "regression":
+            entry = forensics.attribute_span(
+                span, records, res["from-gen"], res["to-gen"])
+            for line in forensics.render_attribution(entry):
+                print("  " + line)
+    if "regression" in statuses:
+        return 1
+    return 0 if all(s == "pass" for s in statuses) else 2
 
 
 def shrink_cmd(opts: argparse.Namespace,
@@ -800,16 +867,26 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                              "(docs/TELEMETRY.md)")
     po.add_argument("action",
                     choices=("ingest", "rebuild", "gate", "sql",
-                             "bench", "timeline"))
+                             "bench", "timeline", "profile", "diff"))
     po.add_argument("query", nargs="?",
                     help="SQL for the sql action (read-only); run id "
-                         "or 32-hex trace id for the timeline action")
+                         "or 32-hex trace id for the timeline action; "
+                         "campaign name for profile/diff")
     po.add_argument("--bench", action="append", metavar="GLOB",
                     help="BENCH json file(s) to ingest alongside the "
                          "store (repeatable; glob ok)")
-    po.add_argument("--campaign", help="gate: campaign name")
-    po.add_argument("--span", help="gate: span site to compare "
-                                   "(e.g. check:list-append)")
+    po.add_argument("--campaign", help="gate/profile/diff: campaign "
+                                       "name")
+    po.add_argument("--span", action="append",
+                    help="gate/diff: span site(s) to compare "
+                         "(repeatable; * globs match known spans, "
+                         "e.g. --span 'check:*')")
+    po.add_argument("--explain", action="store_true",
+                    help="gate: on regression, attribute the delta "
+                         "across phase buckets and forensic counters")
+    po.add_argument("--json", dest="json_out", metavar="PATH",
+                    help="diff: also write the full report as a JSON "
+                         "artifact")
     po.add_argument("--from-gen", dest="from_gen", default=None,
                     help="gate: baseline generation (default: "
                          "second-latest)")

@@ -444,13 +444,21 @@ class FleetWorker:
              "labels": {}, "value": self.duplicates},
         ]
         try:
-            from jepsen_tpu.telemetry.stream import _rss_bytes
+            from jepsen_tpu.telemetry.stream import _hwm_bytes, _rss_bytes
 
             rss = _rss_bytes()
             if rss:
                 rows.append({"name": "worker-rss-bytes",
                              "kind": "gauge", "labels": {},
                              "value": rss})
+                # the kernel high watermark federates the worker's PEAK
+                # footprint (ISSUE 16): visible fleet-wide even when no
+                # scrape coincided with the spike, and retired with the
+                # worker's liveness like every host-attributed series
+                hwm = _hwm_bytes()
+                rows.append({"name": "worker-rss-peak-bytes",
+                             "kind": "gauge", "labels": {},
+                             "value": max(rss, hwm or 0)})
         except Exception:  # noqa: BLE001 — observability only
             pass
         try:

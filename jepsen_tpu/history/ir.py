@@ -34,6 +34,7 @@ column's meaning changes); the padded layout's static facts
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -42,6 +43,18 @@ from jepsen_tpu.history.ops import History
 from jepsen_tpu.history.soa import PackedTxns, pack_txns
 
 __all__ = ["IR_VERSION", "HistoryIR"]
+
+
+def _booked(build):
+    """Run one cache-miss section build, booking its wall as
+    ``host_pack_s`` phase self-time on the enclosing telemetry span
+    (ISSUE 16 phase taxonomy) — memoized hits pay nothing."""
+    from jepsen_tpu.telemetry import spans as _spans
+
+    t0 = time.perf_counter()
+    out = build()
+    _spans.add_phase("host_pack_s", time.perf_counter() - t0)
+    return out
 
 #: layout contract version: v1 = the implicit per-family packings,
 #: v2 = this module (capacity facts + pad-time derived-order columns)
@@ -96,7 +109,8 @@ class HistoryIR(History):
             return self._packed_source
         p = self._packed.get(workload)
         if p is None:
-            p = self._packed[workload] = pack_txns(self, workload)
+            p = self._packed[workload] = _booked(
+                lambda: pack_txns(self, workload))
         return p
 
     def padded(self, workload: str = "list-append"):
@@ -106,7 +120,9 @@ class HistoryIR(History):
         if h is None:
             from jepsen_tpu.checkers.elle.device_infer import pad_packed
 
-            h = self._padded[workload] = pad_packed(self.packed(workload))
+            packed = self.packed(workload)
+            h = self._padded[workload] = _booked(
+                lambda: pad_packed(packed))
         return h
 
     def rw_inference(self):
@@ -115,8 +131,9 @@ class HistoryIR(History):
         if self._rw_inf is None:
             from jepsen_tpu.checkers.invariants import packed as inv_packed
 
-            self._rw_inf = inv_packed.infer_rw(
-                self.packed("rw-register"))
+            packed = self.packed("rw-register")
+            self._rw_inf = _booked(
+                lambda: inv_packed.infer_rw(packed))
         return self._rw_inf
 
     def bank(self, accounts=None):
@@ -126,7 +143,8 @@ class HistoryIR(History):
         if pb is None:
             from jepsen_tpu.checkers.invariants.packed import pack_bank
 
-            pb = self._bank[key] = pack_bank(self, accounts)
+            pb = self._bank[key] = _booked(
+                lambda: pack_bank(self, accounts))
         return pb
 
     def lin_ops(self) -> List[Any]:
@@ -134,7 +152,7 @@ class HistoryIR(History):
         if self._lin_ops is None:
             from jepsen_tpu.checkers.knossos.prep import prepare
 
-            self._lin_ops = prepare(self)
+            self._lin_ops = _booked(lambda: prepare(self))
         return self._lin_ops
 
     def layout(self) -> Dict[str, Any]:

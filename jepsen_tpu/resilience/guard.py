@@ -168,6 +168,16 @@ def _commit_shape(key: Optional[Tuple]) -> bool:
         return True
 
 
+def _shape_label(shape_key: Optional[Tuple]) -> str:
+    """A compact human/SQL-stable label for a call's shape class —
+    the ``shape`` column of the warehouse ``span_profile`` table.
+    ``shape_key`` is ``(site, (shape, dtype), ...)``; scalars-only
+    calls label as ``scalar``."""
+    if not shape_key or len(shape_key) < 2:
+        return "scalar"
+    return "+".join(f"{s}:{d}" if d else s for s, d in shape_key[1:])
+
+
 def _stamp_device_time(site: str, fn: Callable, args: tuple,
                        kw: dict) -> Any:
     """Run one device attempt, stamping its block-until-ready wall time
@@ -181,6 +191,9 @@ def _stamp_device_time(site: str, fn: Callable, args: tuple,
     shape_key = _peek_shape(site, args, kw)
     t0 = time.perf_counter_ns()
     out = fn(*args, **kw)
+    # dispatch wall: tracing + executable lookup + async enqueue — what
+    # the call cost BEFORE the sync point forced device completion
+    disp = time.perf_counter_ns() - t0
     jx = sys.modules.get("jax")
     if jx is not None:
         try:  # force completion so the delta covers the device work
@@ -207,6 +220,23 @@ def _stamp_device_time(site: str, fn: Callable, args: tuple,
             # can answer "32 s of it was XLA compiles"
             k = "compile_s" if first else "execute_s"
             sp.attrs[k] = float(sp.attrs.get(k, 0.0)) + dt / 1e9
+            sp.attrs["device_dispatch_s"] = float(
+                sp.attrs.get("device_dispatch_s", 0.0)) + disp / 1e9
+            # per-(site, shape-class) profile (ISSUE 16 tentpole a):
+            # accumulated on the span, exploded into the warehouse's
+            # span_profile table at ingest — the `cli obs profile`
+            # treemap's raw material
+            prof = sp.attrs.get("profile")
+            if not isinstance(prof, dict):
+                prof = sp.attrs["profile"] = {}
+            cell = prof.setdefault(
+                f"{site}|{_shape_label(shape_key)}",
+                {"calls": 0, "compile_s": 0.0, "execute_s": 0.0,
+                 "device_dispatch_s": 0.0})
+            cell["calls"] += 1
+            cell[k] = float(cell.get(k, 0.0)) + dt / 1e9
+            cell["device_dispatch_s"] = float(
+                cell.get("device_dispatch_s", 0.0)) + disp / 1e9
         except Exception:  # noqa: BLE001 — noop-span attrs are shared
             pass
     reg = telemetry.registry()

@@ -47,6 +47,7 @@ from .stream import event as stream_event
 # — importing sqlite3 here would tax every `import jepsen_tpu`.
 from .spans import (
     NOOP,
+    PHASE_BUCKETS,
     TRACE_HEADER,
     Collector,
     NoopCollector,
@@ -55,6 +56,7 @@ from .spans import (
     TraceContext,
     activate,
     active,
+    add_phase,
     current,
     current_trace,
     deactivate,
@@ -80,7 +82,7 @@ __all__ = [
     "HttpHeartbeat",
     "TraceContext", "TRACE_HEADER", "mint_trace", "trace_id_for",
     "trace_context", "parse_trace_header", "current_trace",
-    "set_trace", "trace_scope",
+    "set_trace", "trace_scope", "add_phase", "PHASE_BUCKETS",
 ]
 
 def registry() -> Registry:

@@ -33,7 +33,7 @@ __all__ = ["Span", "Collector", "NoopCollector", "NOOP", "active",
            "activate", "deactivate", "span", "traced", "enabled",
            "current", "TraceContext", "TRACE_HEADER", "mint_trace",
            "trace_id_for", "parse_trace_header", "current_trace",
-           "set_trace", "trace_scope"]
+           "set_trace", "trace_scope", "add_phase", "PHASE_BUCKETS"]
 
 # ---------------------------------------------------------------------------
 # Distributed trace context (ISSUE 14 tentpole a)
@@ -349,12 +349,24 @@ class Collector:
 
     def close_open_spans(self) -> None:
         """Stamp a provisional end on every still-open span (export can
-        run mid-span, e.g. from inside store.save_1's own span)."""
+        run mid-span, e.g. from inside store.save_1's own span).  Open
+        spans also get the current memory high watermarks (ISSUE 16):
+        the root ``run`` span is still open when telemetry.json is
+        written, and its real close stamps only the event stream."""
         now = time.perf_counter_ns()
+        wm: Dict[str, Any] = {}
+        st = self.stream
+        if st is not None and getattr(st, "watermarks", None) is not None:
+            try:
+                wm = st.watermarks() or {}
+            except Exception:  # noqa: BLE001 — stamping is best-effort
+                wm = {}
 
         def walk(sp: Span) -> None:
             if sp.t1 is None:
                 sp.attrs.setdefault("open", True)
+                if wm:
+                    sp.attrs.update(wm)
                 sp.t1 = now
             for c in sp.children:
                 walk(c)
@@ -440,6 +452,33 @@ def current() -> Optional[Span]:
     """The innermost open span on this thread (None when disabled or
     at top level) — for attaching attributes after the fact."""
     return _active.current()
+
+
+#: the phase self-time taxonomy (ISSUE 16): where a span's wall time
+#: actually went.  compile_s/execute_s predate this list (stamped by
+#: `resilience.guard._stamp_device_time`); the rest are accumulated by
+#: their owning subsystems via :func:`add_phase`.  Bucket attrs are
+#: plain ``*_s`` float seconds on span attrs, so they ride the existing
+#: telemetry.json → ledger → warehouse path with no schema change to
+#: the span structure itself.
+PHASE_BUCKETS = ("compile_s", "execute_s", "queue_wait_s",
+                 "host_pack_s", "device_dispatch_s", "sweep_s",
+                 "journal_fsync_s")
+
+
+def add_phase(bucket: str, seconds: float) -> None:
+    """Accumulate `seconds` of phase self-time into `bucket` on the
+    innermost open span of this thread.  The disabled path is one
+    attribute lookup returning None — cheap enough for hot loops; the
+    enabled path is two dict ops.  Never raises."""
+    sp = _active.current()
+    if sp is None:
+        return
+    try:
+        sp.attrs[bucket] = float(sp.attrs.get(bucket) or 0.0) + float(
+            seconds)
+    except Exception:  # noqa: BLE001 — accounting must never fail a run
+        pass
 
 
 def traced(name: Optional[str] = None, **attrs: Any):

@@ -194,6 +194,10 @@ class EventStream:
         self._flush_lock = threading.Lock()
         self.broken = False
         self._metrics: Optional[_MetricsDelta] = None
+        #: when a ResourceSampler is attached, its ``watermarks`` bound
+        #: method — span closes stamp the current peaks into the span's
+        #: attrs (so telemetry.json carries per-span high watermarks)
+        self.watermarks: Optional[Any] = None
         # one session per file: truncate any previous stream (and drop
         # its rotation segments) — a --force re-shrink appending after
         # the old "end" event would make replay() render a killed
@@ -272,6 +276,18 @@ class EventStream:
                   thread=sp.thread_name)
 
     def span_close(self, sp: Any) -> None:
+        if self.watermarks is not None:
+            # stamp the enclosing span with the run's current memory
+            # high watermarks at its close — this lands in the span
+            # event AND (the attrs dict is the live span's) in the
+            # telemetry.json export, so peak memory is attributable to
+            # the phase that drove it
+            try:
+                wm = self.watermarks()
+                if wm:
+                    sp.attrs.update(wm)
+            except Exception:  # noqa: BLE001 — stamping is best-effort
+                pass
         self.emit("span", name=sp.name, tid=sp.tid, dur_ns=sp.duration_ns,
                   **({"attrs": _jsonable(sp.attrs)} if sp.attrs else {}))
         # a span boundary is the natural metrics flush point: low-rate,
@@ -335,12 +351,27 @@ def _rss_bytes() -> Optional[int]:
             return None
 
 
-def _device_memory() -> Dict[str, int]:
-    """Per-device bytes-in-use from ``device.memory_stats()``, with a
-    live-buffer-bytes fallback.  Only consulted when jax is imported
-    AND its backend is already initialized — ``jax.devices()`` on a
-    cold process would *dial* the backend (which can hang on a downed
-    TPU tunnel), and a sampler must never be the thing that does that."""
+def _hwm_bytes() -> Optional[int]:
+    """Kernel-tracked RSS high watermark (``VmHWM``) — catches a
+    transient allocation spike even when every sampler tick missed it
+    entirely, which is exactly what a watermark series is for."""
+    try:
+        with open("/proc/self/status", "rb") as f:
+            for line in f:
+                if line.startswith(b"VmHWM:"):
+                    return int(line.split()[1]) * 1024
+    except Exception:  # noqa: BLE001 — non-linux
+        pass
+    return None
+
+
+def _device_memory_stats() -> "Dict[str, Tuple[int, Optional[int]]]":
+    """Per-device ``(bytes_in_use, peak_bytes_in_use-or-None)`` from
+    ``device.memory_stats()``, with a live-buffer-bytes fallback.  Only
+    consulted when jax is imported AND its backend is already
+    initialized — ``jax.devices()`` on a cold process would *dial* the
+    backend (which can hang on a downed TPU tunnel), and a sampler must
+    never be the thing that does that."""
     jx = sys.modules.get("jax")
     if jx is None:
         return {}
@@ -351,7 +382,7 @@ def _device_memory() -> Dict[str, int]:
             return {}
     except Exception:  # noqa: BLE001 — unknown jax layout: stay safe
         return {}
-    out: Dict[str, int] = {}
+    out: Dict[str, Tuple[int, Optional[int]]] = {}
     try:
         for d in jx.devices():
             try:
@@ -359,13 +390,21 @@ def _device_memory() -> Dict[str, int]:
             except Exception:  # noqa: BLE001
                 ms = None
             if ms and ms.get("bytes_in_use") is not None:
-                out[str(d)] = int(ms["bytes_in_use"])
+                pk = ms.get("peak_bytes_in_use")
+                out[str(d)] = (int(ms["bytes_in_use"]),
+                               int(pk) if pk is not None else None)
         if not out:
-            out["live-buffers"] = int(sum(
-                int(getattr(a, "nbytes", 0)) for a in jx.live_arrays()))
+            out["live-buffers"] = (int(sum(
+                int(getattr(a, "nbytes", 0))
+                for a in jx.live_arrays())), None)
     except Exception:  # noqa: BLE001
         pass
     return out
+
+
+def _device_memory() -> Dict[str, int]:
+    return {dev: used
+            for dev, (used, _pk) in _device_memory_stats().items()}
 
 
 class ResourceSampler:
@@ -374,14 +413,27 @@ class ResourceSampler:
     caller's thread (so even an instant run records one, and a short
     run never shares the GIL with a sampler tick — per-worker op-split
     tests stay deterministic), then the thread waits a full interval
-    before its first tick; :meth:`stop` takes the final sample (the
-    state a post-mortem reads)."""
+    before its first tick; :meth:`stop` ALWAYS takes one final
+    synchronous sample (marked ``"final": true``) on the caller's
+    thread before detach — the state a post-mortem reads, and the
+    guarantee that the peak gauges below reflect the whole run.
+
+    Beyond instantaneous gauges the sampler maintains HIGH WATERMARKS
+    (ISSUE 16 tentpole b): ``process-rss-peak-bytes`` (max of sampled
+    RSS and the kernel's VmHWM, which catches spikes between ticks),
+    ``device-memory-peak-bytes{device=}`` (``peak_bytes_in_use`` when
+    the backend reports it, else the in-process max of bytes-in-use)
+    and ``jit-cache-entries-peak``.  :meth:`watermarks` exposes them
+    for span-close stamping (see :func:`attach`)."""
 
     def __init__(self, stream: EventStream, registry: Registry,
                  interval_s: float = 1.0):
         self.stream = stream
         self.registry = registry
         self.interval_s = max(0.02, float(interval_s))
+        self.peak_rss = 0
+        self.peak_dev: Dict[str, int] = {}
+        self.peak_jit = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="telemetry-sampler")
@@ -402,25 +454,55 @@ class ResourceSampler:
             except Exception:  # noqa: BLE001 — sampling must never kill
                 pass
 
-    def sample(self) -> None:
+    def sample(self, final: bool = False) -> None:
         fields: Dict[str, Any] = {}
         rss = _rss_bytes()
         if rss is not None:
             self.registry.gauge("process-rss-bytes").set(rss)
             fields["rss_bytes"] = rss
+            self.peak_rss = max(self.peak_rss, rss, _hwm_bytes() or 0)
+            self.registry.gauge("process-rss-peak-bytes").set(
+                self.peak_rss)
+            fields["rss_peak_bytes"] = self.peak_rss
         n = threading.active_count()
         self.registry.gauge("process-threads").set(n)
         fields["threads"] = n
-        for dev, b in _device_memory().items():
-            self.registry.gauge("device-memory-bytes", device=dev).set(b)
-            fields.setdefault("device_bytes", {})[dev] = b
+        for dev, (used, pk) in _device_memory_stats().items():
+            self.registry.gauge("device-memory-bytes",
+                                device=dev).set(used)
+            fields.setdefault("device_bytes", {})[dev] = used
+            peak = max(self.peak_dev.get(dev, 0), used, pk or 0)
+            self.peak_dev[dev] = peak
+            self.registry.gauge("device-memory-peak-bytes",
+                                device=dev).set(peak)
+            fields.setdefault("device_peak_bytes", {})[dev] = peak
+        jit = self.registry.gauge("jit-cache-entries").value
+        if jit:
+            self.peak_jit = max(self.peak_jit, int(jit))
+            self.registry.gauge("jit-cache-entries-peak").set(
+                self.peak_jit)
+        if final:
+            fields["final"] = True
         self.stream.emit("sample", **fields)
         self.stream.flush_metrics()
+
+    def watermarks(self) -> Dict[str, Any]:
+        """The current high watermarks, in the shape span-close
+        stamping writes into span attrs (empty until a sample has
+        landed any)."""
+        out: Dict[str, Any] = {}
+        if self.peak_rss:
+            out["rss_peak_bytes"] = self.peak_rss
+        if self.peak_dev:
+            out["device_peak_bytes"] = dict(self.peak_dev)
+        if self.peak_jit:
+            out["jit_cache_entries_peak"] = self.peak_jit
+        return out
 
     def stop(self) -> None:
         self._stop.set()
         try:
-            self.sample()
+            self.sample(final=True)
         except Exception:  # noqa: BLE001
             pass
 
@@ -481,6 +563,7 @@ def attach(collector: Any, dirpath: str, *,
     smp = None
     if sampler and reg is not None:
         smp = ResourceSampler(s, reg, interval_s)
+        s.watermarks = smp.watermarks
         smp.start()
     collector.stream = s
     return Recorder(collector, s, smp)

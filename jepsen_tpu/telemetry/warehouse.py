@@ -56,7 +56,7 @@ __all__ = ["Warehouse", "warehouse_path", "open_if_exists", "for_ledger",
            "WAREHOUSE_FILE", "SCHEMA_VERSION"]
 
 WAREHOUSE_FILE = "warehouse.sqlite"
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS meta(
@@ -74,7 +74,9 @@ CREATE TABLE IF NOT EXISTS campaign_records(
     dir TEXT, ops INTEGER, wall_s REAL,
     gen TEXT, spec TEXT, ts TEXT,
     witness TEXT,                   -- JSON witness summary, or NULL
-    trace TEXT);                    -- distributed trace id (ISSUE 14)
+    trace TEXT,                     -- distributed trace id (ISSUE 14)
+    phases TEXT,                    -- {span: {bucket: s}} JSON (ISSUE 16)
+    counters TEXT);                 -- forensic counter totals, JSON
 CREATE INDEX IF NOT EXISTS cr_ledger_key ON campaign_records(ledger, key, id);
 CREATE INDEX IF NOT EXISTS cr_ledger_run ON campaign_records(ledger, run, id);
 CREATE TABLE IF NOT EXISTS record_spans(
@@ -180,12 +182,28 @@ CREATE TABLE IF NOT EXISTS trace_spans(
 CREATE INDEX IF NOT EXISTS tsp_trace ON trace_spans(trace_id, t0, id);
 CREATE INDEX IF NOT EXISTS tsp_run ON trace_spans(run);
 CREATE INDEX IF NOT EXISTS tsp_origin ON trace_spans(origin);
+-- per-(site, shape-class) device-call profile (ISSUE 16, schema v5):
+-- one row per run dir per shape class, exploded from the span attrs
+-- `resilience.guard._stamp_device_time` accumulates — the `cli obs
+-- profile` treemap's raw material, host-attributed for fleet stitching
+CREATE TABLE IF NOT EXISTS span_profile(
+    dir TEXT NOT NULL,             -- origin run dir, for per-unit wipes
+    host TEXT,
+    site TEXT NOT NULL,
+    shape TEXT NOT NULL,
+    calls INTEGER NOT NULL DEFAULT 0,
+    compile_s REAL NOT NULL DEFAULT 0,
+    execute_s REAL NOT NULL DEFAULT 0,
+    device_dispatch_s REAL NOT NULL DEFAULT 0);
+CREATE INDEX IF NOT EXISTS spf_dir ON span_profile(dir);
+CREATE INDEX IF NOT EXISTS spf_site ON span_profile(site, shape);
 """
 
 #: every row-holding table, in wipe order (rebuild / per-unit deletes)
 _DATA_TABLES = ("record_spans", "flip_rollup", "span_rollup",
                 "span_gen_rollup", "campaign_records", "ledgers",
-                "run_spans", "run_metrics", "witnesses", "runs",
+                "run_spans", "run_metrics", "span_profile",
+                "witnesses", "runs",
                 "events", "event_cursors", "verifier_sessions",
                 "fleet_events", "fleet_worker_rollup", "trace_spans",
                 "bench")
@@ -250,6 +268,15 @@ class Warehouse:
             if "trace" not in ccols:
                 self.db.execute("ALTER TABLE campaign_records "
                                 "ADD COLUMN trace TEXT")
+            # v4 -> v5 migration (ISSUE 16): campaign_records grows the
+            # phase-bucket and forensic-counter JSON columns; the new
+            # span_profile table itself is covered by the CREATE IF NOT
+            # EXISTS above.  ALTER-only: existing rows keep NULL until
+            # their ledger is re-ingested (obs rebuild).
+            for col in ("phases", "counters"):
+                if col not in ccols:
+                    self.db.execute("ALTER TABLE campaign_records "
+                                    f"ADD COLUMN {col} TEXT")
             self.db.execute(
                 "INSERT OR REPLACE INTO meta(key, value) VALUES "
                 "('schema_version', ?)", (str(SCHEMA_VERSION),))
@@ -438,12 +465,15 @@ class Warehouse:
 
     def _insert_record(self, ledger: str, rec: Dict[str, Any]) -> int:
         w = rec.get("witness")
+        phases = rec.get("phases")
+        counters = rec.get("counters")
         cur = self.db.execute(
             "INSERT INTO campaign_records(ledger, campaign, run, key, "
             "workload, fault, seed, valid, error, degraded, deadline, "
-            "dir, ops, wall_s, gen, spec, ts, witness, trace) "
+            "dir, ops, wall_s, gen, spec, ts, witness, trace, phases, "
+            "counters) "
             "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, "
-            "?, ?, ?)",
+            "?, ?, ?, ?, ?)",
             (ledger, rec.get("campaign"), rec.get("run"), rec.get("key"),
              rec.get("workload"), rec.get("fault"),
              json.dumps(rec.get("seed")),
@@ -453,7 +483,10 @@ class Warehouse:
              rec.get("dir"), rec.get("ops"), rec.get("wall_s"),
              rec.get("gen"), rec.get("spec"), rec.get("ts"),
              json.dumps(w) if isinstance(w, dict) else None,
-             rec.get("trace")))
+             rec.get("trace"),
+             json.dumps(phases) if isinstance(phases, dict) else None,
+             json.dumps(counters) if isinstance(counters, dict)
+             else None))
         rid = cur.lastrowid
         spans = rec.get("spans") or {}
         if isinstance(spans, dict):
@@ -514,12 +547,12 @@ class Warehouse:
                 return False
             valid, flags = self._run_results(d)
             status = "running" if valid is _ABSENT else "done"
-            spans, metrics = self._run_telemetry(d)
+            spans, metrics, profile, host = self._run_telemetry(d)
             traces = self._run_trace_rows(d, rel)
             wit = self._run_witness(d)
             with self.db:
                 for tbl in ("runs", "run_spans", "run_metrics",
-                            "witnesses"):
+                            "witnesses", "span_profile"):
                     self.db.execute(
                         f"DELETE FROM {tbl} WHERE dir = ?", (rel,))
                 self.db.execute(
@@ -550,6 +583,17 @@ class Warehouse:
                         "INSERT INTO run_metrics(dir, kind, name, labels, "
                         "value) VALUES (?, ?, ?, ?, ?)",
                         [(rel,) + m for m in metrics])
+                if profile:
+                    self.db.executemany(
+                        "INSERT INTO span_profile(dir, host, site, "
+                        "shape, calls, compile_s, execute_s, "
+                        "device_dispatch_s) "
+                        "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                        [(rel, host, site, shape, c["calls"],
+                          round(c["compile_s"], 6),
+                          round(c["execute_s"], 6),
+                          round(c["device_dispatch_s"], 6))
+                         for (site, shape), c in sorted(profile.items())])
                 if wit is not None:
                     self.db.execute(
                         "INSERT INTO witnesses(dir, ops, source_ops, "
@@ -589,15 +633,24 @@ class Warehouse:
 
     @staticmethod
     def _run_telemetry(d: str) -> Tuple[Dict[str, Tuple[float, int]],
-                                        List[Tuple]]:
-        """(spans, metric rows) from telemetry.json: per-span-name
-        (total seconds, count), and counter/gauge/histogram snapshot
-        rows for run_metrics."""
+                                        List[Tuple],
+                                        Dict[Tuple[str, str],
+                                             Dict[str, Any]],
+                                        Optional[str]]:
+        """(spans, metric rows, profile, host) from telemetry.json:
+        per-span-name (total seconds, count), counter/gauge/histogram
+        snapshot rows for run_metrics, and the run's per-(site,
+        shape-class) device-call profile (ISSUE 16) summed over span
+        ``profile`` attrs — ONE shared extraction
+        (`forensics.profile_from_doc`), so the jsonl fallback and this
+        ingest can't drift."""
         try:
             with open(os.path.join(d, "telemetry.json")) as f:
                 doc = json.load(f)
         except (OSError, ValueError):
-            return {}, []
+            return {}, [], {}, None
+        if not isinstance(doc, dict):
+            return {}, [], {}, None
         spans: Dict[str, Tuple[float, int]] = {}
 
         def walk(sp: Dict[str, Any]) -> None:
@@ -611,6 +664,11 @@ class Warehouse:
         for r in doc.get("spans", []) if isinstance(doc, dict) else []:
             walk(r)
         spans = {n: (round(t, 6), c) for n, (t, c) in spans.items()}
+        from .forensics import profile_from_doc
+
+        profile = profile_from_doc(doc)
+        meta = doc.get("meta") or {}
+        host = meta.get("host") if isinstance(meta, dict) else None
         m = doc.get("metrics") or {} if isinstance(doc, dict) else {}
 
         def lbl(entry: Dict[str, Any]) -> str:
@@ -632,7 +690,7 @@ class Warehouse:
             if isinstance(h.get("sum"), (int, float)):
                 rows.append(("histogram-sum", h["name"], lbl(h),
                              float(h["sum"])))
-        return spans, rows
+        return spans, rows, profile, host
 
     @staticmethod
     def _run_trace_rows(d: str, rel: str) -> List[Tuple]:
@@ -1204,6 +1262,57 @@ class Warehouse:
                 "AND name = ? ORDER BY first_id",
                 (ledger_rel, name)).fetchall()
         return [(gen, p95) for gen, p95 in rows]
+
+    def forensic_records(self, ledger_rel: str
+                         ) -> List[Tuple[Optional[str],
+                                         Dict[str, float],
+                                         Dict[str, Any],
+                                         Dict[str, float]]]:
+        """(gen, spans, phases, counters) per ledger record in append
+        order — the ONE input shape `telemetry.forensics` attributes
+        regressions from; `Index.forensic_records` returns the
+        identical shape off the raw jsonl (parity asserted in tests)."""
+        with self._lock:
+            recs = self.db.execute(
+                "SELECT id, gen, phases, counters FROM campaign_records "
+                "WHERE ledger = ? ORDER BY id", (ledger_rel,)).fetchall()
+            span_rows = self.db.execute(
+                "SELECT record_id, name, dur_s FROM record_spans "
+                "WHERE ledger = ? ORDER BY record_id",
+                (ledger_rel,)).fetchall()
+        spans_by_rid: Dict[int, Dict[str, float]] = {}
+        for rid, name, dur in span_rows:
+            spans_by_rid.setdefault(rid, {})[name] = dur
+        out = []
+        for rid, gen, phases, counters in recs:
+            out.append((gen, spans_by_rid.get(rid, {}),
+                        json.loads(phases) if phases else {},
+                        json.loads(counters) if counters else {}))
+        return out
+
+    def campaign_profile(self, ledger_rel: str) -> List[Dict[str, Any]]:
+        """The campaign's fleet-wide device-call profile: per (site,
+        shape-class, host) call counts and phase self-times summed over
+        every run dir its records landed in — the ``cli obs profile``
+        treemap rows, biggest total first."""
+        with self._lock:
+            rows = self.db.execute(
+                "SELECT p.site, p.shape, p.host, SUM(p.calls), "
+                "SUM(p.compile_s), SUM(p.execute_s), "
+                "SUM(p.device_dispatch_s) FROM span_profile p "
+                "JOIN (SELECT DISTINCT dir FROM campaign_records "
+                "      WHERE ledger = ? AND dir IS NOT NULL) r "
+                "ON p.dir = r.dir "
+                "GROUP BY p.site, p.shape, p.host",
+                (ledger_rel,)).fetchall()
+        out = [{"site": site, "shape": shape, "host": host,
+                "calls": int(calls or 0),
+                "compile_s": round(comp or 0.0, 6),
+                "execute_s": round(exe or 0.0, 6),
+                "device_dispatch_s": round(disp or 0.0, 6)}
+               for site, shape, host, calls, comp, exe, disp in rows]
+        out.sort(key=lambda r: -(r["compile_s"] + r["execute_s"]))
+        return out
 
     def latest_by_run(self, ledger_rel: str) -> Dict[str, Dict[str, Any]]:
         """The LATEST verdict-bearing record per run id, reconstructed

@@ -33,7 +33,19 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+
+def _fsync_booked(fd: int) -> None:
+    """fsync, booking the wall as ``journal_fsync_s`` phase self-time
+    on the enclosing telemetry span (ISSUE 16) — the durability tax
+    becomes attributable instead of vanishing into span totals."""
+    t0 = time.perf_counter()
+    os.fsync(fd)
+    from jepsen_tpu.telemetry import spans as _spans
+
+    _spans.add_phase("journal_fsync_s", time.perf_counter() - t0)
 
 __all__ = ["SessionJournal", "split_segment", "op_feedable", "read_meta",
            "write_checkpoint", "read_checkpoint",
@@ -241,7 +253,7 @@ class SessionJournal:
         with open(tmp, "wb") as f:
             f.write(header + suffix)
             f.flush()
-            os.fsync(f.fileno())
+            _fsync_booked(f.fileno())
         os.replace(tmp, self.path)
         self.base = upto
         self._header_len = len(header)
@@ -259,7 +271,7 @@ class SessionJournal:
             return self.cursor
         f = self._file()
         f.write(data)
-        os.fsync(f.fileno())
+        _fsync_booked(f.fileno())
         self.cursor += len(data)
         return self.cursor
 

@@ -589,9 +589,12 @@ class VerifierSession:
                 ctx = self._sweep_context(full)
                 for c0 in range(0, len(dirty), self.sweep_chunk):
                     chunk = dirty[c0:c0 + self.sweep_chunk]
+                    t0 = time.perf_counter()
                     resilience.device_call(
                         SWEEP_SITE, self._sweep_chunk, ctx, chunk,
                         deadline, deadline=deadline, plan=self.plan)
+                    telemetry.add_phase("sweep_s",
+                                        time.perf_counter() - t0)
             except BaseException:
                 if rebuilding:
                     # restore the pre-rebuild cache; _rebuild stays

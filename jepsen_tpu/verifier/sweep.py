@@ -35,6 +35,7 @@ checker span.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -171,8 +172,10 @@ def _dispatch(regions: List[Dict[str, Any]],
     with telemetry.span("verifier.sweep", batched=True,
                         sessions=n_sessions, regions=len(regions),
                         nodes=n_nodes, edges=n_edges):
+        t0 = time.perf_counter()
         res = resilience.device_call(SWEEP_SITE, detect_cycles, g,
                                      deadline=deadline)
+        telemetry.add_phase("sweep_s", time.perf_counter() - t0)
     if not res.converged:
         return False, set()
     hits: set = set()

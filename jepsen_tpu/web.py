@@ -210,7 +210,11 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._witness_diff(rel[:-len("/witness-diff")])
                 if rel.endswith("/trend"):
                     return self._trend(rel[:-len("/trend")])
+                if rel.endswith("/forensics"):
+                    return self._forensics(rel[:-len("/forensics")])
                 return self._campaign(rel)
+            if path.startswith("/profile/"):
+                return self._profile(path[len("/profile/"):])
             if path.startswith("/verdict/"):
                 return self._verdict_json(path[len("/verdict/"):])
             if path in ("/verifier", "/verifier/"):
@@ -957,8 +961,146 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 <p>p95 span duration (s) per campaign generation; a &gt;25% step vs
 the previous generation is highlighted.  Enforce with
 <code>cli obs gate --campaign {html.escape(name)} --span &lt;name&gt;
-</code> (docs/TELEMETRY.md).</p>
+</code> (docs/TELEMETRY.md).  Drill down:
+<a href="/profile/{quote(name)}">device-call profile</a> &middot;
+<a href="/campaign/{quote(name)}/forensics">regression forensics</a>.
+</p>
 {body}</body></html>"""
+        self._send(200, doc.encode())
+
+    def _profile(self, name: str):
+        """Device-call profile treemap (ISSUE 16): per (site,
+        shape-class, host) compile/execute/dispatch self-time over the
+        campaign's runs — the web twin of ``cli obs profile``."""
+        from .campaign.index import Index
+
+        self._autoingest()
+        name = unquote(name).rstrip("/")
+        path = self._safe_path(os.path.join("campaigns", name + ".jsonl"))
+        if path is None or not os.path.exists(path):
+            return self._send(404, b"no such campaign", "text/plain")
+        rows = Index(path).profile()
+        by_site: Dict[str, list] = {}
+        for r in rows:
+            by_site.setdefault(r["site"], []).append(r)
+        site_total = {s: sum(r["compile_s"] + r["execute_s"] for r in rs)
+                      for s, rs in by_site.items()}
+        grand = sum(site_total.values()) or 1e-12
+        parts = []
+        for site in sorted(by_site, key=lambda s: -site_total[s]):
+            pct = site_total[site] / grand * 100.0
+            cells = "".join(
+                f"<tr><td><code>{html.escape(r['shape'])}</code></td>"
+                f"<td>{html.escape(r['host'] or '-')}</td>"
+                f"<td>{r['calls']}</td><td>{r['compile_s']:.3f}</td>"
+                f"<td>{r['execute_s']:.3f}</td>"
+                f"<td>{r['device_dispatch_s']:.3f}</td></tr>"
+                for r in sorted(by_site[site],
+                                key=lambda r: -(r["compile_s"]
+                                                + r["execute_s"])))
+            parts.append(
+                f"<h2><code>{html.escape(site)}</code> — "
+                f"{pct:.1f}% of device time</h2>"
+                f'<div class="bar"><div style="width:{pct:.1f}%">'
+                "</div></div>"
+                "<table><tr><th>shape-class</th><th>host</th>"
+                "<th>calls</th><th>compile s</th><th>execute s</th>"
+                f"<th>dispatch s</th></tr>{cells}</table>")
+        body = ("".join(parts) if parts else
+                "<p>no device-call profile yet (runs need "
+                "<code>\"telemetry\": true</code>; re-run "
+                "<code>cli obs ingest</code> after runs land).</p>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>profile — {html.escape(name)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+.bar {{ background: #eee; width: 60%; height: 10px; margin: 4px 0; }}
+.bar div {{ background: #4a90d9; height: 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/campaign/{quote(name)}">&larr; campaign</a> &middot;
+<a href="/campaign/{quote(name)}/trend">trend</a> &middot;
+<a href="/campaign/{quote(name)}/forensics">forensics</a></p>
+<h1>device-call profile — {html.escape(name)}</h1>
+<p>Per (site, shape-class, host): jit compile / execute /
+dispatch-only self-time summed over the campaign's telemetric runs
+(<code>cli obs profile {html.escape(name)}</code>).</p>
+{body}</body></html>"""
+        self._send(200, doc.encode())
+
+    def _forensics(self, name: str):
+        """Cross-generation regression forensics panel (ISSUE 16): the
+        latest generation pair gated span by span, each regression's
+        delta attributed across phase buckets + forensic counters —
+        the web twin of ``cli obs diff``."""
+        from .telemetry import forensics
+
+        self._autoingest()
+        name = unquote(name).rstrip("/")
+        path = self._safe_path(os.path.join("campaigns", name + ".jsonl"))
+        if path is None or not os.path.exists(path):
+            return self._send(404, b"no such campaign", "text/plain")
+        report = forensics.run_diff(self.base, name)
+        status = report.get("status") or "?"
+        color = {"regression": "#f2a3a3", "pass": "#9ce29c"}.get(
+            status, "#eee")
+        parts = [f'<p>generations <code>{html.escape(str(report.get("from-gen", "?")))}'
+                 f"</code> &rarr; <code>"
+                 f'{html.escape(str(report.get("to-gen", "?")))}</code>: '
+                 f'<span style="background:{color};padding:2px 8px">'
+                 f"{html.escape(status)}</span>"
+                 + (f" — {html.escape(str(report['reason']))}"
+                    if report.get("reason") else "") + "</p>"]
+        for e in report.get("spans") or []:
+            mark = {"regression": "#f2a3a3",
+                    "pass": "#9ce29c"}.get(e["status"], "#eee")
+            rel = e.get("rel_delta")
+            rel_txt = f"{rel * 100:+.0f}%" if isinstance(
+                rel, (int, float)) else "?"
+            head = (f'<h2><span style="background:{mark};'
+                    f'padding:1px 6px">{html.escape(e["status"])}'
+                    f"</span> <code>{html.escape(e['span'])}</code> "
+                    f"{rel_txt} (mean {e['mean_from']:.4f}s &rarr; "
+                    f"{e['mean_to']:.4f}s)</h2>")
+            parts.append(head)
+            if e["status"] != "regression":
+                continue
+            rows = "".join(
+                f"<tr><td><code>{html.escape(p['bucket'])}</code></td>"
+                f"<td>{p['from_s']:.4f}</td><td>{p['to_s']:.4f}</td>"
+                f"<td>{p['delta_s']:+.4f}</td>"
+                + (f"<td>{p['share'] * 100:.1f}%</td>"
+                   if isinstance(p.get("share"), (int, float))
+                   else "<td>-</td>") + "</tr>"
+                for p in e.get("phases") or [])
+            if rows:
+                parts.append(
+                    "<table><tr><th>phase bucket</th><th>from s</th>"
+                    "<th>to s</th><th>&Delta; s</th>"
+                    f"<th>share of delta</th></tr>{rows}</table>")
+            crows = "".join(
+                f"<tr><td><code>{html.escape(c['name'])}</code></td>"
+                f"<td>{c['from']:g}</td><td>{c['to']:g}</td>"
+                f"<td>{c['delta']:+g}</td></tr>"
+                for c in (e.get("counters") or [])[:12])
+            if crows:
+                parts.append(
+                    "<table><tr><th>counter</th><th>from</th>"
+                    f"<th>to</th><th>&Delta;</th></tr>{crows}</table>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>forensics — {html.escape(name)}</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/campaign/{quote(name)}">&larr; campaign</a> &middot;
+<a href="/campaign/{quote(name)}/trend">trend</a> &middot;
+<a href="/profile/{quote(name)}">profile</a></p>
+<h1>regression forensics — {html.escape(name)}</h1>
+<p>Latest generation pair gated span by span (Mann-Whitney + p95
+threshold); each regression's delta attributed across the phase
+buckets (<code>cli obs diff {html.escape(name)}</code>).</p>
+{"".join(parts)}</body></html>"""
         self._send(200, doc.encode())
 
     def _witness_diff(self, name: str):

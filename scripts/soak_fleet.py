@@ -81,6 +81,17 @@ def get_status(url, timeout=2.0):
         return json.loads(r.read().decode())
 
 
+def scrape_metrics(url, timeout=2.0):
+    """The coordinator's prometheus exposition ("" when unreachable) —
+    the federated `jepsen_fleet_host_*{host=}` series live here."""
+    try:
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:  # noqa: BLE001 — chaos windows 503/refuse
+        return ""
+
+
 def wait_status(url, pred, deadline_s, what):
     """Poll /fleet/status until pred(status) (chaos 503s and restart
     windows are ridden out); returns the matching status."""
@@ -579,6 +590,35 @@ def main():
         if not requeued:
             failures.append("no lease-expiry requeue observed after "
                             "2 worker kills")
+
+        # -- watermark retirement (ISSUE 16 satellite) ----------------
+        # the killed worker's federated host series — including the
+        # worker-rss-peak-bytes watermark — must retire with its
+        # liveness window; a scrape that kept publishing dead workers'
+        # peaks would grow monotonically across every kill -9 round
+        if requeued:
+            t_end = time.time() + 3 * args.lease + 30
+            retired = False
+            while time.time() < t_end:
+                expo = scrape_metrics(url)
+                if expo and f'host="{victim}"' not in expo:
+                    retired = True
+                    break
+                time.sleep(0.5)
+            if not retired:
+                failures.append(
+                    f"federated series for killed worker {victim} did "
+                    "not retire within its liveness window (watermarks "
+                    "would grow monotonically across kill -9 rounds)")
+            elif "jepsen_fleet_host_worker_rss_peak_bytes" not in expo:
+                # retirement must not be vacuous: alive workers still
+                # publish the peak-RSS watermark series
+                failures.append(
+                    "no federated worker-rss-peak-bytes series for "
+                    "alive workers after the kill -9 round")
+            else:
+                print(f"federated watermarks retired with {victim}'s "
+                      "liveness; alive workers still publish peaks")
 
         # -- nemesis 2 (full mode): SIGSTOP a worker past its lease ---
         zombie = None

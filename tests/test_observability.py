@@ -166,7 +166,9 @@ def test_federated_metrics_host_labels_rollups_and_retirement(tmp_path):
     rows = [{"name": "worker-cells-done", "kind": "counter",
              "labels": {}, "value": 3},
             {"name": "worker-rss-bytes", "kind": "gauge",
-             "labels": {}, "value": 1000.0}]
+             "labels": {}, "value": 1000.0},
+            {"name": "worker-rss-peak-bytes", "kind": "gauge",
+             "labels": {}, "value": 1500.0}]
     _hb(coord, "w1", rows)
     _hb(coord, "w2", [dict(rows[0], value=5)])
     expo = prom.exposition(base=str(tmp_path), fleet=coord)
@@ -176,6 +178,8 @@ def test_federated_metrics_host_labels_rollups_and_retirement(tmp_path):
             in expo)
     assert "jepsen_fleet_rollup_worker_cells_done_total 8" in expo
     assert 'jepsen_fleet_host_worker_rss_bytes{host="w1"} 1000' in expo
+    assert ('jepsen_fleet_host_worker_rss_peak_bytes{host="w1"} 1500'
+            in expo)
     assert "jepsen_fleet_fed_workers_reporting 2" in expo
     # liveness retirement: silence both workers past ALIVE_LEASES —
     # their series stop rendering without any explicit removal call
@@ -202,7 +206,10 @@ def test_federation_cardinality_flat_under_worker_churn(tmp_path):
         coord.register({"worker": name, "host": name})
         _hb(coord, name, [{"name": "worker-cells-done",
                            "kind": "counter", "labels": {},
-                           "value": gen}])
+                           "value": gen},
+                          {"name": "worker-rss-peak-bytes",
+                           "kind": "gauge", "labels": {},
+                           "value": 1000 + gen}])
         expo = prom.exposition(base=str(tmp_path), fleet=coord)
         counts.append(sum(1 for ln in expo.splitlines()
                           if ln.startswith("jepsen_fleet_host_")
@@ -226,7 +233,8 @@ def test_worker_metrics_snapshot_shape_and_cap(tmp_path):
     assert 0 < len(rows) <= MAX_PUSHED_SERIES
     names = {r["name"] for r in rows}
     assert {"worker-cells-done", "worker-uploads-done",
-            "jit-cache-entries", "compile-cache-miss"} <= names
+            "jit-cache-entries", "compile-cache-miss",
+            "worker-rss-peak-bytes"} <= names
     for r in rows:
         assert r["kind"] in ("counter", "gauge")
         assert isinstance(r["value"], (int, float))

@@ -242,11 +242,24 @@ def test_noop_run_has_sampler_gauges_and_sample_events(tmp_path):
     samples = [e for e in evs if e["ev"] == "sample"]
     assert samples, "no resource sample in a noop run"
     assert samples[0].get("threads", 0) >= 1
+    # detach always lands one last synchronous sample (ISSUE 16
+    # satellite): peaks can't be lost to tick-interval truncation
+    assert samples[-1].get("final") is True
     doc = json.load(open(os.path.join(d, "telemetry.json")))
     gauges = {gg["name"] for gg in doc["metrics"]["gauges"]}
     assert "process-threads" in gauges
     if samples[0].get("rss_bytes"):  # /proc present on this platform
         assert "process-rss-bytes" in gauges
+        assert "process-rss-peak-bytes" in gauges
+        # watermark monotonicity across the sample series
+        peaks = [s["rss_peak_bytes"] for s in samples
+                 if "rss_peak_bytes" in s]
+        assert peaks == sorted(peaks) and \
+            peaks[-1] >= samples[-1]["rss_bytes"]
+        # ... and the enclosing run span carries the watermark as of
+        # export time (the final sample at detach can only grow it)
+        run_span = next(s for s in doc["spans"] if s["name"] == "run")
+        assert 0 < run_span["attrs"]["rss_peak_bytes"] <= peaks[-1]
 
 
 # --------------------------------- partial trace after mid-check SIGKILL

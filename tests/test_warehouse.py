@@ -401,6 +401,111 @@ def test_rebuild_from_torn_partial_store(tmp_path):
          "sessions": 0, "fleet-events": 0}
 
 
+def test_v4_to_v5_migration_on_populated_store(tmp_path):
+    """Satellite: opening a v4-era (PR 14) warehouse migrates it in
+    place — rollups and timelines survive untouched, the new
+    span_profile table and phase/counter columns stay empty until a
+    re-ingest, and ``rebuild`` over span_profile is idempotent."""
+    import sqlite3
+
+    d = _mk_run(tmp_path, "a-test", "t1")
+    tp = os.path.join(d, "telemetry.json")
+    with open(tp) as f:
+        doc = json.load(f)
+    doc["spans"][0]["children"][0]["attrs"] = {"profile": {
+        "elle.infer|i32[1024]": {"calls": 3, "compile_s": 0.21,
+                                 "execute_s": 0.05,
+                                 "device_dispatch_s": 0.012}}}
+    doc["meta"] = {"host": "host-a"}
+    with open(tp, "w") as f:
+        json.dump(doc, f)
+    path = _write_ledger(tmp_path, n=6)
+    # graft phase buckets + forensic counters onto the ledger so
+    # campaign_records exercises the v5 columns
+    recs = [json.loads(ln) for ln in open(path)]
+    for r in recs:
+        r["phases"] = {"check:la": {"compile_s": 0.1,
+                                    "execute_s": 0.2}}
+        r["counters"] = {"compile-cache-miss{site=checker}": 2.0}
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rel = os.path.relpath(path, str(tmp_path))
+    whp = wmod.warehouse_path(str(tmp_path))
+    wh = wmod.Warehouse(whp)
+    wh.ingest_store(str(tmp_path))
+    PROF_SQL = ("SELECT dir, host, site, shape, calls, compile_s, "
+                "execute_s, device_dispatch_s FROM span_profile "
+                "ORDER BY dir, site, shape")
+    prof0 = wh.query(PROF_SQL)[1]
+    assert prof0 and prof0[0][1] == "host-a" and \
+        prof0[0][2] == "elle.infer"
+    roll0 = wh.query("SELECT * FROM span_rollup ORDER BY 1, 2")[1]
+    gen0 = wh.query("SELECT * FROM span_gen_rollup ORDER BY 1, 2, 3")[1]
+    nrec = wh.query("SELECT COUNT(*) FROM campaign_records")[1][0][0]
+    assert roll0 and nrec == 12
+    fr0 = wh.forensic_records(rel)
+    assert fr0 and all(p and c for _, _, p, c in fr0)
+    wh.close()
+
+    # demote the file to v4: drop the ISSUE-16 surface wholesale.
+    # DROP COLUMN needs sqlite >= 3.35, so the columns go via the
+    # portable rename-copy-drop dance (which is also exactly what a
+    # real PR-14-era file looks like: no phases/counters at all).
+    V4_COLS = ("id, ledger, campaign, run, key, workload, fault, "
+               "seed, valid, error, degraded, deadline, dir, ops, "
+               "wall_s, gen, spec, ts, witness, trace")
+    db = sqlite3.connect(whp)
+    with db:
+        db.execute("DROP TABLE span_profile")
+        db.execute("ALTER TABLE campaign_records "
+                   "RENAME TO campaign_records_v5")
+        db.execute("""CREATE TABLE campaign_records(
+            id INTEGER PRIMARY KEY, ledger TEXT NOT NULL,
+            campaign TEXT, run TEXT, key TEXT, workload TEXT,
+            fault TEXT, seed TEXT, valid TEXT, error TEXT,
+            degraded TEXT, deadline INTEGER, dir TEXT, ops INTEGER,
+            wall_s REAL, gen TEXT, spec TEXT, ts TEXT, witness TEXT,
+            trace TEXT)""")
+        db.execute(f"INSERT INTO campaign_records({V4_COLS}) "
+                   f"SELECT {V4_COLS} FROM campaign_records_v5")
+        db.execute("DROP TABLE campaign_records_v5")
+        db.execute("CREATE INDEX IF NOT EXISTS cr_ledger_key ON "
+                   "campaign_records(ledger, key, id)")
+        db.execute("CREATE INDEX IF NOT EXISTS cr_ledger_run ON "
+                   "campaign_records(ledger, run, id)")
+        db.execute("INSERT OR REPLACE INTO meta(key, value) "
+                   "VALUES ('schema_version', '4')")
+    db.close()
+
+    wh = wmod.Warehouse(whp)
+    assert wh.query("SELECT value FROM meta WHERE key = "
+                    "'schema_version'")[1][0][0] == str(
+                        wmod.SCHEMA_VERSION)
+    # rollups and timelines are untouched by the migration...
+    assert wh.query("SELECT * FROM span_rollup "
+                    "ORDER BY 1, 2")[1] == roll0
+    assert wh.query("SELECT * FROM span_gen_rollup "
+                    "ORDER BY 1, 2, 3")[1] == gen0
+    assert wh.query("SELECT COUNT(*) FROM "
+                    "campaign_records")[1][0][0] == nrec
+    # ...but the new surface stays empty until a re-ingest; the
+    # incremental path is a digest no-op, so rebuild is the
+    # documented recovery route
+    assert wh.query("SELECT COUNT(*) FROM span_profile")[1][0][0] == 0
+    assert all(p == {} and c == {}
+               for _, _, p, c in wh.forensic_records(rel))
+    assert wh.ingest_store(str(tmp_path))["records"] == 0
+    assert wh.query("SELECT COUNT(*) FROM span_profile")[1][0][0] == 0
+    wh.rebuild(str(tmp_path))
+    assert wh.query(PROF_SQL)[1] == prof0
+    assert wh.forensic_records(rel) == fr0
+    # rebuild twice: span_profile lands identical (idempotent)
+    wh.rebuild(str(tmp_path))
+    assert wh.query(PROF_SQL)[1] == prof0
+    wh.close()
+
+
 def test_event_ingest_rotation_resets_and_since_filter(tmp_path):
     from jepsen_tpu.telemetry.stream import EventStream
 
@@ -695,12 +800,16 @@ class _GoldenFleet:
                  "labels": {}, "value": 3},
                 {"name": "jit-cache-entries", "kind": "gauge",
                  "labels": {}, "value": 7},
+                {"name": "worker-rss-peak-bytes", "kind": "gauge",
+                 "labels": {}, "value": 120_000_000},
             ]},
             "w2": {"host": "h2", "age-s": 2.0, "rows": [
                 {"name": "worker-cells-done", "kind": "counter",
                  "labels": {}, "value": 5},
                 {"name": "jit-cache-entries", "kind": "gauge",
                  "labels": {}, "value": 4},
+                {"name": "worker-rss-peak-bytes", "kind": "gauge",
+                 "labels": {}, "value": 95_000_000},
             ]},
         }
 
@@ -758,6 +867,11 @@ def _golden_exposition(base):
     reg.gauge("fleet-artifact-staging-bytes").set(4096)
     reg.gauge("jit-cache-entries").set(11)
     reg.counter("compile-cache-miss", site="elle.infer").inc(2)
+    # memory watermarks (ISSUE 16): peak-RSS / per-device / jit-cache
+    # high-watermark gauges published by the resource sampler
+    reg.gauge("process-rss-peak-bytes").set(104857600)
+    reg.gauge("device-memory-peak-bytes", device="cpu:0").set(8388608)
+    reg.gauge("jit-cache-entries-peak").set(13)
     cdir = os.path.join(str(base), "campaigns")
     os.makedirs(cdir, exist_ok=True)
     with open(os.path.join(cdir, "soak.live.json"), "w") as f:
