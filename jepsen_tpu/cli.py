@@ -366,19 +366,66 @@ def campaign_cmd(opts: argparse.Namespace) -> int:
 
 
 def fleet_cmd(opts: argparse.Namespace) -> int:
-    """`fleet serve|work|status` — the distributed campaign control
-    plane (docs/FLEET.md): a coordinator serves a spec as a leased
-    work queue over HTTP; remote workers claim, execute, and upload
-    verdicts; every cell lands exactly one attributable record."""
+    """`fleet serve|work|status|autopilot` — the distributed campaign
+    control plane (docs/FLEET.md): a coordinator serves a spec as a
+    leased work queue over HTTP; remote workers claim, execute, and
+    upload verdicts; every cell lands exactly one attributable record.
+    `autopilot` (docs/AUTOPILOT.md) is the continuous driver on top:
+    stream template generations forever, gate each one, quarantine +
+    auto-shrink regressions, scale the worker pool."""
     import json
     import signal
     import time as _time
     import urllib.request
 
     from . import report, web
-    from .fleet import FleetCoordinator, FleetWorker
+    from .fleet import Autopilot, FleetCoordinator, FleetWorker
 
     base = opts.store_dir
+    if opts.action == "autopilot":
+        if not opts.spec:
+            print("fleet autopilot needs a campaign spec template",
+                  file=sys.stderr)
+            return 2
+        url = f"http://{opts.host}:{opts.port}"
+        try:
+            ap = Autopilot(
+                opts.spec, base, lease_s=opts.lease,
+                run_deadline_s=opts.run_deadline,
+                generations=getattr(opts, "generations", None),
+                spans=tuple(getattr(opts, "gate_span", None)
+                            or ("workload", "check:*")),
+                coordinator_url=url,
+                min_workers=getattr(opts, "workers_min", 0),
+                max_workers=getattr(opts, "workers_max", 0),
+                worker_version=getattr(opts, "worker_version", None)
+                or "dev")
+        except (OSError, ValueError) as e:
+            print(f"fleet: bad spec {opts.spec!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        try:
+            signal.signal(signal.SIGTERM, lambda *_: ap.stop.set())
+        except ValueError:
+            pass  # not the main thread (embedded use)
+        srv = web.serve(port=opts.port, base=base, host=opts.host,
+                        fleet=ap.coordinator, background=True)
+        print(f"autopilot {ap.name}: serving {url}, journal digest "
+              f"{ap.journal.digest()}, {len(ap.journal.order)} "
+              f"generation(s) journaled, "
+              f"{len(ap.journal.quarantined)} quarantined", flush=True)
+        try:
+            out = ap.run()
+        except KeyboardInterrupt:
+            ap.close()
+            return 1
+        finally:
+            srv.server_close()
+            ap.coordinator.close()
+        print(f"autopilot {ap.name}: {out['generations']} "
+              f"generation(s) closed, quarantined="
+              f"{out['quarantined'] or '[]'}, digest {out['digest']}")
+        return 0
     if opts.action == "serve":
         if not opts.spec:
             print("fleet serve needs a campaign spec", file=sys.stderr)
@@ -444,7 +491,9 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
                              backend=opts.backend, mesh=opts.mesh,
                              poll_s=opts.poll,
                              claim_budget_s=opts.claim_budget,
-                             upload=getattr(opts, "upload", False))
+                             upload=getattr(opts, "upload", False),
+                             version=getattr(opts, "worker_version",
+                                             None))
         # SIGTERM drains gracefully: finish the in-flight cell, release
         # unstarted claims, exit — the lease protocol covers kill -9
         try:
@@ -477,10 +526,16 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
               f"{c.get('queued')} queued, {c.get('claimed')} claimed, "
               f"{c.get('requeues')} requeues, {c.get('duplicates')} "
               f"duplicates discarded")
+        # the scaler's two inputs (ISSUE 17 satellite)
+        p95 = s.get("claim-latency-p95-s")
+        print(f"queue depth: {s.get('queue-depth')}  "
+              f"claim-latency p95: "
+              f"{'-' if p95 is None else f'{p95:.3f}s'}")
         print(f"digest: {s.get('digest')}  boot: {s.get('boot-digest')}")
         for w, d in sorted((s.get("workers") or {}).items()):
             line = (f"  worker {w}: host={d.get('host')} "
                     f"slots={d.get('device-slots')} "
+                    f"version={d.get('version') or '-'} "
                     f"seen {d.get('age-s')}s ago "
                     f"({'alive' if d.get('alive') else 'silent'})")
             wd = d.get("windows")
@@ -508,6 +563,20 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
                     for w in gens[g])
                 anchor = (f" t0={t0s[g]}" if g in t0s else "")
                 print(f"  gen {g}: {digests.get(g)}{anchor} {wins}")
+        ap = s.get("autopilot")
+        if ap:
+            print(f"autopilot: generation {ap.get('generation')} "
+                  f"({ap.get('generations-closed')} closed), "
+                  f"{len(ap.get('quarantined') or {})} quarantined, "
+                  f"worker version {ap.get('worker-version')}, "
+                  f"journal {ap.get('journal-digest')}")
+            for k, q in sorted((ap.get("quarantined") or {}).items()):
+                print(f"  quarantined {k}: {q.get('span')} "
+                      f"{q.get('rel-delta')} at {q.get('gen')}")
+            for v in ap.get("last-verdicts") or []:
+                print(f"  gate[{v.get('to-gen')}] "
+                      f"{v.get('span')}: {v.get('status')} "
+                      f"(rc {v.get('rc')})")
         return 0
     print(f"fleet: unknown action {opts.action!r}", file=sys.stderr)
     return 2
@@ -586,6 +655,24 @@ def obs_cmd(opts: argparse.Namespace) -> int:
             print("obs: --bench matched/ingested no files",
                   file=sys.stderr)
             return 2
+        return 0
+    if opts.action == "gc":
+        # store retention (ISSUE 17 satellite / ROADMAP 5c): archive
+        # landed run dirs past --retention to _archive/ — needs no
+        # warehouse (it operates on the store itself; the next ingest
+        # simply no longer sees the archived dirs)
+        from . import store as store_mod
+
+        retention = getattr(opts, "retention", None)
+        if retention is None:
+            print("obs: gc needs --retention <seconds>",
+                  file=sys.stderr)
+            return 2
+        stats = store_mod.gc_runs(base, retention_s=float(retention))
+        print(f"obs gc: archived {stats['archived']} run dir(s) to "
+              f"{store_mod.archive_dir(base)} "
+              f"({stats['kept']} kept within retention, "
+              f"{stats['skipped']} unlanded skipped)")
         return 0
     if opts.action in ("gate", "profile", "diff"):
         # campaign analytics: Index answers from the warehouse when it
@@ -867,7 +954,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                              "(docs/TELEMETRY.md)")
     po.add_argument("action",
                     choices=("ingest", "rebuild", "gate", "sql",
-                             "bench", "timeline", "profile", "diff"))
+                             "bench", "timeline", "profile", "diff",
+                             "gc"))
     po.add_argument("query", nargs="?",
                     help="SQL for the sql action (read-only); run id "
                          "or 32-hex trace id for the timeline action; "
@@ -902,6 +990,11 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                     help="gate: minimum runs per generation; fewer "
                          "exits 2 (cannot evaluate), never a silent "
                          "pass/fail")
+    po.add_argument("--retention", type=float, default=None,
+                    metavar="SECONDS",
+                    help="gc: archive landed run dirs older than this "
+                         "to <store>/_archive/ (they leave store "
+                         "scans and future warehouse ingests)")
 
     pc = sub.add_parser("campaign",
                         help="run/inspect a fleet of tests from a "
@@ -931,9 +1024,12 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                          help="distributed campaign execution: a "
                               "leased work queue served over HTTP + "
                               "remote workers (docs/FLEET.md)")
-    pfl.add_argument("action", choices=("serve", "work", "status"))
+    pfl.add_argument("action", choices=("serve", "work", "status",
+                                        "autopilot"))
     pfl.add_argument("spec", nargs="?",
-                     help="campaign spec JSON file (serve)")
+                     help="campaign spec JSON file (serve), or spec "
+                          "TEMPLATE (autopilot: expanded into "
+                          "generations forever)")
     pfl.add_argument("-p", "--port", type=int, default=8080)
     pfl.add_argument("--host", default="127.0.0.1",
                      help='bind address (use "0.0.0.0" so remote '
@@ -979,6 +1075,28 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                           "the same port, so cells with "
                           '"live-check" opts stream here '
                           "(docs/VERIFIER.md)")
+    pfl.add_argument("--generations", type=int, default=None,
+                     help="autopilot: stop after this many gated "
+                          "generations (default: stream forever)")
+    pfl.add_argument("--gate-span", dest="gate_span", action="append",
+                     help="autopilot: span site(s) gated per "
+                          "generation (repeatable, * globs; default "
+                          "workload + check:*)")
+    pfl.add_argument("--workers-min", dest="workers_min", type=int,
+                     default=0,
+                     help="autopilot: scaler lower bound on managed "
+                          "local workers (0 = bring your own workers)")
+    pfl.add_argument("--workers-max", dest="workers_max", type=int,
+                     default=0,
+                     help="autopilot: scaler upper bound; 0 disables "
+                          "the scaler entirely")
+    pfl.add_argument("--worker-version", dest="worker_version",
+                     default=None,
+                     help="work: advertised build version (default "
+                          "$JEPSEN_WORKER_VERSION or 'dev'); "
+                          "autopilot: target version — changing it on "
+                          "a live loop rolls the pool one worker at "
+                          "a time")
     pfl.add_argument("--staging-retention", dest="staging_retention",
                      type=float, default=None,
                      help="serve: expire abandoned artifact-upload "

@@ -14,11 +14,20 @@ zombie double-completions (discarded, at-most-once verdicts).
 The contract is the campaign contract, distributed: every cell
 terminates with exactly one attributable verdict record in the same
 append-only index a single-process `run_campaign` writes.
+
+On top sits the **autopilot** (`autopilot.Autopilot`, ``cli fleet
+autopilot``, docs/AUTOPILOT.md): the continuous driver that streams
+spec-template generations into the queue forever, gates each one,
+quarantines + auto-shrinks regressions, and scales the worker pool —
+including rolling version upgrades — from its own crash-replayable
+journal.
 """
 
+from .autopilot import Autopilot, AutopilotJournal, autopilot_path
 from .coordinator import FleetCoordinator
 from .queue import WorkQueue, fleet_path, record_digest
 from .worker import FleetWorker
 
-__all__ = ["FleetCoordinator", "FleetWorker", "WorkQueue",
+__all__ = ["Autopilot", "AutopilotJournal", "FleetCoordinator",
+           "FleetWorker", "WorkQueue", "autopilot_path",
            "fleet_path", "record_digest"]

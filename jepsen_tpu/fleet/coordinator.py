@@ -112,6 +112,14 @@ class FleetCoordinator:
         self.gen = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
         self.spec_digest = plan_mod.spec_digest(self.spec)
         self._lock = threading.RLock()
+        #: streamed-generation labels (ISSUE 17): run id -> the
+        #: autopilot generation label its record is stamped with (the
+        #: gate groups samples by this; admit() fills it)
+        self._gen_by_run: Dict[str, str] = {}
+        #: the owning `fleet.autopilot.Autopilot`, when one drives
+        #: this coordinator — /fleet/status and the web panel join
+        #: its status_doc through this
+        self.autopilot: Optional[Any] = None
         for rs in self.specs:
             # same opt plumbing as run_campaign: a hard per-run wall
             # also bounds the checkers cooperatively
@@ -194,10 +202,53 @@ class FleetCoordinator:
             logger.info("fleet %s: reconciled missing index record "
                         "for %s", self.name, run)
 
+    def admit(self, run_specs, gen: Optional[str] = None
+              ) -> Dict[str, int]:
+        """Stream a new generation of cells into the LIVE queue (the
+        autopilot's enqueue seam, ISSUE 17): extend the plan, map each
+        run id to its generation label for record stamping, and
+        enqueue idempotently — already-indexed cells count done
+        immediately (restart-free resume), already-queued ids are
+        refused by the ledger.  Safe to call any number of times with
+        the same specs; that is the crash-window contract."""
+        added = enq = already = 0
+        with self._lock:
+            known = {rs.run_id for rs in self.specs}
+            indexed = self.idx.completed_ids()
+            for rs in run_specs:
+                rid = rs.run_id
+                if gen:
+                    self._gen_by_run[rid] = str(gen)
+                if rid not in known:
+                    self.specs.append(rs)
+                    known.add(rid)
+                    added += 1
+                if rid in indexed:
+                    if rid not in self._done_ids:
+                        self._done_ids.add(rid)
+                        already += 1
+                elif self.queue.enqueue(rs.to_dict()):
+                    enq += 1
+            hb = self._hbs.get(self.name)
+            if hb is not None:
+                try:
+                    hb.state["total"] = len(self.specs)
+                    hb.state["done"] = len(self._done_ids)
+                except Exception:  # noqa: BLE001 — display only
+                    pass
+        self._update_gauges()
+        logger.info("fleet %s: admitted %s (+%d cells, %d enqueued, "
+                    "%d already indexed)", self.name, gen or "-",
+                    added, enq, already)
+        return {"admitted": added, "enqueued": enq,
+                "already-done": already}
+
     def _stamp(self, record: Dict[str, Any], worker: Any
                ) -> Dict[str, Any]:
         rec = dict(record)
-        rec.setdefault("gen", self.gen)
+        with self._lock:
+            gen = self._gen_by_run.get(str(record.get("run") or ""))
+        rec.setdefault("gen", gen or self.gen)
         rec.setdefault("spec", self.spec_digest)
         if worker:
             rec.setdefault("fleet-worker", str(worker))
@@ -262,6 +313,10 @@ class FleetCoordinator:
                 "backend": body.get("backend"),
                 "mesh": body.get("mesh"),
                 "device-slots": int(body.get("device-slots", 1)),
+                # rolling-upgrade visibility (ISSUE 17): the stamped
+                # build version, refreshed on heartbeat, rendered as
+                # jepsen_fleet_host_info{host,version}
+                "version": str(body.get("version") or "") or None,
                 "registered": round(time.time(), 3),
                 "last-seen": round(time.time(), 3),
             }
@@ -285,7 +340,13 @@ class FleetCoordinator:
         self._update_gauges()
         if spec is None:
             c = self.queue.counts()
-            return 200, {"spec": None, "finished": self.finished,
+            # under an autopilot the fleet is never "finished" from a
+            # worker's perspective — a drained generation is just the
+            # gap before the next one streams in (ISSUE 17).  Workers
+            # idle-poll; the autopilot drains them by SIGTERM when the
+            # loop actually ends.
+            fin = self.finished and self.autopilot is None
+            return 200, {"spec": None, "finished": fin,
                          "queued": c["queued"], "claimed": c["claimed"]}
         out = {"spec": spec, "lease-s": self.lease_s,
                "deadline": deadline}
@@ -348,6 +409,11 @@ class FleetCoordinator:
                 known = str(worker) in self.workers
             if known:
                 self._touch(str(worker))
+                if body.get("version"):
+                    with self._lock:
+                        if str(worker) in self.workers:
+                            self.workers[str(worker)]["version"] = \
+                                str(body["version"])
             if "state" in body:
                 hb.worker(str(worker), body.get("state"))
         out: Dict[str, Any] = {"ok": True, "lease-s": self.lease_s}
@@ -468,6 +534,7 @@ class FleetCoordinator:
                        "backend": c.get("backend"),
                        "mesh": c.get("mesh"),
                        "device-slots": c.get("device-slots"),
+                       "version": c.get("version"),
                        "age-s": round(now - c["last-seen"], 3),
                        "alive": now - c["last-seen"] <=
                        ALIVE_LEASES * self.lease_s}
@@ -493,6 +560,7 @@ class FleetCoordinator:
                 workers[w] = row
             done = len(self._done_ids)
         self._update_gauges()
+        counts = self.queue.counts()
         out = {
             "campaign": self.name,
             "gen": self.gen,
@@ -500,13 +568,23 @@ class FleetCoordinator:
             "total": len(self.specs),
             "done": done,
             "finished": done >= len(self.specs),
-            "counts": self.queue.counts(),
+            "counts": counts,
+            # the scaler's two inputs (ISSUE 17 satellite), first-class
+            # instead of derivable-via-obs-sql
+            "queue-depth": counts["queued"],
+            "claim-latency-p95-s": self.queue.claim_latency_p95(),
             "leases": self.queue.leases(),
             "digest": self.queue.digest(),
             "boot-digest": self.boot_digest,
             "lease-s": self.lease_s,
             "workers": workers,
         }
+        ap = self.autopilot
+        if ap is not None:
+            try:
+                out["autopilot"] = ap.status_doc()
+            except Exception:  # noqa: BLE001 — panel is best-effort
+                logger.debug("autopilot status failed", exc_info=True)
         if self.sched:
             with self._lock:
                 t0s = {str(g): t for g, t in
@@ -530,7 +608,7 @@ class FleetCoordinator:
         with self._lock:
             caps = self.workers.setdefault(worker, {
                 "host": None, "backend": None, "mesh": None,
-                "device-slots": 1,
+                "device-slots": 1, "version": None,
                 "registered": round(time.time(), 3),
                 "last-seen": round(time.time(), 3)})
             caps["last-seen"] = round(time.time(), 3)
@@ -565,6 +643,7 @@ class FleetCoordinator:
                 mx = c.get("metrics")
                 if isinstance(mx, dict) and mx.get("rows"):
                     out[w] = {"host": c.get("host"),
+                              "version": c.get("version"),
                               "rows": list(mx["rows"]),
                               "age-s": round(now - mx["ts"], 3)}
         return out
@@ -612,6 +691,11 @@ class FleetCoordinator:
             reg.gauge("fleet-leases-active").set(c["claimed"])
             for state in ("queued", "claimed", "done"):
                 reg.gauge("fleet-cells", state=state).set(c[state])
+            # the scaler's two inputs (ISSUE 17): depth + claim p95
+            reg.gauge("fleet-queue-depth").set(c["queued"])
+            p95 = self.queue.claim_latency_p95()
+            if p95 is not None:
+                reg.gauge("fleet-claim-latency-p95-s").set(p95)
             if self.sched:
                 # chaos visibility: currently-open windows across the
                 # fleet, by fault family, from the workers' heartbeat

@@ -394,6 +394,31 @@ class WorkQueue:
                     "claimed": c.get("claimed_ts"),
                     "claims": c["claims"], "requeues": c["requeues"]}
 
+    def claim_latencies(self, last: int = 50) -> List[float]:
+        """The most recent cells' enqueue->first-claim waits (ledger
+        timestamps), in enqueue order — the raw material for the
+        scaler's claim-latency signal (ISSUE 17)."""
+        with self._lock:
+            out = []
+            for run in self._order:
+                c = self.cells[run]
+                enq, clm = c.get("enqueued_ts"), c.get("claimed_ts")
+                if isinstance(enq, (int, float)) and \
+                        isinstance(clm, (int, float)) and clm >= enq:
+                    out.append(round(clm - enq, 6))
+            return out[-max(1, int(last)):]
+
+    def claim_latency_p95(self, last: int = 50) -> Optional[float]:
+        """Nearest-rank p95 over `claim_latencies` — one of the two
+        signals the autopilot scaler sizes the worker pool on (the
+        other is queue depth).  None until a cell has been claimed."""
+        xs = sorted(self.claim_latencies(last))
+        if not xs:
+            return None
+        import math
+
+        return xs[max(0, math.ceil(0.95 * len(xs)) - 1)]
+
     def leases(self) -> List[Dict[str, Any]]:
         """Active claims: run / worker / lease deadline."""
         with self._lock:

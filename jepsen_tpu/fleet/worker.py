@@ -69,10 +69,17 @@ class FleetWorker:
                  retry: Optional[RetryPolicy] = None,
                  timeout_s: float = 10.0,
                  claim_budget_s: float = 120.0,
-                 upload: bool = False):
+                 upload: bool = False,
+                 version: Optional[str] = None):
         self.url = coordinator.rstrip("/")
         self.base = base or store.BASE
         self.name = name or f"{socket.gethostname()}-{os.getpid()}"
+        #: rolling-upgrade identity (ISSUE 17): stamped at register
+        #: and every heartbeat so the coordinator (and the autopilot's
+        #: upgrade tick) can tell which build each worker runs
+        self.version = str(
+            version or os.environ.get("JEPSEN_WORKER_VERSION")
+            or "dev")
         self.device_slots = int(device_slots)
         self.backend = backend
         self.mesh = mesh
@@ -286,7 +293,8 @@ class FleetWorker:
         r = self._post("fleet.register", "/fleet/register", {
             "worker": self.name, "host": socket.gethostname(),
             "backend": self.backend, "mesh": self.mesh,
-            "device-slots": self.device_slots})
+            "device-slots": self.device_slots,
+            "version": self.version})
         if isinstance(r.get("lease-s"), (int, float)):
             self.lease_s = float(r["lease-s"])
         logger.info("fleet worker %s registered with %s (campaign %s, "
@@ -528,6 +536,7 @@ class FleetWorker:
                 try:
                     r = self._post("fleet.heartbeat", "/fleet/heartbeat",
                                    {"worker": self.name, "state": state,
+                                    "version": self.version,
                                     "windows": self._window_ticks(t0),
                                     "metrics": self.metrics_snapshot(),
                                     "renew": [run_id]})
@@ -555,6 +564,7 @@ class FleetWorker:
         try:
             self._post("fleet.heartbeat", "/fleet/heartbeat",
                        {"worker": self.name, "state": state,
+                        "version": self.version,
                         "windows": self._window_ticks(t0),
                         "metrics": self.metrics_snapshot(),
                         "renew": [run_id]})
@@ -688,6 +698,7 @@ class FleetWorker:
             try:
                 self._post("fleet.heartbeat", "/fleet/heartbeat",
                            {"worker": self.name, "state": None,
+                            "version": self.version,
                             "metrics": self.metrics_snapshot(),
                             "windows": None})
             except Exception:  # noqa: BLE001

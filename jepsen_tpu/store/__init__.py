@@ -198,10 +198,11 @@ def tests(name: Optional[str] = None, *, base: Optional[str] = None) -> List[str
         nd = os.path.join(b, n)
         # skip the base-level "current" symlink (and anything like it):
         # only real per-name directories hold runs — and the campaigns/
-        # + verifier/ + fleet/ subtrees, which hold ledgers and
-        # verifier session dirs, not run dirs
+        # + verifier/ + fleet/ subtrees (ledgers and verifier session
+        # dirs, not run dirs) and _archive/ (runs retired by
+        # `gc_runs` retention: archived, out of every live scan)
         if os.path.islink(nd) or not os.path.isdir(nd) \
-                or n in ("campaigns", "verifier", "fleet"):
+                or n in ("campaigns", "verifier", "fleet", "_archive"):
             continue
         for ts in os.listdir(nd):
             d = os.path.join(nd, ts)
@@ -229,3 +230,74 @@ def delete(name: str, ts: Optional[str] = None, *, base: Optional[str] = None) -
     d = os.path.join(b, sanitize(name)) if ts is None else os.path.join(b, sanitize(name), ts)
     if os.path.isdir(d):
         shutil.rmtree(d)
+
+
+def archive_dir(base: Optional[str] = None) -> str:
+    """Where `gc_runs` retires run dirs: ``<base>/_archive/<name>/<ts>``
+    — inside the store (same filesystem, atomic ``os.replace``) but
+    outside every live scan (`tests` skips ``_archive``, and the
+    warehouse ingest rides `tests`)."""
+    return os.path.join(base or BASE, "_archive")
+
+
+def _run_dir_age_s(d: str, now: float) -> float:
+    """A run dir's age from its UTC timestamp basename
+    (``YYYYmmddTHHMMSS.mmmZ``), falling back to mtime for
+    foreign-named dirs."""
+    ts = os.path.basename(d)
+    try:
+        import calendar
+
+        t = calendar.timegm(time.strptime(ts[:15], "%Y%m%dT%H%M%S"))
+        return now - t
+    except (ValueError, OverflowError):
+        try:
+            return now - os.path.getmtime(d)
+        except OSError:
+            return 0.0
+
+
+def gc_runs(base: Optional[str] = None, *, retention_s: float,
+            now: Optional[float] = None) -> dict:
+    """Retention for run dirs (``cli obs gc --retention <s>``, ISSUE 17
+    satellite / ROADMAP 5c): archive **landed** runs older than
+    `retention_s` to ``_archive/`` — the verifier's session-archival
+    discipline (atomic ``os.replace``, millisecond suffix on
+    collision) applied to the store itself, so months of autopilot
+    don't grow the live store monotonically.  Unlanded dirs (no
+    ``results.json`` yet: still executing, or crashed mid-run — the
+    warehouse's ``status='running'`` rule) are never archived
+    regardless of age; a post-mortem owns them.  Returns
+    ``{"archived", "kept", "skipped"}`` counts."""
+    b = base or BASE
+    t = time.time() if now is None else now
+    stats = {"archived": 0, "kept": 0, "skipped": 0}
+    for d in tests(base=b):
+        if _run_dir_age_s(d, t) < retention_s:
+            stats["kept"] += 1
+            continue
+        if not os.path.exists(os.path.join(d, "results.json")):
+            stats["skipped"] += 1
+            continue
+        name = os.path.basename(os.path.dirname(d))
+        dst_dir = os.path.join(archive_dir(b), name)
+        os.makedirs(dst_dir, exist_ok=True)
+        dst = os.path.join(dst_dir, os.path.basename(d))
+        if os.path.exists(dst):
+            dst = f"{dst}.{int(t * 1000)}"
+        os.replace(d, dst)
+        stats["archived"] += 1
+        # tidy the per-name dir: drop a now-dangling "latest" symlink
+        # and the dir itself if nothing is left
+        nd = os.path.dirname(d)
+        link = os.path.join(nd, "latest")
+        if os.path.islink(link) and not os.path.exists(link):
+            try:
+                os.unlink(link)
+            except OSError:
+                pass
+        try:
+            os.rmdir(nd)
+        except OSError:
+            pass  # still holds runs (or the refreshed symlink)
+    return stats

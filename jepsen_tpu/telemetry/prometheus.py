@@ -284,6 +284,19 @@ def render_fleet(fleet: Any) -> List[str]:
                "alive workers whose metrics snapshot is being "
                "federated").append(
         f"jepsen_fleet_fed_workers_reporting {len(fed)}")
+    # rolling-upgrade visibility (ISSUE 17 satellite): one info series
+    # per ALIVE versioned worker.  Cardinality is pinned the same way
+    # as every host_* series — the set retires with worker liveness,
+    # so an upgrade churning through worker names keeps the scrape
+    # flat instead of accreting dead versions.
+    for w in sorted(fed):
+        ver = fed[w].get("version")
+        if ver:
+            doc.family("jepsen_fleet_host_info", "gauge",
+                       "alive fleet workers by stamped version"
+                       ).append(
+                "jepsen_fleet_host_info"
+                f"{_labels_str({'host': w, 'version': ver})} 1")
     rollup: Dict[Tuple[str, str, str], float] = {}
     for w in sorted(fed):
         for r in fed[w].get("rows") or []:

@@ -1458,6 +1458,7 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
             f"<tr><td>{html.escape(w)}</td>"
             f"<td>{html.escape(str(d.get('host')))}</td>"
             f"<td>{html.escape(str(d.get('backend')))}</td>"
+            f"<td>{html.escape(str(d.get('version') or '—'))}</td>"
             f"<td>{d.get('device-slots')}</td>"
             f"<td>{d.get('age-s')}s</td>"
             f"<td>{'alive' if d.get('alive') else 'silent'}</td>"
@@ -1494,6 +1495,50 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
                 "installed digests)</p>"
                 "<table><tr><th>gen</th><th>digest</th>"
                 f"<th>windows</th></tr>{''.join(grows)}</table>")
+        ap_html = ""
+        ap = s.get("autopilot")
+        if ap:
+            # the autopilot panel (ISSUE 17): generation counter,
+            # quarantine set, last gate verdicts, managed workers
+            qrows = "".join(
+                f"<tr><td><code>{html.escape(k)}</code></td>"
+                f"<td>{html.escape(str(q.get('span')))}</td>"
+                f"<td>{q.get('rel-delta')}</td>"
+                f"<td>{html.escape(str(q.get('gen')))}</td></tr>"
+                for k, q in sorted(
+                    (ap.get("quarantined") or {}).items()))
+            vrows = "".join(
+                f"<tr><td>{html.escape(str(v.get('span')))}</td>"
+                f"<td>{html.escape(str(v.get('status')))}</td>"
+                f"<td>{v.get('rc')}</td>"
+                f"<td>{html.escape(str(v.get('reason') or ''))}</td>"
+                "</tr>"
+                for v in ap.get("last-verdicts") or [])
+            arows = "".join(
+                f"<tr><td>{html.escape(n)}</td>"
+                f"<td>{html.escape(str(w.get('version')))}</td>"
+                f"<td>{w.get('pid')}</td>"
+                f"<td>{'running' if w.get('running') else 'exited'}"
+                f"{' (draining)' if w.get('draining') else ''}"
+                "</td></tr>"
+                for n, w in sorted((ap.get("workers") or {}).items()))
+            ap_html = (
+                "<h2>autopilot</h2>"
+                f"<p>generation <b>{html.escape(str(ap.get('generation')))}</b> "
+                f"({ap.get('generations-closed')} closed) &middot; "
+                f"target worker version "
+                f"<code>{html.escape(str(ap.get('worker-version')))}</code> "
+                f"&middot; journal "
+                f"<code>{html.escape(str(ap.get('journal-digest')))}</code></p>"
+                "<h3>quarantined cells</h3>"
+                "<table><tr><th>key</th><th>span</th><th>rel delta</th>"
+                f"<th>since gen</th></tr>{qrows or '<tr><td colspan=4>(none)</td></tr>'}</table>"
+                "<h3>last gate verdicts</h3>"
+                "<table><tr><th>span</th><th>status</th><th>rc</th>"
+                f"<th>reason</th></tr>{vrows or '<tr><td colspan=4>(no closed generation yet)</td></tr>'}</table>"
+                "<h3>managed workers</h3>"
+                "<table><tr><th>worker</th><th>version</th><th>pid</th>"
+                f"<th>state</th></tr>{arows or '<tr><td colspan=4>(none)</td></tr>'}</table>")
         name = str(s.get("campaign"))
         state = "finished" if s.get("finished") else "running"
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
@@ -1508,16 +1553,21 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
 <a href="/fleet/status">status.json</a></p>
 <h1>fleet — {html.escape(name)}</h1>
 <p>{state}: {s.get("done")}/{s.get("total")} cells done &middot;
-{c.get("queued")} queued, {c.get("claimed")} claimed &middot;
+queue depth {s.get("queue-depth")}, claim-latency p95
+{s.get("claim-latency-p95-s") if s.get("claim-latency-p95-s")
+ is not None else "&mdash;"}s &middot;
+{c.get("claimed")} claimed &middot;
 {c.get("requeues")} requeues, {c.get("duplicates")} duplicate
 completions discarded &middot; queue digest
 <code>{html.escape(str(s.get("digest")))}</code></p>
+{ap_html}
 <h2>workers</h2>
 <table><tr><th>worker</th><th>host</th><th>backend</th>
+<th>version</th>
 <th>device slots</th><th>last seen</th><th></th>
 <th>verdict freshness</th>
 <th>installed windows</th></tr>{wrows or
-'<tr><td colspan="8">(none registered)</td></tr>'}</table>
+'<tr><td colspan="9">(none registered)</td></tr>'}</table>
 <h2>active leases</h2>
 <table><tr><th>run</th><th>worker</th><th>deadline</th></tr>{lrows or
 '<tr><td colspan="3">(none)</td></tr>'}</table>

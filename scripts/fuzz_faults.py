@@ -269,6 +269,73 @@ def run_one(seed: int, p: float, deadline_s: float) -> dict:
     return row
 
 
+def autopilot_chaos_round(seed: int, p: float = 0.35) -> dict:
+    """Chaos on the autopilot's own decision loop (ISSUE 17): a
+    seeded FaultPlan on every ``autopilot.*`` seam — enqueue, gate,
+    shrink, scale — while a synthetic fleet drains generations.  The
+    invariant: the loop never wedges and never loses attribution —
+    every generation closes, every verdict carries its to-gen and an
+    rc in {0, 1, 2}, and an independent journal replay reaches the
+    identical digest.  `scripts/soak_autopilot.py` imports this as its
+    chaos round; ``--autopilot`` runs it standalone."""
+    import tempfile as _tf
+    import threading as _th
+
+    from jepsen_tpu.fleet import Autopilot, AutopilotJournal, \
+        autopilot_path
+    from jepsen_tpu.resilience import FaultPlan, use
+
+    base = _tf.mkdtemp(prefix="fuzz-autopilot-")
+    spec = {"name": "fuzz-ap", "workloads": ["bank"],
+            "seeds": [0, 1, 2], "opts": {"time-limit": 0.2}}
+    ap = Autopilot(spec, base, generations=2, spans=("workload",),
+                   poll_s=0.02)
+
+    def drain():
+        while not ap.stop.is_set():
+            code, out = ap.coordinator.claim({"worker": "syn"})
+            sp = out.get("spec") if code == 200 else None
+            if not sp:
+                time.sleep(0.01)
+                continue
+            key = (f'{sp["workload_label"]}|{sp["fault_label"]}'
+                   f'|s{sp["seed"]}')
+            ap.coordinator.complete({
+                "worker": "syn", "run": sp["run_id"],
+                "record": {"run": sp["run_id"], "key": key,
+                           "workload": sp["workload_label"],
+                           "fault": sp["fault_label"],
+                           "seed": sp["seed"], "valid?": True,
+                           "spans": {"workload": 0.1}}})
+
+    t = _th.Thread(target=drain, daemon=True)
+    t.start()
+    plan = FaultPlan(seed=seed, p=p, kinds=("oom", "stall"),
+                     stall_s=0.005,
+                     sites="autopilot.enqueue|autopilot.gate"
+                           "|autopilot.shrink|autopilot.scale")
+    try:
+        with use(plan):
+            out = ap.run()
+    finally:
+        ap.stop.set()
+        t.join(timeout=5)
+        ap.coordinator.close()
+    assert out["generations"] == 2, \
+        f"autopilot wedged under seam chaos ({out})"
+    for label in ap.journal.closed_labels():
+        for v in ap.journal.gens[label]["verdicts"]:
+            assert v.get("to-gen") == label and \
+                v.get("rc") in (0, 1, 2), \
+                f"unattributable verdict under chaos: {v}"
+    replay = AutopilotJournal(
+        autopilot_path("fuzz-ap", base)).digest()
+    assert replay == ap.journal.digest(), \
+        "journal replay diverged under seam chaos"
+    return {"seed": seed, "injected": len(plan.injected),
+            "generations": out["generations"]}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rounds", type=int, default=10)
@@ -277,7 +344,22 @@ def main() -> int:
                     help="per-call fault probability")
     ap.add_argument("--deadline", type=float, default=60.0,
                     help="per-check deadline seconds")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="run the autopilot seam-chaos rounds instead")
     args = ap.parse_args()
+
+    if args.autopilot:
+        t0 = time.time()
+        inj = 0
+        for seed in range(args.seed0, args.seed0 + args.rounds):
+            row = autopilot_chaos_round(seed, max(args.p, 0.3))
+            inj += row["injected"]
+            print(f"seed {seed}: injected={row['injected']} "
+                  f"generations={row['generations']}")
+        print(f"\n{args.rounds} autopilot rounds in "
+              f"{time.time() - t0:.1f}s: {inj} seam faults injected, "
+              "every generation closed with attributable verdicts")
+        return 0
 
     t0 = time.time()
     totals = {"injected": 0, "degraded": 0, "unknown": 0}

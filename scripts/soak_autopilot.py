@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Autopilot soak: unattended generations under kill -9 + rolling
+upgrade.  ISSUE 17 acceptance driver.
+
+A **child** process runs the real thing — `fleet.Autopilot` with its
+own HTTP control plane and managed ``fleet work`` subprocess pool —
+streaming generations of a real bank campaign (telemetry on, spans
+recorded).  Generation 2 carries a seeded regression: the mutator
+bumps client latency ~2.5x AND installs a skew nemesis window, so the
+workload span blows past the gate threshold and the cells go invalid
+(a real shrinkable anomaly, not a synthetic record).
+
+The **parent** orchestrates the failure script:
+
+- child A (phase ``a``) streams generations until the parent sees
+  generation g0001 mid-flight, then the whole "host" is ``kill -9``'d
+  — coordinator AND its managed workers;
+- child B (phase ``b``) restarts on the same port + store.  Resume
+  must re-admit from the journal with ZERO duplicate cells (the
+  constructor digest must equal the parent's independent replay of
+  the crashed journal).  It closes the resumed generation, catches the
+  seeded regression (gate rc 1 -> quarantine -> REAL auto-shrink to a
+  witness), then flips ``worker_version`` v1 -> v2 and runs the last
+  generation through the rolling upgrade — one replacement at a time,
+  every cell landing, ``jepsen_fleet_host_info`` cardinality flat.
+
+The run FAILS unless: every admitted cell lands exactly one
+attributable verdict (done == cells, duplicates == 0), exactly one
+cell key is quarantined with a witness-bearing shrink outcome, the
+final journal replays to the child's reported digest, every surviving
+worker is v2, and the host_info series count is identical before and
+after the upgrade.
+
+Usage::
+
+    python scripts/soak_autopilot.py --fast   # tier-1 acceptance
+    python scripts/soak_autopilot.py          # wider soak
+
+Exit 0 iff the acceptance holds.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+NAME = "ap-soak"
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_json(url, path, timeout=2.0):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def host_info_series(url, timeout=2.0) -> int:
+    with urllib.request.urlopen(url + "/metrics",
+                                timeout=timeout) as r:
+        text = r.read().decode()
+    return sum(1 for l in text.splitlines()
+               if l.startswith("jepsen_fleet_host_info{"))
+
+
+def template(seeds):
+    return {"name": NAME, "workloads": ["bank"], "seeds": list(seeds),
+            "opts": {"telemetry": True, "time-limit": 0.5,
+                     "ops": 200, "concurrency": 3,
+                     "client-latency": 0.004}}
+
+
+def mutate(i, sp):
+    """Generation >= 2 regresses: slower clients (the span the gate
+    watches) plus a skew window (a real anomaly for the shrinker)."""
+    if i >= 2:
+        o = sp.setdefault("opts", {})
+        o["client-latency"] = 0.01
+        o["nemesis-windows"] = [{"pos": 0, "fault": "skew",
+                                 "at_s": 0.0, "dur_s": 0.4}]
+    return sp
+
+
+# ------------------------------------------------------------- child
+
+def build(args, version):
+    from jepsen_tpu.fleet import Autopilot
+
+    return Autopilot(
+        template(args.seed_list), args.store,
+        lease_s=2.0, generations=args.gens, spans=("workload",),
+        mutate=mutate,
+        coordinator_url=f"http://127.0.0.1:{args.port}",
+        min_workers=2, max_workers=3, worker_version=version,
+        scale_interval_s=0.25, worker_poll_s=0.05,
+        shrink_knobs={"probe-deadline": 15.0}, poll_s=0.05)
+
+
+def child_a(args) -> int:
+    from jepsen_tpu import web
+
+    ap = build(args, "v1")
+    web.serve(args.port, args.store, fleet=ap.coordinator,
+              background=True)
+    print(f"CHILD-A-UP digest={ap.journal.digest()}", flush=True)
+    ap.run()  # the parent kill -9s us mid-loop
+    return 0
+
+
+def child_b(args) -> int:
+    from jepsen_tpu import web
+
+    ap = build(args, "v1")
+    web.serve(args.port, args.store, fleet=ap.coordinator,
+              background=True)
+    url = f"http://127.0.0.1:{args.port}"
+    print(f"CHILD-B-RESUMED digest={ap.journal.digest()}", flush=True)
+
+    # close every generation but the last (resumes the crashed one,
+    # then catches + quarantines + shrinks the seeded regression)
+    while len(ap.journal.closed_labels()) < args.gens - 1:
+        out = ap.step()
+        print(f"CHILD-B-GEN {json.dumps(out, default=str)}",
+              flush=True)
+        if out.get("stopped"):
+            return 1
+
+    pre = host_info_series(url)
+    ap.worker_version = "v2"  # the rolling upgrade rides the last gen
+    out = ap.step()
+    print(f"CHILD-B-GEN {json.dumps(out, default=str)}", flush=True)
+
+    # settle: tick the scaler until the pool is all-v2 per the
+    # COORDINATOR's view and the old workers' series have retired
+    deadline = time.time() + 90.0
+    flat = None
+    while time.time() < deadline:
+        ap._scale_tick()
+        live = [n for n in ap._live_workers()
+                if not ap.workers[n]["draining"]]
+        if len(live) >= ap.min_workers and \
+                all(ap.workers[n]["version"] == "v2" for n in live) \
+                and all(ap._worker_alive(n) for n in live):
+            flat = host_info_series(url)
+            if flat == pre == len(live):
+                break
+        time.sleep(0.25)
+    finals = {n: ap.workers[n]["version"]
+              for n in ap._live_workers()
+              if not ap.workers[n]["draining"]}
+    summary = {
+        "digest": ap.journal.digest(),
+        "closed": ap.journal.closed_labels(),
+        "quarantined": {k: dict(v) for k, v in
+                        ap.journal.quarantined.items()},
+        "shrinks": {k: dict(v) for k, v in
+                    ap.journal.shrinks.items()},
+        "counts": ap.coordinator.queue.counts(),
+        "host-info-pre": pre, "host-info-post": flat,
+        "workers-final": finals,
+    }
+    print(f"CHILD-B-SUMMARY {json.dumps(summary)}", flush=True)
+    ap.close()
+    return 0
+
+
+# ------------------------------------------------------------ parent
+
+def wait_for(pred, deadline_s, what):
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise SystemExit(f"FAIL: timed out waiting for {what}")
+
+
+def kill_host(proc, pids):
+    """The whole-'host' kill -9: coordinator process and every
+    managed worker it reported."""
+    for pid in [proc.pid] + pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    proc.wait(timeout=10)
+    # belt-and-braces: reap any worker spawned inside the scrape->kill
+    # window (it would otherwise idle-poll the port forever and claim
+    # cells from child B as an unmanaged v1 straggler)
+    try:
+        out = subprocess.run(
+            ["pgrep", "-f", f"--name ap-{proc.pid}-"],
+            capture_output=True, text=True)
+        for pid in out.stdout.split():
+            os.kill(int(pid), signal.SIGKILL)
+    except (OSError, ValueError):
+        pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 acceptance config")
+    ap.add_argument("--gens", type=int, default=None)
+    ap.add_argument("--seeds", type=int, default=None)
+    ap.add_argument("--store", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--child", choices=["a", "b"], default=None)
+    args = ap.parse_args()
+    args.gens = args.gens or (4 if args.fast else 5)
+    args.seeds = args.seeds or (3 if args.fast else 4)
+    args.seed_list = list(range(args.seeds))
+
+    if args.child:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return child_a(args) if args.child == "a" else child_b(args)
+
+    from jepsen_tpu.fleet import AutopilotJournal, WorkQueue, \
+        autopilot_path, fleet_path
+
+    base = args.store or tempfile.mkdtemp(prefix="soak-autopilot-")
+    port = args.port or free_port()
+    url = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--gens", str(args.gens), "--seeds", str(args.seeds),
+           "--store", base, "--port", str(port)]
+
+    t_start = time.time()
+    a = subprocess.Popen(cmd + ["--child", "a"], env=env)
+    try:
+        def mid_g0001():
+            try:
+                st = http_json(url, "/fleet/status")
+            except OSError:
+                return None
+            apst = st.get("autopilot") or {}
+            if apst.get("generations-closed", 0) >= 1 \
+                    and st.get("done", 0) > args.seeds:
+                return st
+            return None
+
+        st = wait_for(mid_g0001, 180, "generation g0001 mid-flight")
+        pids = [w["pid"] for w in
+                (st["autopilot"].get("workers") or {}).values()
+                if w.get("running")]
+        print(f"parent: killing host mid-{st['autopilot']['generation']}"
+              f" (coordinator pid {a.pid} + workers {pids})",
+              flush=True)
+        kill_host(a, pids)
+    except BaseException:
+        kill_host(a, [])
+        raise
+
+    d_crash = AutopilotJournal(autopilot_path(NAME, base)).digest()
+
+    b = subprocess.Popen(cmd + ["--child", "b"], env=env,
+                         stdout=subprocess.PIPE, text=True)
+    summary, resumed = None, None
+    try:
+        for line in b.stdout:
+            sys.stdout.write(line)
+            sys.stdout.flush()
+            if line.startswith("CHILD-B-RESUMED"):
+                resumed = line.split("digest=")[1].strip()
+            if line.startswith("CHILD-B-SUMMARY "):
+                summary = json.loads(
+                    line.split("CHILD-B-SUMMARY ", 1)[1])
+        rc = b.wait(timeout=300)
+    except BaseException:
+        b.kill()
+        raise
+    if rc != 0 or summary is None:
+        print(f"FAIL: child B rc={rc}, summary={summary is not None}")
+        return 1
+
+    fails = []
+    if resumed != d_crash:
+        fails.append(f"resume digest {resumed} != independent replay "
+                     f"of the crashed journal {d_crash}")
+    d_final = AutopilotJournal(autopilot_path(NAME, base)).digest()
+    if summary["digest"] != d_final:
+        fails.append(f"final digest {summary['digest']} != replay "
+                     f"{d_final}")
+    c = summary["counts"]
+    q = len(summary["quarantined"])
+    expect_cells = args.gens * args.seeds - q * (args.gens - 3)
+    if c["duplicates"] != 0:
+        fails.append(f"{c['duplicates']} duplicate verdicts")
+    if c["done"] != c["cells"] or c["cells"] != expect_cells:
+        fails.append(f"cells {c['cells']} done {c['done']} != "
+                     f"expected {expect_cells} (zero lost/extra)")
+    if q != 1:
+        fails.append(f"expected exactly 1 quarantined key, got "
+                     f"{sorted(summary['quarantined'])}")
+    key = next(iter(summary["quarantined"]), "")
+    sk = (summary["shrinks"].get(key) or {}).get("outcome") or {}
+    if sk.get("error") or not sk.get("digest"):
+        fails.append(f"shrink outcome lacks a witness: {sk}")
+    wq = WorkQueue(fleet_path(NAME, base))
+    unattr = [r for r, cell in wq.cells.items()
+              if cell["state"] == "done"
+              and not (cell.get("record") or {}).get("key")]
+    if unattr:
+        fails.append(f"{len(unattr)} unattributed verdicts")
+    if wq.counts()["duplicates"] != 0:
+        fails.append("ledger replay shows duplicates")
+    finals = summary["workers-final"]
+    if not finals or any(v != "v2" for v in finals.values()):
+        fails.append(f"pool not fully upgraded: {finals}")
+    if summary["host-info-pre"] != summary["host-info-post"] or \
+            summary["host-info-pre"] != len(finals):
+        fails.append(
+            f"host_info cardinality moved: "
+            f"{summary['host-info-pre']} -> "
+            f"{summary['host-info-post']} (workers {len(finals)})")
+
+    wall = time.time() - t_start
+    if fails:
+        for f in fails:
+            print(f"FAIL: {f}")
+        return 1
+    print(f"SOAK PASS gens={len(summary['closed'])} "
+          f"cells={c['cells']} duplicates={c['duplicates']} "
+          f"quarantined={key} witness-ops={sk.get('witness-ops')} "
+          f"upgrade=v1->v2 "
+          f"host-info={summary['host-info-pre']}->"
+          f"{summary['host-info-post']} wall={wall:.1f}s")
+    if not args.store:
+        shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -795,7 +795,8 @@ class _GoldenFleet:
 
     def federated_metrics(self):
         return {
-            "w1": {"host": "h1", "age-s": 1.0, "rows": [
+            "w1": {"host": "h1", "age-s": 1.0, "version": "v1",
+                   "rows": [
                 {"name": "worker-cells-done", "kind": "counter",
                  "labels": {}, "value": 3},
                 {"name": "jit-cache-entries", "kind": "gauge",
@@ -803,7 +804,8 @@ class _GoldenFleet:
                 {"name": "worker-rss-peak-bytes", "kind": "gauge",
                  "labels": {}, "value": 120_000_000},
             ]},
-            "w2": {"host": "h2", "age-s": 2.0, "rows": [
+            "w2": {"host": "h2", "age-s": 2.0, "version": "v2",
+                   "rows": [
                 {"name": "worker-cells-done", "kind": "counter",
                  "labels": {}, "value": 5},
                 {"name": "jit-cache-entries", "kind": "gauge",
@@ -872,6 +874,12 @@ def _golden_exposition(base):
     reg.gauge("process-rss-peak-bytes").set(104857600)
     reg.gauge("device-memory-peak-bytes", device="cpu:0").set(8388608)
     reg.gauge("jit-cache-entries-peak").set(13)
+    # autopilot (ISSUE 17): the scaler's two inputs (queue depth +
+    # claim-latency p95) and the continuous loop's own state gauges
+    reg.gauge("fleet-queue-depth").set(4)
+    reg.gauge("fleet-claim-latency-p95-s").set(0.42)
+    reg.gauge("fleet-quarantined-cells").set(1)
+    reg.gauge("fleet-autopilot-generations").set(5)
     cdir = os.path.join(str(base), "campaigns")
     os.makedirs(cdir, exist_ok=True)
     with open(os.path.join(cdir, "soak.live.json"), "w") as f:
