@@ -50,6 +50,17 @@ COUNT_NAMES = ("duplicate-appends", "duplicate-elements",
                "internal")
 
 
+def _cc(site, jitfn, *args, **static):
+    """Route one device dispatch through the AOT compile cache: memory
+    table -> persisted executable -> compile+persist, falling through
+    to the plain jit call on any failure (see jepsen_tpu.compilecache).
+    Statics go by keyword so the cached Compiled can be dispatched with
+    the dynamic args alone."""
+    from jepsen_tpu import compilecache
+
+    return compilecache.call(site, jitfn, *args, **static)
+
+
 def proj_include_stack(projections=PROJECTIONS) -> jnp.ndarray:
     """(P, 5) family-include flags for the ww/wr/rw/tb/bt edge families
     (tb/bt are the realtime-barrier families)."""
@@ -153,13 +164,14 @@ def core_check_staged(h: PaddedLA, n_keys: int, max_k: int = 128,
     import time as _time
 
     t0 = _time.perf_counter()
-    out = _infer_stage(h, n_keys)
+    out = _cc("elle.core-check.infer", _infer_stage, h, n_keys=n_keys)
     jax.block_until_ready(out)
     if verbose:
         print(f"  staged: infer {_time.perf_counter() - t0:.1f}s",
               flush=True)
     t0 = _time.perf_counter()
-    res = _sweep_stage(out, max_k=max_k, max_rounds=max_rounds)
+    res = _cc("elle.core-check.sweep", _sweep_stage, out, max_k=max_k,
+              max_rounds=max_rounds)
     jax.block_until_ready(res)
     if verbose:
         print(f"  staged: sweep {_time.perf_counter() - t0:.1f}s",
@@ -196,8 +208,9 @@ def _sharded_dispatch(h: PaddedLA, n_keys: int, max_k: int,
     if max_k % n:
         max_k = ((max_k // n) + 1) * n
     h, _ = shard_padded(h, mesh, "batch")
-    return _core_check_sharded(h, n_keys, mesh, "batch", max_k=max_k,
-                               max_rounds=max_rounds)
+    return _cc("parallel.op-shard", _core_check_sharded, h,
+               n_keys=n_keys, mesh=mesh, axis="batch", max_k=max_k,
+               max_rounds=max_rounds)
 
 
 def core_check_auto(h: PaddedLA, n_keys: int, max_k: int = 128,
@@ -215,7 +228,8 @@ def core_check_auto(h: PaddedLA, n_keys: int, max_k: int = 128,
     if _use_staged(h):
         return core_check_staged(h, n_keys, max_k=max_k,
                                  max_rounds=max_rounds)
-    return core_check(h, n_keys, max_k=max_k, max_rounds=max_rounds)
+    return _cc("elle.core-check", core_check, h, n_keys=n_keys,
+               max_k=max_k, max_rounds=max_rounds)
 
 
 def grow_until_exact(run, max_k: int = 128, max_rounds: int = 64,
@@ -288,18 +302,22 @@ def core_check_exact(h: PaddedLA, n_keys: int, max_k: int = 128,
         if max_k % n:
             max_k = ((max_k // n) + 1) * n
         return grow_until_exact(
-            lambda k, r: _core_check_sharded(h2, n_keys, mesh, "batch",
-                                             max_k=k, max_rounds=r),
+            lambda k, r: _cc("parallel.op-shard", _core_check_sharded,
+                             h2, n_keys=n_keys, mesh=mesh, axis="batch",
+                             max_k=k, max_rounds=r),
             max_k, max_rounds, round_to=n, deadline=deadline)
     if _use_staged(h):
         # staged split: infer is independent of max_k/max_rounds, so a
         # budget retry re-runs only the (cheap-on-acyclic) sweep stage —
         # the fused program had to redo inference every retry
-        out = _infer_stage(h, n_keys)
+        out = _cc("elle.core-check.infer", _infer_stage, h,
+                  n_keys=n_keys)
         jax.block_until_ready(out)
         return grow_until_exact(
-            lambda k, r: _sweep_stage(out, max_k=k, max_rounds=r),
+            lambda k, r: _cc("elle.core-check.sweep", _sweep_stage, out,
+                             max_k=k, max_rounds=r),
             max_k, max_rounds, deadline=deadline)
     return grow_until_exact(
-        lambda k, r: core_check(h, n_keys, max_k=k, max_rounds=r),
+        lambda k, r: _cc("elle.core-check", core_check, h,
+                         n_keys=n_keys, max_k=k, max_rounds=r),
         max_k, max_rounds, deadline=deadline)

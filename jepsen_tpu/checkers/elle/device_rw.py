@@ -289,6 +289,17 @@ def infer_rw(h: PaddedLA, n_keys: int, rw_cap: int = 0):
     }
 
 
+def _cc_call(h, n_keys, max_k, max_rounds, rw_cap):
+    """The guarded dispatch body: rw_core_check through the AOT compile
+    cache (memory table -> persisted executable -> compile+persist,
+    plain jit on any failure — see jepsen_tpu.compilecache)."""
+    from jepsen_tpu import compilecache
+
+    return compilecache.call("elle.rw-core-check", rw_core_check, h,
+                             n_keys=n_keys, max_k=max_k,
+                             max_rounds=max_rounds, rw_cap=rw_cap)
+
+
 @partial(jax.jit, static_argnames=("n_keys", "max_k", "max_rounds",
                                    "rw_cap"))
 def rw_core_check(h: PaddedLA, n_keys: int, max_k: int = 128,
@@ -385,8 +396,7 @@ def check(p: PackedTxns | PaddedLA, n_keys: int = None, max_k: int = 128,
             deadline.check("elle.rw-core-check")
         bits, over, rw_over = resilience.device_call(
             "elle.rw-core-check",
-            lambda: rw_core_check(h, n_keys, max_k=max_k,
-                                  max_rounds=max_rounds, rw_cap=rw_cap),
+            lambda: _cc_call(h, n_keys, max_k, max_rounds, rw_cap),
             policy=policy, deadline=deadline, plan=plan)
         over_i = int(np.asarray(over))
         rw_over_i = int(np.asarray(rw_over))

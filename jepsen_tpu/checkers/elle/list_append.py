@@ -154,7 +154,14 @@ def _check_device(history, consistency_models, anomalies, max_reported,
                 h.txn_complete_pos, h.txn_mask, h.mop_txn, h.mop_kind,
                 h.mop_key, h.mop_val, h.mop_rd_start, h.mop_rd_len,
                 h.mop_mask, h.rd_elems, h.rd_elem_mask)))
-    out = dev("elle.infer", infer, h, h.n_keys)
+    # infer rides the AOT compile cache: shrink probes and campaign
+    # cells over same-bucket histories (pad_packed pads to pow2
+    # classes) share one executable instead of compiling per shape
+    from jepsen_tpu import compilecache
+
+    out = dev("elle.infer",
+              lambda: compilecache.call("elle.infer", infer, h,
+                                        n_keys=h.n_keys))
 
     found: Dict[str, List[Any]] = {}
     counts = {k: int(v) for k, v in out["counts"].items()}

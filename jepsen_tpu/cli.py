@@ -382,6 +382,12 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
     from .fleet import Autopilot, FleetCoordinator, FleetWorker
 
     base = opts.store_dir
+    if getattr(opts, "cache_warm", False) and opts.action in (
+            "serve", "work", "autopilot"):
+        # before the service loop starts: a coordinator warms the
+        # store its claim adverts ship from; a worker warms the store
+        # its own dispatches hit
+        _fleet_cache_warm(base)
     if opts.action == "autopilot":
         if not opts.spec:
             print("fleet autopilot needs a campaign spec template",
@@ -580,6 +586,90 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
         return 0
     print(f"fleet: unknown action {opts.action!r}", file=sys.stderr)
     return 2
+
+
+def cache_cmd(opts: argparse.Namespace) -> int:
+    """`cache warm|ls|stats|clear` — the shape-bucketed AOT compile
+    cache (docs/COMPILECACHE.md): pre-warm the bucket ladder into
+    ``<store>/compilecache/``, list/inspect the entry store, or drop
+    it.  ``warm`` is what a fleet service runs at start (``fleet ...
+    --cache-warm``) so every worker's first claim of a known shape
+    class pays dispatch, not compile."""
+    import json as _json
+
+    from jepsen_tpu import compilecache
+    from jepsen_tpu.compilecache import store as cc_store
+
+    d = compilecache.adopt_base(opts.store_dir)
+    if opts.action == "warm":
+        from jepsen_tpu.compilecache import warm as cc_warm
+
+        sizes = ([int(s) for s in opts.sizes.split(",") if s]
+                 if opts.sizes else None)
+        fams = tuple(f for f in (opts.families or "la,rw").split(",")
+                     if f)
+        recs = cc_warm.warm_ladder(
+            sizes=sizes, max_txns=opts.max_txns, families=fams,
+            max_k=opts.max_k, verbose=not opts.json)
+        st = compilecache.stats()
+        if opts.json:
+            print(_json.dumps({"dir": d, "rungs": recs, "stats": st},
+                              indent=1))
+        else:
+            ok = sum(1 for r in recs if r.get("ok"))
+            print(f"cache warm: {ok}/{len(recs)} rungs ok, "
+                  f"{st['entries']} entries "
+                  f"({d or 'memory-only'})")
+        return 0 if all(r.get("ok") for r in recs) else 1
+    if opts.action == "ls":
+        rows = cc_store.entries(d) if d else []
+        for e in rows:
+            meta = {}
+            try:
+                with open(os.path.join(d, e["name"]), "rb") as f:
+                    doc = cc_store.unpack_entry(f.read())
+                meta = (doc or {}).get("meta") or {}
+            except OSError:
+                pass
+            print(f"{e['name']}  {e['size']:>9}  "
+                  f"{meta.get('site', '?')}  {meta.get('class', '?')}")
+        print(f"{len(rows)} entries, "
+              f"{cc_store.total_bytes(d) if d else 0} bytes "
+              f"({d or 'memory-only'})")
+        return 0
+    if opts.action == "stats":
+        print(_json.dumps(dict(compilecache.stats(), dir=d), indent=1))
+        return 0
+    if opts.action == "clear":
+        n = 0
+        for e in (cc_store.entries(d) if d else []):
+            if cc_store.delete(d, e["name"][:-len(cc_store.SUFFIX)]):
+                n += 1
+        compilecache.clear()
+        print(f"cache clear: {n} entries removed ({d or 'memory-only'})")
+        return 0
+    print(f"cache: unknown action {opts.action!r}", file=sys.stderr)
+    return 2
+
+
+def _fleet_cache_warm(base: str) -> None:
+    """The ``fleet --cache-warm`` service-start hook: point the AOT
+    store at this service's base and walk the bucket ladder, so the
+    coordinator's claim adverts (or this worker's own dispatches) are
+    warm from the first cell.  Failures are logged, never fatal — a
+    cold cache only costs compile time."""
+    try:
+        from jepsen_tpu import compilecache
+        from jepsen_tpu.compilecache import warm as cc_warm
+
+        d = compilecache.adopt_base(base)
+        recs = cc_warm.warm_ladder(verbose=True)
+        ok = sum(1 for r in recs if r.get("ok"))
+        print(f"cache warm: {ok}/{len(recs)} rungs ok "
+              f"({d or 'memory-only'})", flush=True)
+    except Exception as e:  # noqa: BLE001 — warm is an optimization
+        print(f"cache warm failed (continuing cold): {e}",
+              file=sys.stderr)
 
 
 def _render_timeline(tl: Dict[str, Any]) -> str:
@@ -1105,6 +1195,35 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                           "staged bytes are visible either way as "
                           "jepsen_fleet_artifact_staging_bytes on "
                           "/metrics")
+    pfl.add_argument("--cache-warm", dest="cache_warm",
+                     action="store_true",
+                     help="pre-warm the AOT compile cache's bucket "
+                          "ladder at service start (serve/work/"
+                          "autopilot), so first claims pay dispatch, "
+                          "not compile (docs/COMPILECACHE.md)")
+
+    pcc = sub.add_parser("cache",
+                         help="shape-bucketed AOT compile cache: "
+                              "pre-warm the bucket ladder, list/"
+                              "inspect the entry store, or clear it "
+                              "(docs/COMPILECACHE.md)")
+    pcc.add_argument("action", choices=("warm", "ls", "stats", "clear"))
+    pcc.add_argument("--sizes", default=None,
+                     help="comma-separated txn-count rungs to warm "
+                          "(default: the pow2 bucket ladder "
+                          "64..1024)")
+    pcc.add_argument("--max-txns", dest="max_txns", type=int,
+                     default=None,
+                     help="cap the default ladder at this rung")
+    pcc.add_argument("--families", default="la,rw",
+                     help="workload families to warm (la = "
+                          "list-append infer + core check, rw = "
+                          "rw-register core check)")
+    pcc.add_argument("--max-k", dest="max_k", type=int, default=128,
+                     help="key-space ceiling fed to the warm "
+                          "generators")
+    pcc.add_argument("--json", action="store_true",
+                     help="machine-readable output (warm/stats)")
 
     def dispatch(opts: argparse.Namespace) -> int:
         if opts.cmd == "test":
@@ -1123,6 +1242,8 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
             return campaign_cmd(opts)
         if opts.cmd == "fleet":
             return fleet_cmd(opts)
+        if opts.cmd == "cache":
+            return cache_cmd(opts)
         if opts.cmd == "obs":
             return obs_cmd(opts)
         p.error(f"unknown command {opts.cmd}")

@@ -1,0 +1,117 @@
+"""Shape-bucket policy: which executable does a call share?
+
+The anti-amortization shape (ROADMAP item 1) is many small
+heterogeneous probes — every distinct padded shape is a distinct XLA
+program, and per-shape jit was minutes-for-500-ops.  The cure is a
+single bucketing POLICY: every device entry point pads its arrays to
+power-of-two capacities (``pad_packed``, the verifier sweep's
+``_pow2``, the streamed-staging caps all already do), so a shrink
+probe at 300 txns and a campaign cell at 500 land in the SAME
+(site, dtype-signature, padded-dims) class and share one executable.
+
+This module is that policy made first-class:
+
+- :func:`pow2_at_least` — the one rounding rule (identical to
+  ``device_infer.pow2_at_least``; a unit test pins them equal so the
+  two can't drift);
+- :func:`signature` — a call's dtype-signature + padded dims, read
+  straight off the (already bucketed) argument pytree.  Abstract
+  ``ShapeDtypeStruct`` leaves sign identically to concrete arrays, so
+  a pre-warm at abstract shapes populates the same class a live call
+  looks up;
+- :func:`class_label` — the compact ``(site, signature, statics)``
+  label used by cache fingerprints, telemetry, and docs;
+- :data:`LADDER` / :func:`ladder` — the default pre-warm rungs (txn
+  counts; each rung pads to its pow2 class, so warming the ladder
+  covers every history up to the top rung).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Iterable, List, Tuple
+
+__all__ = ["pow2_at_least", "signature", "static_signature",
+           "class_label", "class_digest", "LADDER", "ladder"]
+
+
+def pow2_at_least(n: int, floor: int = 8) -> int:
+    """The bucket rounding rule: smallest power of two >= n, floored.
+    Must stay equal to ``device_infer.pow2_at_least`` (pinned by
+    test_compilecache's drift test)."""
+    x = floor
+    while x < n:
+        x *= 2
+    return x
+
+
+def _leaves(args: Iterable[Any]) -> List[Tuple[str, str]]:
+    """(shape, dtype) of every array-like leaf in the args pytree.
+    Uses jax's flattening so registered containers (PaddedLA, dicts of
+    stage outputs) enumerate deterministically; ShapeDtypeStructs and
+    concrete arrays produce identical entries."""
+    import jax
+
+    out: List[Tuple[str, str]] = []
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            out.append((str(tuple(shape)),
+                        str(getattr(leaf, "dtype", ""))))
+    return out
+
+
+def signature(args: tuple) -> Tuple[Tuple[str, str], ...]:
+    """The call's shape class: (shape, dtype) per array leaf.  The
+    arrays are expected to be bucket-padded already (``pad_packed`` /
+    ``_pow2``); this just reads the class off them."""
+    return tuple(_leaves(args))
+
+
+def static_signature(static: dict) -> Tuple[Tuple[str, str], ...]:
+    """Sorted (name, repr) of the static arguments — part of the
+    class: a different ``max_k`` is a different specialization, hence
+    a different executable."""
+    return tuple(sorted((str(k), repr(v)) for k, v in static.items()))
+
+
+def class_label(site: str, args: tuple, static: dict) -> str:
+    """Human/SQL-stable label for a call class, e.g.
+    ``elle.core-check|(512,):int8+...|max_k=128``."""
+    sig = "+".join(f"{s}:{d}" if d else s for s, d in signature(args))
+    st = ",".join(f"{k}={v}" for k, v in static_signature(static))
+    return f"{site}|{sig or 'scalar'}" + (f"|{st}" if st else "")
+
+
+def class_digest(site: str, args: tuple, static: dict) -> str:
+    """Short stable digest of the class label — the shape-class half
+    of a cache fingerprint."""
+    return hashlib.sha256(
+        class_label(site, args, static).encode()).hexdigest()[:16]
+
+
+#: default pre-warm rungs (txn counts).  Each rung's history pads to
+#: its pow2 class, so the warmed executables cover every history whose
+#: padded capacities land on the same rungs: shrink ladders (tens to
+#: hundreds of ops), unit/campaign cells (hundreds), and the small
+#: bench sizes.  Large rungs (1M+) stay opt-in via ``cli cache warm
+#: --sizes`` — warming them costs the very compile the cache then
+#: amortizes.
+LADDER: Tuple[int, ...] = (64, 128, 256, 512, 1024)
+
+
+def ladder(max_txns: int | None = None,
+           sizes: Iterable[int] | None = None) -> List[int]:
+    """The pre-warm rung list: explicit `sizes`, else the default
+    ladder optionally extended by doubling up to ``max_txns``'s
+    bucket."""
+    if sizes is not None:
+        return sorted({pow2_at_least(int(s)) for s in sizes})
+    rungs = set(LADDER)
+    if max_txns:
+        top = pow2_at_least(int(max_txns))
+        r = max(LADDER)
+        while r < top:
+            r *= 2
+            rungs.add(r)
+    return sorted(rungs)

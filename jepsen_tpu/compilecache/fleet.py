@@ -1,0 +1,275 @@
+"""Fleet distribution of AOT cache entries (docs/COMPILECACHE.md).
+
+Entries travel over PR 13's chunked, digest-verified artifact channel
+plus one small GET surface, so a worker's FIRST claim of a known shape
+class is warm:
+
+- **advert** — the coordinator's claim response carries
+  :func:`export_index`: ``[{"name", "digest", "size"}...]`` for every
+  entry under its ``<base>/compilecache/``.  File digests are cached
+  by ``(name, size, mtime)`` so a busy claim path never re-hashes an
+  unchanged store.
+- **pull** — :func:`pull_missing`: the worker fetches entries it lacks
+  from ``GET /fleet/cache/<name>``, sha256-verifies each blob against
+  the advert digest AND the entry's own self-verifying framing
+  (`store.unpack_entry`), then installs atomically (tmp +
+  ``os.replace``) — a torn pull never lands.
+- **push** — :func:`push_new`: after a cell, the worker spools any
+  entries it minted into a batch dir, tars it through
+  `fleet.artifacts.pack_run_dir_file`, and streams it over the
+  worker's existing resumable ``_upload_spooled`` seam under
+  ``rel=compilecache/cc-<digest12>`` (a rel `_safe_rel` admits).
+- **absorb** — the coordinator's artifact handler calls
+  :func:`absorb` when a ``compilecache/*`` rel lands: each ``*.aotx``
+  is re-verified and moved up into the flat ``<base>/compilecache/``
+  store (fingerprint-named, so concurrent workers pushing the same
+  class converge on one entry), and the batch dir is removed.
+
+Everything here is best-effort: a failed pull/push/absorb logs and
+moves on — the worker just compiles locally, exactly as before the
+cache existed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import urllib.request
+from typing import Any, Dict, List, Optional, Set, Tuple
+from urllib.parse import quote
+
+from jepsen_tpu.compilecache import store
+
+logger = logging.getLogger("jepsen.compilecache")
+
+__all__ = ["export_index", "entry_names", "read_entry", "absorb",
+           "pull_missing", "push_new", "MAX_ADVERT_ENTRIES"]
+
+#: cap on entries a claim response adverts — a claim is a hot-path
+#: control message, not a directory dump
+MAX_ADVERT_ENTRIES = 128
+
+_digest_lock = threading.Lock()
+#: name -> (size, mtime, digest): the by-stat digest memo
+_digests: Dict[str, Tuple[int, float, str]] = {}
+
+
+def _registry():
+    from jepsen_tpu import telemetry
+
+    return telemetry.registry()
+
+
+def _count(state: str, n: int = 1) -> None:
+    try:
+        _registry().counter("compile-cache-transfers",
+                            state=state).inc(n)
+    except Exception:  # noqa: BLE001 — observability only
+        pass
+
+
+def _safe_name(name: str) -> bool:
+    return (name.endswith(store.SUFFIX) and "/" not in name
+            and "\\" not in name and not name.startswith(".")
+            and name == os.path.basename(name))
+
+
+def export_index(cache_dir: Optional[str],
+                 limit: int = MAX_ADVERT_ENTRIES
+                 ) -> List[Dict[str, Any]]:
+    """The advert: every entry's ``{"name", "digest", "size"}``,
+    digests memoized by (size, mtime) so repeated claims stat, not
+    hash."""
+    if not cache_dir:
+        return []
+    out: List[Dict[str, Any]] = []
+    for e in store.entries(cache_dir)[:max(0, int(limit))]:
+        name, size = e["name"], e["size"]
+        path = os.path.join(cache_dir, name)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        with _digest_lock:
+            memo = _digests.get(name)
+        if memo is not None and memo[0] == size and memo[1] == mtime:
+            digest = memo[2]
+        else:
+            digest = store.file_digest(path)
+            if digest is None:
+                continue
+            with _digest_lock:
+                _digests[name] = (size, mtime, digest)
+        out.append({"name": name, "digest": digest, "size": size})
+    return out
+
+
+def entry_names(cache_dir: Optional[str]) -> Set[str]:
+    if not cache_dir:
+        return set()
+    return {e["name"] for e in store.entries(cache_dir)}
+
+
+def read_entry(cache_dir: Optional[str],
+               name: str) -> Optional[bytes]:
+    """One entry's raw file bytes for ``GET /fleet/cache/<name>``;
+    None for unsafe names, missing files, or corrupt framing."""
+    if not cache_dir or not _safe_name(name):
+        return None
+    try:
+        with open(os.path.join(cache_dir, name), "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None
+    if store.unpack_entry(blob) is None:
+        return None
+    return blob
+
+
+def _install(cache_dir: str, name: str, blob: bytes) -> bool:
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, name)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def absorb(base: str, rel: str) -> int:
+    """Coordinator side: a landed ``compilecache/<batch>`` artifact dir
+    becomes flat store entries.  Each ``*.aotx`` is verified (corrupt
+    members are dropped, not installed) and moved up into
+    ``<base>/compilecache/``; the batch dir is removed.  Returns the
+    number of entries absorbed."""
+    batch = os.path.join(base, rel)
+    dest = os.path.join(base, "compilecache")
+    absorbed = 0
+    try:
+        names = sorted(os.listdir(batch))
+    except OSError:
+        return 0
+    for fn in names:
+        src = os.path.join(batch, fn)
+        if not _safe_name(fn) or not os.path.isfile(src):
+            continue
+        if os.path.exists(os.path.join(dest, fn)):
+            continue  # fingerprint collision = identical content
+        try:
+            with open(src, "rb") as f:
+                blob = f.read()
+        except OSError:
+            continue
+        if store.unpack_entry(blob) is None:
+            logger.warning("compilecache: pushed entry %s corrupt; "
+                           "dropped", fn)
+            continue
+        if _install(dest, fn, blob):
+            absorbed += 1
+            _count("absorbed")
+    shutil.rmtree(batch, ignore_errors=True)
+    if absorbed:
+        logger.info("compilecache: absorbed %d fleet entries from %s",
+                    absorbed, rel)
+        try:
+            _registry().gauge("compile-cache-entries").set(
+                len(store.entries(dest)))
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+    return absorbed
+
+
+def pull_missing(base_url: str, advert: Any,
+                 cache_dir: Optional[str],
+                 timeout_s: float = 10.0) -> int:
+    """Worker side: fetch advertised entries absent locally.  Each
+    blob must match the advert's sha256 AND parse as a well-formed
+    entry before the atomic install; failures skip the entry (the
+    worker compiles that class locally).  Returns entries installed."""
+    if not cache_dir or not isinstance(advert, list) or not advert:
+        return 0
+    have = entry_names(cache_dir)
+    pulled = 0
+    for row in advert:
+        if not isinstance(row, dict):
+            continue
+        name = str(row.get("name") or "")
+        want = str(row.get("digest") or "")
+        if not _safe_name(name) or name in have or not want:
+            continue
+        url = f"{base_url.rstrip('/')}/fleet/cache/{quote(name)}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                blob = r.read()
+        except Exception as e:  # noqa: BLE001 — a cache pull must
+            # never fail a cell
+            logger.warning("compilecache: pull of %s failed (%s)",
+                           name, e)
+            _count("pull-failed")
+            continue
+        import hashlib
+
+        if hashlib.sha256(blob).hexdigest() != want \
+                or store.unpack_entry(blob) is None:
+            logger.warning("compilecache: pulled entry %s failed "
+                           "verification; dropped", name)
+            _count("pull-rejected")
+            continue
+        if _install(cache_dir, name, blob):
+            pulled += 1
+            _count("pulled")
+    if pulled:
+        logger.info("compilecache: pulled %d entries from %s",
+                    pulled, base_url)
+    return pulled
+
+
+def push_new(worker: Any, new_names: Set[str],
+             cache_dir: Optional[str]) -> bool:
+    """Worker side: ship freshly minted entries to the coordinator as
+    ONE batch artifact over the resumable upload seam.  ``worker`` is
+    a `fleet.worker.FleetWorker` (duck-typed: `_upload_spooled`)."""
+    if not cache_dir or not new_names:
+        return False
+    from jepsen_tpu.fleet.artifacts import pack_run_dir_file
+
+    with tempfile.TemporaryDirectory(prefix="jepsen-cc-push-") as td:
+        staged = 0
+        for name in sorted(new_names):
+            if not _safe_name(name):
+                continue
+            blob = read_entry(cache_dir, name)
+            if blob is None:
+                continue
+            with open(os.path.join(td, name), "wb") as f:
+                f.write(blob)
+            staged += 1
+        if not staged:
+            return False
+        with tempfile.TemporaryFile(prefix="jepsen-cc-spool-") as sp:
+            total, digest = pack_run_dir_file(td, sp)
+            batch = f"cc-{digest[:12]}"
+            try:
+                ok = bool(worker._upload_spooled(
+                    batch, f"compilecache/{batch}", sp, total, digest))
+            except Exception as e:  # noqa: BLE001 — push is an
+                # optimization; the verdict path never depends on it
+                logger.warning("compilecache: push failed (%s)", e)
+                ok = False
+    _count("pushed" if ok else "push-failed", staged if ok else 1)
+    if ok:
+        logger.info("compilecache: pushed %d entries to %s",
+                    staged, getattr(worker, "url", "?"))
+    return ok

@@ -37,6 +37,7 @@ either process partitions that side of the seam.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any, Dict, Optional, Tuple, Union
@@ -358,6 +359,20 @@ class FleetCoordinator:
         ctx = spans_mod.mint_trace(str(spec.get("run_id") or ""))
         out["trace"] = dict(ctx.child("fleet:claim").to_dict(),
                             header=ctx.header())
+        try:
+            # compile-cache advert (docs/COMPILECACHE.md): the claim
+            # names every distributable AOT entry so the worker can
+            # pull what it lacks BEFORE executing — its first cell of
+            # a known shape class pays dispatch, not compile.  Digests
+            # are (size, mtime)-memoized; an empty store adverts
+            # nothing and costs one listdir.
+            from jepsen_tpu.compilecache import fleet as cc_fleet
+
+            adv = cc_fleet.export_index(self.cache_dir())
+            if adv:
+                out["compilecache"] = adv
+        except Exception:  # noqa: BLE001 — the advert is best-effort
+            logger.debug("compilecache advert failed", exc_info=True)
         if self.sched:
             # the window broadcast: the claim response is the
             # AUTHORITATIVE carrier of the cell generation's
@@ -506,8 +521,57 @@ class FleetCoordinator:
         upload seam (chunked + digest-verified + idempotent; see
         `artifacts.ArtifactStore`).  Guarded like every other
         control-plane endpoint, so chaos plans drop/stall uploads."""
-        return self._guarded("fleet.artifact", self.artifacts.handle,
+        return self._guarded("fleet.artifact", self._artifact,
                              run_id, params, body)
+
+    def _artifact(self, run_id: str, params: Dict[str, Any],
+                  body: bytes) -> Tuple[int, Dict[str, Any]]:
+        code, doc = self.artifacts.handle(run_id, params, body)
+        # compile-cache distribution (docs/COMPILECACHE.md): a landed
+        # "compilecache/<batch>" artifact is a worker pushing AOT
+        # entries, not a run dir — absorb them into the flat store so
+        # the next claim's advert carries them fleet-wide
+        landed_dir = doc.get("dir")
+        if doc.get("landed") and not doc.get("already") \
+                and isinstance(landed_dir, str) \
+                and landed_dir.startswith("compilecache/"):
+            try:
+                from jepsen_tpu.compilecache import fleet as cc_fleet
+
+                doc["absorbed"] = cc_fleet.absorb(self.base, landed_dir)
+            except Exception:  # noqa: BLE001 — absorb is best-effort
+                logger.warning("compilecache absorb of %s failed",
+                               landed_dir, exc_info=True)
+        return code, doc
+
+    def cache_dir(self) -> str:
+        """The coordinator's AOT entry store (pre-warmed by ``cli
+        cache warm``, grown by worker pushes)."""
+        return os.path.join(self.base, "compilecache")
+
+    def cache_index(self) -> Tuple[int, Dict[str, Any]]:
+        """``GET /fleet/cache`` — the distributable entry advert."""
+        return self._guarded("fleet.cache", self._cache_index)
+
+    def _cache_index(self) -> Tuple[int, Dict[str, Any]]:
+        from jepsen_tpu.compilecache import fleet as cc_fleet
+
+        entries = cc_fleet.export_index(self.cache_dir())
+        return 200, {"entries": entries,
+                     "bytes": sum(e["size"] for e in entries)}
+
+    def cache_blob(self, name: str) -> Tuple[int, Dict[str, Any]]:
+        """``GET /fleet/cache/<name>`` — one verified entry's bytes
+        (the web layer streams ``doc["_blob"]`` as octet-stream)."""
+        return self._guarded("fleet.cache", self._cache_blob, name)
+
+    def _cache_blob(self, name: str) -> Tuple[int, Dict[str, Any]]:
+        from jepsen_tpu.compilecache import fleet as cc_fleet
+
+        blob = cc_fleet.read_entry(self.cache_dir(), name)
+        if blob is None:
+            return 404, {"error": f"no cache entry {name!r}"}
+        return 200, {"_blob": blob, "name": name}
 
     def release(self, body: Dict[str, Any]
                 ) -> Tuple[int, Dict[str, Any]]:

@@ -116,6 +116,16 @@ class FleetWorker:
         #: Jepsen-Trace header — heartbeat/renew, artifact chunks,
         #: complete all stitch onto the run's one trace
         self._trace: Optional[spans_mod.TraceContext] = None
+        # compile-cache adoption (docs/COMPILECACHE.md): the worker's
+        # persistent AOT store follows its store base, so entries
+        # pulled from the coordinator land exactly where the dispatch
+        # seam (`compilecache.call`) looks
+        try:
+            from jepsen_tpu import compilecache
+
+            compilecache.adopt_base(self.base)
+        except Exception:  # noqa: BLE001 — the cache is optional
+            pass
 
     # -- transport -----------------------------------------------------------
 
@@ -353,7 +363,8 @@ class FleetWorker:
                 self._post("fleet.release", "/fleet/release",
                            {"worker": self.name, "run": spec["run_id"]})
                 break
-            self._run_cell(spec, r.get("windows"), r.get("trace"))
+            self._run_cell(spec, r.get("windows"), r.get("trace"),
+                           r.get("compilecache"))
         logger.info("fleet worker %s done: %d cells completed "
                     "(%d duplicates discarded upstream)",
                     self.name, self.cells_done, self.duplicates)
@@ -501,13 +512,33 @@ class FleetWorker:
 
     def _run_cell(self, spec: Dict[str, Any],
                   windows: Optional[Dict[str, Any]] = None,
-                  trace: Optional[Dict[str, Any]] = None) -> None:
+                  trace: Optional[Dict[str, Any]] = None,
+                  cc_advert: Optional[Any] = None) -> None:
         from jepsen_tpu.campaign.core import execute_run
 
         rs = RunSpec.from_dict(spec)
         rs.opts["_base"] = self.base
         self._install_windows(rs, windows)
         run_id = rs.run_id
+        # compile-cache federation (docs/COMPILECACHE.md): pull the
+        # claim's advertised AOT entries before executing, so this
+        # worker's first cell of a known shape class dispatches a
+        # pre-built executable instead of compiling; snapshot the
+        # store so freshly minted entries can be pushed back after
+        cc_dir: Optional[str] = None
+        cc_pre: set = set()
+        try:
+            from jepsen_tpu import compilecache
+            from jepsen_tpu.compilecache import fleet as cc_fleet
+
+            cc_dir = compilecache.cache_dir()
+            if cc_dir and cc_advert:
+                cc_fleet.pull_missing(self.url, cc_advert, cc_dir,
+                                      timeout_s=self.timeout_s)
+            cc_pre = cc_fleet.entry_names(cc_dir)
+        except Exception:  # noqa: BLE001 — never fail a cell on cache
+            logger.warning("fleet worker %s: compile-cache pull "
+                           "failed", self.name, exc_info=True)
         # distributed trace (ISSUE 14): adopt the claim's trace id —
         # equal to the locally derivable one (both are pure functions
         # of the run id), so a claim from an older coordinator still
@@ -691,6 +722,20 @@ class FleetWorker:
                                "beyond retries (%s); cell will "
                                "requeue on lease expiry", self.name,
                                run_id, e)
+            # push entries this cell minted so the NEXT claim's advert
+            # carries them fleet-wide (best-effort; own batch rel, so
+            # no lease dependency)
+            try:
+                if cc_dir:
+                    from jepsen_tpu.compilecache import fleet as \
+                        cc_fleet
+
+                    new = cc_fleet.entry_names(cc_dir) - cc_pre
+                    if new:
+                        cc_fleet.push_new(self, new, cc_dir)
+            except Exception:  # noqa: BLE001 — push is an optimization
+                logger.warning("fleet worker %s: compile-cache push "
+                               "failed", self.name, exc_info=True)
         finally:
             stop_renew.set()
             renewer.join(timeout=5)

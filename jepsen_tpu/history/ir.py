@@ -155,6 +155,18 @@ class HistoryIR(History):
             self._lin_ops = _booked(lambda: prepare(self))
         return self._lin_ops
 
+    def bucket_class(self, workload: str = "list-append",
+                     site: str = "elle.infer") -> str:
+        """The compile-cache shape-class label of this history's padded
+        device view (``compilecache.bucket.class_label``): which AOT
+        executable a check over it shares.  The padded layout already
+        pads to pow2 capacities, so nearby history sizes report the
+        SAME class — the property the bucket ladder pre-warms against."""
+        from jepsen_tpu.compilecache import bucket
+
+        h = self.padded(workload)
+        return bucket.class_label(site, (h,), {"n_keys": h.n_keys})
+
     def layout(self) -> Dict[str, Any]:
         """The versioned layout summary of the padded list-append view
         (docs/IR.md): capacities + which facts/columns are active."""

@@ -265,6 +265,17 @@ _sweep = jax.jit(_sweep_arrays,
                  static_argnames=("n_nodes", "max_k", "max_rounds"))
 
 
+# arrays-first twins of _sweep/_sweep_sharded for the AOT compile-cache
+# seam (compilecache.call dispatches a cached Compiled with the dynamic
+# args alone, so statics must bind by keyword behind the arrays)
+@partial(jax.jit, static_argnames=("n_nodes", "max_k", "max_rounds"))
+def _sweep_kw(rank, nc_src, nc_dst, nc_mask, chain_nodes, chain_starts,
+              chain_mask, *, n_nodes, max_k, max_rounds):
+    return _sweep_arrays(n_nodes, max_k, max_rounds, rank, nc_src,
+                         nc_dst, nc_mask, chain_nodes, chain_starts,
+                         chain_mask)
+
+
 @partial(jax.jit, static_argnames=("n_nodes", "max_k", "max_rounds",
                                    "mesh", "axis"))
 def _sweep_sharded(n_nodes: int, max_k: int, max_rounds: int, mesh, axis,
@@ -295,6 +306,16 @@ def _sweep_sharded(n_nodes: int, max_k: int, max_rounds: int, mesh, axis,
 
     return run(rank, nc_src, nc_dst, nc_mask, chain_nodes, chain_starts,
                chain_mask)
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "max_k", "max_rounds",
+                                   "mesh", "axis"))
+def _sweep_sharded_kw(rank, nc_src, nc_dst, nc_mask, chain_nodes,
+                      chain_starts, chain_mask, *, n_nodes, max_k,
+                      max_rounds, mesh, axis):
+    return _sweep_sharded(n_nodes, max_k, max_rounds, mesh, axis, rank,
+                          nc_src, nc_dst, nc_mask, chain_nodes,
+                          chain_starts, chain_mask)
 
 
 def projection_scan(n_nodes: int, max_k: int, max_rounds: int,
@@ -467,19 +488,26 @@ def detect_cycles(g: SweepGraph, max_k: int = 128,
     """
     if deadline is not None:
         deadline.check("cycle-sweep")
+    # both branches ride the AOT compile cache: verifier sweep chunks
+    # and checker projections pad to pow2 (N, E) classes, so
+    # maintenance rounds and probes share persisted executables
+    from jepsen_tpu import compilecache
+
     if mesh is not None and mesh.devices.size > 1:
         n_shards = mesh.shape[axis]
         if max_k % n_shards:
             max_k = ((max_k // n_shards) + 1) * n_shards
-        has, wit, n_back, conv = _sweep_sharded(
-            g.n_nodes, max_k, max_rounds, mesh, axis, g.rank, g.nc_src,
+        has, wit, n_back, conv = compilecache.call(
+            "cycle-sweep.sharded", _sweep_sharded_kw, g.rank, g.nc_src,
             g.nc_dst, g.nc_mask, g.chain_nodes, g.chain_starts,
-            g.chain_mask)
+            g.chain_mask, n_nodes=g.n_nodes, max_k=max_k,
+            max_rounds=max_rounds, mesh=mesh, axis=axis)
     else:
         mesh = None
-        has, wit, n_back, conv = _sweep(
-            g.n_nodes, max_k, max_rounds, g.rank, g.nc_src, g.nc_dst,
-            g.nc_mask, g.chain_nodes, g.chain_starts, g.chain_mask)
+        has, wit, n_back, conv = compilecache.call(
+            "cycle-sweep", _sweep_kw, g.rank, g.nc_src, g.nc_dst,
+            g.nc_mask, g.chain_nodes, g.chain_starts, g.chain_mask,
+            n_nodes=g.n_nodes, max_k=max_k, max_rounds=max_rounds)
     n_back = int(n_back)
     if n_back > max_k:
         if n_back > MAX_K_CAP or max_k >= MAX_K_CAP:

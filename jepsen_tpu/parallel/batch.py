@@ -131,6 +131,22 @@ def _batched_core(batch: PaddedLA, n_keys: int):
     return jax.vmap(lambda h: core_check(h, n_keys))(batch)
 
 
+@partial(jax.jit, static_argnames=("n_keys", "mesh", "axis"))
+def _batched_sharded(batch: PaddedLA, *, n_keys: int, mesh: Mesh,
+                     axis: str):
+    """The mesh branch of check_batch as a module-level jit (statics by
+    keyword) so the AOT compile cache can key and serialize it — same
+    shard_map program the old per-call closure built."""
+    spec = P(axis)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec,),
+             out_specs=(spec, spec))
+    def rows(b):
+        return jax.vmap(lambda h: core_check(h, n_keys))(b)
+
+    return rows(batch)
+
+
 def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
                 axis: str = "dp", caps: tuple = None,
                 deadline=None, plan=None, policy=None) -> List[dict]:
@@ -166,27 +182,28 @@ def check_batch(ps: Sequence[PackedTxns], mesh: Mesh = None,
         n_keys = batch.n_keys
         _stage_bytes(sp, batch)
 
+        from jepsen_tpu import compilecache
+
         if mesh is None:
             bits, over = resilience.device_call(
-                "parallel.batch", _batched_core, batch, n_keys,
+                "parallel.batch",
+                lambda: compilecache.call("parallel.batch",
+                                          _batched_core, batch,
+                                          n_keys=n_keys),
                 deadline=deadline, plan=plan, policy=policy)
         else:
-            spec = P(axis)
-            in_shard = NamedSharding(mesh, spec)
+            in_shard = NamedSharding(mesh, P(axis))
 
             def put(x):
                 return jax.device_put(x, in_shard)
 
             batch = jax.tree_util.tree_map(put, batch)
-
-            @partial(shard_map, mesh=mesh, in_specs=(spec,),
-                     out_specs=(spec, spec))
-            def sharded(b):
-                bits, over = jax.vmap(lambda h: core_check(h, n_keys))(b)
-                return bits, over
-
             bits, over = resilience.device_call(
-                "parallel.batch", sharded, batch,
+                "parallel.batch",
+                lambda: compilecache.call("parallel.batch",
+                                          _batched_sharded, batch,
+                                          n_keys=n_keys, mesh=mesh,
+                                          axis=axis),
                 deadline=deadline, plan=plan, policy=policy)
 
         return summarize_batch_bits(bits, over, batch, n_keys, n_real)

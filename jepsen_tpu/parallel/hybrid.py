@@ -119,9 +119,14 @@ def check_batch_hybrid(ps: Sequence[PackedTxns], mesh: Mesh,
             batch)
         _stage_bytes(sp, batch)
 
+        from jepsen_tpu import compilecache
+
         bits, over = resilience.device_call(
-            "parallel.hybrid", _hybrid_core, batch, batch.n_keys, mesh,
-            max_k=max_k, max_rounds=max_rounds,
+            "parallel.hybrid",
+            lambda: compilecache.call(
+                "parallel.hybrid", _hybrid_core, batch,
+                n_keys=batch.n_keys, mesh=mesh, max_k=max_k,
+                max_rounds=max_rounds),
             deadline=deadline, plan=plan, policy=policy)
         return summarize_batch_bits(bits, over, batch, batch.n_keys,
                                     n_real, k_floor=max_k)

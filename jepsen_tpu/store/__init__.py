@@ -199,10 +199,12 @@ def tests(name: Optional[str] = None, *, base: Optional[str] = None) -> List[str
         # skip the base-level "current" symlink (and anything like it):
         # only real per-name directories hold runs — and the campaigns/
         # + verifier/ + fleet/ subtrees (ledgers and verifier session
-        # dirs, not run dirs) and _archive/ (runs retired by
-        # `gc_runs` retention: archived, out of every live scan)
+        # dirs, not run dirs), _archive/ (runs retired by `gc_runs`
+        # retention: archived, out of every live scan), and
+        # compilecache/ (AOT entries + in-flight fleet push batches)
         if os.path.islink(nd) or not os.path.isdir(nd) \
-                or n in ("campaigns", "verifier", "fleet", "_archive"):
+                or n in ("campaigns", "verifier", "fleet", "_archive",
+                         "compilecache"):
             continue
         for ts in os.listdir(nd):
             d = os.path.join(nd, ts)

@@ -31,7 +31,9 @@ Tables (see ``docs/TELEMETRY.md`` for the query cookbook):
 - ``campaign_records`` + ``record_spans`` — one row per ledger record,
   span durations exploded for indexed trend queries.
 - ``runs`` / ``run_spans`` / ``run_metrics`` — per run dir: verdict +
-  attribution flags, per-span total/count, counter & gauge snapshot.
+  attribution flags, per-span total/count, counter & gauge snapshot;
+  runs retired to ``_archive/`` by ``obs gc`` keep their rows with
+  ``archived = 1`` (schema v6) so the history stays queryable.
 - ``witnesses`` — minimal-witness summaries (``witness.json``).
 - ``events`` — streamed flight-recorder events (``cli tail --since``).
 - ``bench`` — BENCH payloads (``bench.py`` self-ingests; ``cli obs
@@ -56,7 +58,7 @@ __all__ = ["Warehouse", "warehouse_path", "open_if_exists", "for_ledger",
            "WAREHOUSE_FILE", "SCHEMA_VERSION"]
 
 WAREHOUSE_FILE = "warehouse.sqlite"
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS meta(
@@ -112,6 +114,7 @@ CREATE TABLE IF NOT EXISTS runs(
     digest TEXT NOT NULL,
     valid TEXT, error TEXT, degraded TEXT, deadline INTEGER,
     status TEXT NOT NULL DEFAULT 'done',  -- 'running' until results.json
+    archived INTEGER NOT NULL DEFAULT 0,  -- 1: retired to _archive/
     ingested_at REAL);
 CREATE TABLE IF NOT EXISTS verifier_sessions(
     name TEXT PRIMARY KEY,          -- session dir name
@@ -277,6 +280,13 @@ class Warehouse:
                 if col not in ccols:
                     self.db.execute("ALTER TABLE campaign_records "
                                     f"ADD COLUMN {col} TEXT")
+            # v5 -> v6 migration (ISSUE 18 satellite): runs.archived —
+            # runs retired to _archive/ by `obs gc` stay queryable
+            # (``obs sql``) with the dimension to tell them apart from
+            # the live store.  ALTER-only, default 0.
+            if "archived" not in cols:
+                self.db.execute("ALTER TABLE runs ADD COLUMN archived "
+                                "INTEGER NOT NULL DEFAULT 0")
             self.db.execute(
                 "INSERT OR REPLACE INTO meta(key, value) VALUES "
                 "('schema_version', ?)", (str(SCHEMA_VERSION),))
@@ -527,7 +537,8 @@ class Warehouse:
                 parts.append(f"{fn}:-")
         return "|".join(parts)
 
-    def ingest_run_dir(self, d: str, base: str) -> bool:
+    def ingest_run_dir(self, d: str, base: str,
+                       archived: bool = False) -> bool:
         """Ingest one run dir (verdict + spans + metric snapshot +
         witness); returns True if anything changed.  Keyed by a stat
         digest of the artifacts — an unchanged run is a no-op.  Missing
@@ -537,7 +548,13 @@ class Warehouse:
         analysis) is recorded as ``status = 'running'`` instead of
         being skipped — so fleet views and the verifier's session list
         include live work (ISSUE 7 satellite).  When results appear the
-        stat digest changes and the row flips to ``'done'``."""
+        stat digest changes and the row flips to ``'done'``.
+
+        `archived` (ISSUE 18 satellite): the run lives under
+        ``_archive/`` (``obs gc`` retention) — its row carries
+        ``archived = 1``, and the stale live-path rows the run left
+        behind when it was retired are wiped so rollups don't count it
+        twice."""
         rel = os.path.relpath(os.path.abspath(d), os.path.abspath(base))
         digest = self._run_digest(d)
         with self._lock:
@@ -550,13 +567,26 @@ class Warehouse:
             spans, metrics, profile, host = self._run_telemetry(d)
             traces = self._run_trace_rows(d, rel)
             wit = self._run_witness(d)
+            # the dir this run occupied before gc moved it (rel is
+            # "_archive/<name>/<ts>"; the basename may carry a
+            # collision suffix the live dir never had — strip nothing,
+            # the live rel is exactly the path minus the prefix)
+            stale = (os.path.relpath(rel, "_archive")
+                     if archived else None)
             with self.db:
                 for tbl in ("runs", "run_spans", "run_metrics",
                             "witnesses", "span_profile"):
                     self.db.execute(
                         f"DELETE FROM {tbl} WHERE dir = ?", (rel,))
+                    if stale:
+                        self.db.execute(
+                            f"DELETE FROM {tbl} WHERE dir = ?", (stale,))
                 self.db.execute(
                     "DELETE FROM trace_spans WHERE origin = ?", (rel,))
+                if stale:
+                    self.db.execute(
+                        "DELETE FROM trace_spans WHERE origin = ?",
+                        (stale,))
                 if traces:
                     self.db.executemany(
                         "INSERT INTO trace_spans(trace_id, origin, "
@@ -564,14 +594,15 @@ class Warehouse:
                         "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)", traces)
                 self.db.execute(
                     "INSERT INTO runs(dir, name, ts, digest, valid, "
-                    "error, degraded, deadline, status, ingested_at) "
-                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                    "error, degraded, deadline, status, archived, "
+                    "ingested_at) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                     (rel, os.path.basename(os.path.dirname(rel)) or None,
                      os.path.basename(rel), digest,
                      json.dumps(valid) if valid is not _ABSENT else None,
                      flags.get("error"), flags.get("degraded"),
                      1 if flags.get("deadline") else 0, status,
-                     time.time()))
+                     1 if archived else 0, time.time()))
                 if spans:
                     self.db.executemany(
                         "INSERT INTO run_spans(dir, name, total_s, count) "
@@ -1164,7 +1195,7 @@ class Warehouse:
         from jepsen_tpu import store as store_mod
 
         stats = {"ledgers": 0, "records": 0, "runs": 0, "events": 0,
-                 "sessions": 0, "fleet-events": 0}
+                 "sessions": 0, "fleet-events": 0, "archived": 0}
         cdir = os.path.join(base, "campaigns")
         if os.path.isdir(cdir):
             for fn in sorted(os.listdir(cdir)):
@@ -1183,6 +1214,15 @@ class Warehouse:
                 stats["runs"] += 1
             if events:
                 stats["events"] += self.ingest_events(d, base)
+        # runs retired by `obs gc` (ISSUE 18 satellite): _archive/ has
+        # the same <name>/<ts> layout, so the run-dir scan applies
+        # as-is; rows land with archived = 1 (no event streams — those
+        # were ingested while the run was live)
+        adir = store_mod.archive_dir(base)
+        if os.path.isdir(adir):
+            for d in store_mod.tests(base=adir):
+                if self.ingest_run_dir(d, base, archived=True):
+                    stats["archived"] += 1
         stats["sessions"] = self.ingest_verifier_sessions(base)
         return stats
 
@@ -1451,11 +1491,14 @@ class Warehouse:
         """Warehouse-wide gauges for the Prometheus exposition: runs by
         verdict (in-progress runs roll up as ``running`` — the ISSUE 7
         status fix), per-campaign latest verdict counts, verifier
-        session states, latest bench throughput."""
+        session states, latest bench throughput.  Archived runs are
+        excluded — the gauges describe the LIVE store, so `obs gc`
+        retiring old runs doesn't move them (the history stays
+        queryable via ``obs sql ... WHERE archived = 1``)."""
         with self._lock:
             run_rows = self.db.execute(
                 "SELECT valid, status, COUNT(*) FROM runs "
-                "GROUP BY valid, status").fetchall()
+                "WHERE archived = 0 GROUP BY valid, status").fetchall()
             ledgers = [r[0] for r in self.db.execute(
                 "SELECT DISTINCT ledger FROM campaign_records").fetchall()]
             vf_rows = self.db.execute(

@@ -225,6 +225,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._fleet_page()
             if path == "/fleet/status":
                 return self._fleet_status()
+            if path in ("/fleet/cache", "/fleet/cache/"):
+                return self._fleet_cache("")
+            if path.startswith("/fleet/cache/"):
+                return self._fleet_cache(
+                    path[len("/fleet/cache/"):].strip("/"))
             if path.startswith("/timeline/"):
                 return self._timeline(path[len("/timeline/"):])
             self._send(404, b"not found", "text/plain")
@@ -1385,6 +1390,25 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
         code, doc = self.fleet.artifact(run_id, params,
                                         self._read_body())
         self._send_json(code, doc)
+
+    def _fleet_cache(self, name: str):
+        """``GET /fleet/cache`` (entry advert JSON) and ``GET
+        /fleet/cache/<name>`` (one verified AOT entry as
+        octet-stream) — the compile-cache distribution surface
+        (docs/COMPILECACHE.md); only routed with a coordinator
+        attached."""
+        if self.fleet is None:
+            return self._send_json(
+                404, {"error": "no fleet coordinator (start with "
+                      "`fleet serve <spec.json>`)"})
+        if not name:
+            code, doc = self.fleet.cache_index()
+            return self._send_json(code, doc)
+        code, doc = self.fleet.cache_blob(name)
+        blob = doc.pop("_blob", None)
+        if code == 200 and isinstance(blob, bytes):
+            return self._send(200, blob, "application/octet-stream")
+        return self._send_json(code, doc)
 
     def _fleet_status_doc(self):
         """The coordinator's status, enriched with the co-hosted

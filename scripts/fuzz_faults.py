@@ -13,6 +13,7 @@ linearizability analysis under it, asserting the resilience invariant:
 Usage:
     JAX_PLATFORMS=cpu python scripts/fuzz_faults.py --rounds 20
     python scripts/fuzz_faults.py --rounds 5 --p 0.3 --deadline 30
+    python scripts/fuzz_faults.py --compilecache --rounds 5
 """
 
 from __future__ import annotations
@@ -336,6 +337,95 @@ def autopilot_chaos_round(seed: int, p: float = 0.35) -> dict:
             "generations": out["generations"]}
 
 
+def compilecache_chaos_round(seed: int, p: float = 0.5) -> dict:
+    """Chaos on the AOT compile-cache seams (ISSUE 18): a seeded
+    FaultPlan naming ``compilecache.load`` / ``.compile`` / ``.warm``
+    (the seams are strictly opt-in — a plan must name them) while the
+    elle device checks and the bucket warmer run against a fresh
+    store.  The invariant: every faulted call falls through to plain
+    jit with the IDENTICAL verdict (``compilecache_degraded``-stamped
+    at worst), the warmer records failed rungs instead of wedging, and
+    the on-disk store is never corrupted — every entry that survives
+    still verifies, and a fault-free rerun serves the same store with
+    zero fall-throughs."""
+    import shutil as _sh
+    import tempfile as _tf
+
+    from jepsen_tpu import compilecache
+    from jepsen_tpu.checkers.elle import list_append, rw_register
+    from jepsen_tpu.compilecache import store as cc_store
+    from jepsen_tpu.compilecache import warm as cc_warm
+    from jepsen_tpu.resilience import FaultPlan, use
+    from jepsen_tpu.workloads import synth
+
+    h = synth.la_history(n_txns=50, seed=seed)
+    if seed % 2:
+        synth.inject_wr_cycle(h)
+    hrw = synth.rw_history(n_txns=40, seed=seed)
+
+    orig_min = rw_register.FUSED_MIN_TXNS
+    rw_register.FUSED_MIN_TXNS = 1  # force the fused device path
+    # reference verdicts: fault-free, cache pinned memory-only
+    compilecache.set_cache_dir(None)
+    compilecache.clear()
+    try:
+        clean = list_append.check(h)
+        clean_rw = rw_register.check(hrw)
+
+        # chaos run against a FRESH empty store per round — a prior
+        # round's surviving disk entry would let .load succeed before
+        # the faulted .compile seam ever fires, hiding it
+        d = _tf.mkdtemp(prefix="fuzz-cc-")
+        row = {"seed": seed, "injected": 0, "fallthroughs": 0,
+               "entries": 0}
+        try:
+            compilecache.set_cache_dir(d)
+            compilecache.clear()
+            compilecache.reset_stats()
+            plan = FaultPlan(
+                seed=seed, p=max(p, 0.4),
+                kinds=("oom", "xla", "stall"), stall_s=0.001,
+                sites="compilecache.load|compilecache.compile"
+                      "|compilecache.warm")
+            with use(plan):
+                recs = cc_warm.warm_ladder(sizes=(64,), max_k=64)
+                assert recs, "warm ladder returned no records"
+                faulted = list_append.check(h)
+                faulted_rw = rw_register.check(hrw)
+            assert faulted["valid?"] == clean["valid?"], \
+                f"list-append verdict changed under cache chaos " \
+                f"({clean['valid?']} -> {faulted['valid?']})"
+            assert faulted_rw["valid?"] == clean_rw["valid?"], \
+                f"rw-register verdict changed under cache chaos " \
+                f"({clean_rw['valid?']} -> {faulted_rw['valid?']})"
+            row["injected"] = len(plan.injected)
+            row["fallthroughs"] = compilecache.stats()["fallthroughs"]
+            # never corrupt: every surviving entry still verifies
+            ents = cc_store.entries(d)
+            row["entries"] = len(ents)
+            for e in ents:
+                with open(os.path.join(d, e["name"]), "rb") as f:
+                    assert cc_store.unpack_entry(f.read()) is not None, \
+                        f"corrupt entry survived chaos: {e['name']}"
+            # and a fault-free pass over the SAME store serves it
+            # cleanly — whatever the faulted pass left behind must be
+            # usable, not wedged
+            compilecache.clear()
+            compilecache.reset_stats()
+            again = list_append.check(h)
+            assert again["valid?"] == clean["valid?"], \
+                "verdict changed on the post-chaos store"
+            assert compilecache.stats()["fallthroughs"] == 0, \
+                "fault-free rerun fell through on the post-chaos store"
+        finally:
+            compilecache.set_cache_dir(None)
+            compilecache.clear()
+            _sh.rmtree(d, ignore_errors=True)
+    finally:
+        rw_register.FUSED_MIN_TXNS = orig_min
+    return row
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rounds", type=int, default=10)
@@ -346,7 +436,26 @@ def main() -> int:
                     help="per-check deadline seconds")
     ap.add_argument("--autopilot", action="store_true",
                     help="run the autopilot seam-chaos rounds instead")
+    ap.add_argument("--compilecache", action="store_true",
+                    help="run the AOT compile-cache seam-chaos rounds "
+                         "instead (load/compile/warm fall-through)")
     args = ap.parse_args()
+
+    if args.compilecache:
+        t0 = time.time()
+        inj = ft = 0
+        for seed in range(args.seed0, args.seed0 + args.rounds):
+            row = compilecache_chaos_round(seed, max(args.p, 0.4))
+            inj += row["injected"]
+            ft += row["fallthroughs"]
+            print(f"seed {seed}: injected={row['injected']} "
+                  f"fallthroughs={row['fallthroughs']} "
+                  f"entries={row['entries']}")
+        print(f"\n{args.rounds} compile-cache rounds in "
+              f"{time.time() - t0:.1f}s: {inj} seam faults injected, "
+              f"{ft} fall-throughs to plain jit — identical verdicts, "
+              "no wedge, no corrupt entries")
+        return 0
 
     if args.autopilot:
         t0 = time.time()

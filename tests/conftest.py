@@ -29,6 +29,13 @@ if not os.environ.get("JT_NO_TEST_CACHE"):
             os.path.abspath(__file__))), ".jax_cache_tests"))
     enable_compile_cache()
 
+# AOT compile cache: memory-only for the suite. The default resolution
+# ("<store>/compilecache when ./store exists") would make persistence
+# depend on which earlier test happened to create a default-BASE store
+# dir — ordering-dependent disk churn. Tests that exercise persistence
+# pin a tmp dir via compilecache.set_cache_dir (overrides this env).
+os.environ.setdefault("JT_COMPILECACHE", "mem")
+
 import pytest
 
 
@@ -74,4 +81,9 @@ def _clear_jax_caches_between_modules():
     yield
     import jax
 
+    from jepsen_tpu import compilecache
+
+    # the AOT executable table holds Compiled objects jax.clear_caches
+    # doesn't see — drop it alongside or it defeats the memory cap
+    compilecache.clear()
     jax.clear_caches()

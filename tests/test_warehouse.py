@@ -304,7 +304,7 @@ def test_run_dir_ingest_digest_noop_and_missing_artifacts(tmp_path):
     # unchanged store: full no-op
     assert wh.ingest_store(str(tmp_path)) == \
         {"ledgers": 0, "records": 0, "runs": 0, "events": 0,
-         "sessions": 0, "fleet-events": 0}
+         "sessions": 0, "fleet-events": 0, "archived": 0}
     c = wh.counts()
     assert c["runs"] == 2 and c["witnesses"] == 1
     assert c["run_spans"] == 2   # run + check:la (telemetric run only)
@@ -398,7 +398,7 @@ def test_rebuild_from_torn_partial_store(tmp_path):
     # ... and a plain re-ingest on top is a no-op
     assert wh.ingest_store(str(tmp_path)) == \
         {"ledgers": 1, "records": 0, "runs": 0, "events": 0,
-         "sessions": 0, "fleet-events": 0}
+         "sessions": 0, "fleet-events": 0, "archived": 0}
 
 
 def test_v4_to_v5_migration_on_populated_store(tmp_path):
@@ -803,6 +803,8 @@ class _GoldenFleet:
                  "labels": {}, "value": 7},
                 {"name": "worker-rss-peak-bytes", "kind": "gauge",
                  "labels": {}, "value": 120_000_000},
+                {"name": "compile-cache-hits", "kind": "counter",
+                 "labels": {}, "value": 9},
             ]},
             "w2": {"host": "h2", "age-s": 2.0, "version": "v2",
                    "rows": [
@@ -869,6 +871,18 @@ def _golden_exposition(base):
     reg.gauge("fleet-artifact-staging-bytes").set(4096)
     reg.gauge("jit-cache-entries").set(11)
     reg.counter("compile-cache-miss", site="elle.infer").inc(2)
+    # AOT compile cache (ISSUE 18): hit/miss/byte counters + the entry
+    # gauge on the live registry (federated over the fleet heartbeat
+    # like every registry series), fall-through by seam site, and
+    # fleet entry-transfer states
+    reg.counter("compile-cache-hits").inc(9)
+    reg.counter("compile-cache-misses").inc(4)
+    reg.counter("compile-cache-bytes").inc(3131146)
+    reg.counter("compile-cache-fallthrough",
+                site="elle.core-check").inc(1)
+    reg.gauge("compile-cache-entries").set(3)
+    reg.counter("compile-cache-transfers", state="pushed").inc(2)
+    reg.counter("compile-cache-transfers", state="absorbed").inc(2)
     # memory watermarks (ISSUE 16): peak-RSS / per-device / jit-cache
     # high-watermark gauges published by the resource sampler
     reg.gauge("process-rss-peak-bytes").set(104857600)
