@@ -1214,7 +1214,10 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                           "64..1024)")
     pcc.add_argument("--max-txns", dest="max_txns", type=int,
                      default=None,
-                     help="cap the default ladder at this rung")
+                     help="cap the default ladder at this txn "
+                          "count's pow2 bucket (rungs above it are "
+                          "dropped; a bucket past 1024 extends the "
+                          "ladder to it by doubling)")
     pcc.add_argument("--families", default="la,rw",
                      help="workload families to warm (la = "
                           "list-append infer + core check, rw = "

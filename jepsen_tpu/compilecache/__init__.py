@@ -131,10 +131,15 @@ def adopt_base(base: str) -> Optional[str]:
 
 
 def clear() -> None:
-    """Drop the in-memory executable table (disk entries persist).
-    Conftest calls this alongside ``jax.clear_caches()``."""
+    """Drop the in-memory executable table and the fleet digest memo
+    (disk entries persist).  Conftest calls this alongside
+    ``jax.clear_caches()``; ``cli cache clear`` calls it after
+    deleting entries so no stale digest outlives its file."""
     with _lock:
         _mem.clear()
+    from jepsen_tpu.compilecache import fleet as cc_fleet
+
+    cc_fleet.clear_digest_memo()
 
 
 def stats() -> Dict[str, int]:
@@ -260,10 +265,15 @@ def _mem_drop(key: Optional[Tuple]) -> None:
 
 
 def _obtain(site: str, jitfn: Callable, args: tuple, static: dict
-            ) -> Tuple[Any, str]:
-    """Lower, then load-or-compile: ``(Compiled, "loaded"|"compiled")``.
-    Raises on any failure — callers map that to plain-jit fall-through
-    (:func:`call`) or a skipped rung (:mod:`.warm`)."""
+            ) -> Tuple[Any, str, Optional[Tuple[str, str]]]:
+    """Lower, then load-or-compile:
+    ``(Compiled, "loaded"|"compiled", (cache_dir, fingerprint)|None)``.
+    The third element locates the persistent entry so :func:`call` can
+    delete it if a *loaded* executable then raises at dispatch (skew
+    that only surfaces at execute time must self-heal like
+    deserialize failures do).  Raises on any failure — callers map
+    that to plain-jit fall-through (:func:`call`) or a skipped rung
+    (:mod:`.warm`)."""
     from jax.experimental import serialize_executable as _se
 
     _fire(SITE_LOAD)
@@ -278,7 +288,7 @@ def _obtain(site: str, jitfn: Callable, args: tuple, static: dict
                 compiled = _se.deserialize_and_load(*doc["payload"])
                 _bump("bytes", size)
                 _count("compile-cache-bytes", size)
-                return compiled, "loaded"
+                return compiled, "loaded", (d, fp)
             except Exception:  # noqa: BLE001 — skew/corruption: the
                 # entry deserialized but won't load here (topology or
                 # jaxlib drift inside one fingerprint epoch) — drop it
@@ -303,7 +313,7 @@ def _obtain(site: str, jitfn: Callable, args: tuple, static: dict
             # optimization, not a contract
             logger.warning("compilecache: serialize of %s failed",
                            site, exc_info=True)
-    return compiled, "compiled"
+    return compiled, "compiled", (d, fp) if d and fp else None
 
 
 def call(site: str, jitfn: Callable, *args: Any, **static: Any) -> Any:
@@ -331,10 +341,23 @@ def call(site: str, jitfn: Callable, *args: Any, **static: Any) -> Any:
         _count("compile-cache-hits")
         return out
     try:
-        compiled, how = _obtain(site, jitfn, args, static)
-        out = compiled(*args)
+        compiled, how, loc = _obtain(site, jitfn, args, static)
     except Exception:  # noqa: BLE001 — injected fault, corrupt entry,
         # serialization gap: plain jit is always correct
+        return _fallthrough(site, jitfn, args, static)
+    try:
+        out = compiled(*args)
+    except Exception:  # noqa: BLE001 — plain jit is always correct
+        if how == "loaded" and loc:
+            # the entry deserialized but its executable raises at
+            # dispatch ("Symbols not found"-style skew can surface
+            # here too): delete it, mirroring the deserialize-failure
+            # path, so the next call recompiles and re-serializes a
+            # good one instead of paying deserialize + fall-through
+            # forever
+            logger.warning("compilecache: loaded entry %s raised at "
+                           "dispatch; dropped", loc[1], exc_info=True)
+            store.delete(*loc)
         return _fallthrough(site, jitfn, args, static)
     _mem_put(mk, compiled)
     if how == "loaded":
@@ -375,7 +398,7 @@ def ensure(site: str, jitfn: Callable, *args: Any,
     mk = _mem_key(site, jitfn, args, static)
     if _mem_get(mk) is not None:
         return "cached"
-    compiled, how = _obtain(site, jitfn, args, static)
+    compiled, how, _loc = _obtain(site, jitfn, args, static)
     _mem_put(mk, compiled)
     if how == "loaded":
         _bump("hits")

@@ -103,8 +103,10 @@ LADDER: Tuple[int, ...] = (64, 128, 256, 512, 1024)
 def ladder(max_txns: int | None = None,
            sizes: Iterable[int] | None = None) -> List[int]:
     """The pre-warm rung list: explicit `sizes`, else the default
-    ladder optionally extended by doubling up to ``max_txns``'s
-    bucket."""
+    ladder capped at ``max_txns``'s bucket — rungs above it are
+    dropped, and when the bucket exceeds the default top the ladder
+    extends to it by doubling.  ``ladder(max_txns=128) == [64, 128]``;
+    ``ladder(max_txns=5000)`` runs 64..8192."""
     if sizes is not None:
         return sorted({pow2_at_least(int(s)) for s in sizes})
     rungs = set(LADDER)
@@ -114,4 +116,5 @@ def ladder(max_txns: int | None = None,
         while r < top:
             r *= 2
             rungs.add(r)
+        rungs = {r for r in rungs if r <= top} or {top}
     return sorted(rungs)

@@ -25,6 +25,24 @@ class is warm:
   store (fingerprint-named, so concurrent workers pushing the same
   class converge on one entry), and the batch dir is removed.
 
+**Authentication.**  An entry body is a pickle (JAX's AOT
+serialization is pickle-based end to end — ``deserialize_and_load``
+unpickles even the inner payload), so unpickling bytes that arrived
+over the unauthenticated fleet HTTP surface would be remote code
+execution for anyone who can reach the endpoints.  Every transfer is
+therefore HMAC-SHA256-authenticated with the fleet shared secret
+(:func:`shared_secret`): the coordinator signs served blobs
+(``X-Jepsen-Cache-MAC`` response header), the worker signs pushed
+entries (``<name>.mac`` sidecars in the batch), and BOTH sides verify
+with :func:`hmac.compare_digest` *before* any ``pickle.loads``.  The
+in-file sha256 framing still guards integrity; the MAC guards origin.
+No secret → no transfer: pull/push/absorb refuse (counted
+``unauthenticated``) and the worker simply compiles locally.  The
+secret is ``$JEPSEN_FLEET_SECRET`` (set it on every host of a
+multi-host fleet), else ``<base>/fleet/secret`` — the coordinator
+mints one at startup, so single-host fleets sharing a store base
+authenticate with zero configuration.
+
 Everything here is best-effort: a failed pull/push/absorb logs and
 moves on — the worker just compiles locally, exactly as before the
 cache existed.
@@ -32,8 +50,11 @@ cache existed.
 
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
 import logging
 import os
+import secrets as secrets_mod
 import shutil
 import tempfile
 import threading
@@ -46,15 +67,76 @@ from jepsen_tpu.compilecache import store
 logger = logging.getLogger("jepsen.compilecache")
 
 __all__ = ["export_index", "entry_names", "read_entry", "absorb",
-           "pull_missing", "push_new", "MAX_ADVERT_ENTRIES"]
+           "pull_missing", "push_new", "shared_secret", "entry_mac",
+           "MAX_ADVERT_ENTRIES", "MAC_HEADER", "MAC_SUFFIX",
+           "SECRET_ENV"]
 
 #: cap on entries a claim response adverts — a claim is a hot-path
 #: control message, not a directory dump
 MAX_ADVERT_ENTRIES = 128
 
+#: HTTP response header carrying the coordinator's HMAC of a served
+#: entry blob
+MAC_HEADER = "X-Jepsen-Cache-MAC"
+#: per-entry MAC sidecar suffix inside a pushed batch
+MAC_SUFFIX = ".mac"
+#: the fleet shared secret env override (multi-host fleets set this
+#: on every host; single-host fleets get ``<base>/fleet/secret``)
+SECRET_ENV = "JEPSEN_FLEET_SECRET"
+
 _digest_lock = threading.Lock()
-#: name -> (size, mtime, digest): the by-stat digest memo
-_digests: Dict[str, Tuple[int, float, str]] = {}
+#: entry PATH -> (size, mtime_ns, digest): the by-stat digest memo.
+#: Keyed by full path (tests switch cache dirs), pruned against the
+#: live listing on every export, cleared by ``compilecache.clear()``.
+_digests: Dict[str, Tuple[int, int, str]] = {}
+
+
+def clear_digest_memo() -> None:
+    with _digest_lock:
+        _digests.clear()
+
+
+def shared_secret(base: Optional[str],
+                  create: bool = False) -> Optional[bytes]:
+    """The fleet cache-transfer HMAC key: ``$JEPSEN_FLEET_SECRET``,
+    else the ``<base>/fleet/secret`` file.  With ``create=True`` (the
+    coordinator) a missing file is minted (0600, atomic) so
+    shared-base workers pick it up with zero configuration.  None
+    means unauthenticated — every transfer refuses."""
+    env = os.environ.get(SECRET_ENV, "").strip()
+    if env:
+        return env.encode()
+    if not base:
+        return None
+    path = os.path.join(base, "fleet", "secret")
+    try:
+        with open(path, "rb") as f:
+            return f.read().strip() or None
+    except OSError:
+        pass
+    if not create:
+        return None
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(secrets_mod.token_hex(32).encode())
+        os.replace(tmp, path)
+        # re-read: a concurrent minter may have won the replace race
+        with open(path, "rb") as f:
+            return f.read().strip() or None
+    except OSError:
+        logger.warning("fleet secret mint at %s failed", path,
+                       exc_info=True)
+        return None
+
+
+def entry_mac(secret: bytes, blob: bytes) -> str:
+    """HMAC-SHA256 hex of one entry's file bytes under the fleet
+    shared secret — origin authentication for the transfer surfaces
+    (the in-file sha256 covers integrity only)."""
+    return hmac_mod.new(secret, blob, hashlib.sha256).hexdigest()
 
 
 def _registry():
@@ -86,24 +168,34 @@ def export_index(cache_dir: Optional[str],
     if not cache_dir:
         return []
     out: List[Dict[str, Any]] = []
-    for e in store.entries(cache_dir)[:max(0, int(limit))]:
+    listed = store.entries(cache_dir)
+    for e in listed[:max(0, int(limit))]:
         name, size = e["name"], e["size"]
         path = os.path.join(cache_dir, name)
         try:
-            mtime = os.path.getmtime(path)
+            mtime_ns = os.stat(path).st_mtime_ns
         except OSError:
             continue
         with _digest_lock:
-            memo = _digests.get(name)
-        if memo is not None and memo[0] == size and memo[1] == mtime:
+            memo = _digests.get(path)
+        if memo is not None and memo[0] == size \
+                and memo[1] == mtime_ns:
             digest = memo[2]
         else:
             digest = store.file_digest(path)
             if digest is None:
                 continue
             with _digest_lock:
-                _digests[name] = (size, mtime, digest)
+                _digests[path] = (size, mtime_ns, digest)
         out.append({"name": name, "digest": digest, "size": size})
+    # bound the memo: drop keys under this dir whose entry is gone
+    # (cache clear, test teardown) — the memo tracks live files only
+    live = {os.path.join(cache_dir, e["name"]) for e in listed}
+    prefix = cache_dir.rstrip(os.sep) + os.sep
+    with _digest_lock:
+        for path in [p for p in _digests
+                     if p.startswith(prefix) and p not in live]:
+            del _digests[path]
     return out
 
 
@@ -150,16 +242,27 @@ def _install(cache_dir: str, name: str, blob: bytes) -> bool:
 
 def absorb(base: str, rel: str) -> int:
     """Coordinator side: a landed ``compilecache/<batch>`` artifact dir
-    becomes flat store entries.  Each ``*.aotx`` is verified (corrupt
-    members are dropped, not installed) and moved up into
-    ``<base>/compilecache/``; the batch dir is removed.  Returns the
-    number of entries absorbed."""
+    becomes flat store entries.  Each ``*.aotx`` must carry a valid
+    ``<name>.mac`` sidecar (HMAC under the fleet secret — verified
+    BEFORE the body is ever unpickled) and parse as a well-formed
+    entry; failures are dropped, not installed.  Survivors move up
+    into ``<base>/compilecache/``; the batch dir is removed.  Returns
+    the number of entries absorbed."""
     batch = os.path.join(base, rel)
     dest = os.path.join(base, "compilecache")
+    secret = shared_secret(base, create=True)
     absorbed = 0
     try:
         names = sorted(os.listdir(batch))
     except OSError:
+        return 0
+    if secret is None:
+        # no key to verify origin with: never unpickle the push
+        logger.warning("compilecache: no fleet secret; pushed batch "
+                       "%s dropped unabsorbed (set %s)", rel,
+                       SECRET_ENV)
+        _count("unauthenticated", max(1, len(names)))
+        shutil.rmtree(batch, ignore_errors=True)
         return 0
     for fn in names:
         src = os.path.join(batch, fn)
@@ -170,7 +273,17 @@ def absorb(base: str, rel: str) -> int:
         try:
             with open(src, "rb") as f:
                 blob = f.read()
+            with open(src + MAC_SUFFIX, "rb") as f:
+                mac = f.read().strip().decode("ascii", "replace")
         except OSError:
+            logger.warning("compilecache: pushed entry %s unreadable "
+                           "or missing its .mac sidecar; dropped", fn)
+            _count("push-rejected")
+            continue
+        if not hmac_mod.compare_digest(entry_mac(secret, blob), mac):
+            logger.warning("compilecache: pushed entry %s failed HMAC "
+                           "verification; dropped", fn)
+            _count("push-rejected")
             continue
         if store.unpack_entry(blob) is None:
             logger.warning("compilecache: pushed entry %s corrupt; "
@@ -193,12 +306,22 @@ def absorb(base: str, rel: str) -> int:
 
 def pull_missing(base_url: str, advert: Any,
                  cache_dir: Optional[str],
+                 secret: Optional[bytes] = None,
                  timeout_s: float = 10.0) -> int:
     """Worker side: fetch advertised entries absent locally.  Each
-    blob must match the advert's sha256 AND parse as a well-formed
-    entry before the atomic install; failures skip the entry (the
-    worker compiles that class locally).  Returns entries installed."""
+    blob's :data:`MAC_HEADER` must verify under the fleet `secret`
+    (checked BEFORE the body is ever unpickled), then the blob must
+    match the advert's sha256 AND parse as a well-formed entry before
+    the atomic install; failures skip the entry (the worker compiles
+    that class locally).  No secret → no pull.  Returns entries
+    installed."""
     if not cache_dir or not isinstance(advert, list) or not advert:
+        return 0
+    if secret is None:
+        logger.warning("compilecache: no fleet secret; skipping pull "
+                       "of %d advertised entries (set %s)",
+                       len(advert), SECRET_ENV)
+        _count("unauthenticated", len(advert))
         return 0
     have = entry_names(cache_dir)
     pulled = 0
@@ -213,14 +336,18 @@ def pull_missing(base_url: str, advert: Any,
         try:
             with urllib.request.urlopen(url, timeout=timeout_s) as r:
                 blob = r.read()
+                mac = str(r.headers.get(MAC_HEADER) or "")
         except Exception as e:  # noqa: BLE001 — a cache pull must
             # never fail a cell
             logger.warning("compilecache: pull of %s failed (%s)",
                            name, e)
             _count("pull-failed")
             continue
-        import hashlib
-
+        if not hmac_mod.compare_digest(entry_mac(secret, blob), mac):
+            logger.warning("compilecache: pulled entry %s failed HMAC "
+                           "verification; dropped", name)
+            _count("pull-rejected")
+            continue
         if hashlib.sha256(blob).hexdigest() != want \
                 or store.unpack_entry(blob) is None:
             logger.warning("compilecache: pulled entry %s failed "
@@ -237,11 +364,20 @@ def pull_missing(base_url: str, advert: Any,
 
 
 def push_new(worker: Any, new_names: Set[str],
-             cache_dir: Optional[str]) -> bool:
+             cache_dir: Optional[str],
+             secret: Optional[bytes] = None) -> bool:
     """Worker side: ship freshly minted entries to the coordinator as
-    ONE batch artifact over the resumable upload seam.  ``worker`` is
+    ONE batch artifact over the resumable upload seam, each with a
+    ``<name>.mac`` HMAC sidecar the coordinator's :func:`absorb`
+    verifies before unpickling.  No secret → no push.  ``worker`` is
     a `fleet.worker.FleetWorker` (duck-typed: `_upload_spooled`)."""
     if not cache_dir or not new_names:
+        return False
+    if secret is None:
+        logger.warning("compilecache: no fleet secret; %d minted "
+                       "entries not pushed (set %s)", len(new_names),
+                       SECRET_ENV)
+        _count("unauthenticated", len(new_names))
         return False
     from jepsen_tpu.fleet.artifacts import pack_run_dir_file
 
@@ -255,6 +391,8 @@ def push_new(worker: Any, new_names: Set[str],
                 continue
             with open(os.path.join(td, name), "wb") as f:
                 f.write(blob)
+            with open(os.path.join(td, name + MAC_SUFFIX), "wb") as f:
+                f.write(entry_mac(secret, blob).encode())
             staged += 1
         if not staged:
             return False

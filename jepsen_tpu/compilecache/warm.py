@@ -2,8 +2,9 @@
 
 The graft of ``scripts/aot_warm.py`` into supported machinery: instead
 of a one-off script lowering the 10M TPU programs, :func:`warm_ladder`
-walks the default bucket ladder (:data:`bucket.LADDER`, extendable via
-``--sizes`` / ``--max-txns``) and ensures every rung's checker
+walks the default bucket ladder (:data:`bucket.LADDER`, overridable
+via ``--sizes``, capped/extended to ``--max-txns``'s bucket) and
+ensures every rung's checker
 executables exist in the persistent store — so the first shrink probe,
 campaign cell, or fleet claim of a known shape class pays dispatch,
 not compile.

@@ -143,6 +143,16 @@ class FleetCoordinator:
         #: store federation (ISSUE 13): the artifact-upload endpoint's
         #: staging + atomic landing
         self.artifacts = ArtifactStore(self.base)
+        try:
+            # mint the fleet cache-transfer secret (compilecache.fleet
+            # HMAC) up front so shared-base workers find it before
+            # their first pull/push; env override wins inside
+            from jepsen_tpu.compilecache import fleet as cc_fleet
+
+            cc_fleet.shared_secret(self.base, create=True)
+        except Exception:  # noqa: BLE001 — transfers then refuse,
+            # workers just compile locally
+            logger.warning("fleet secret mint failed", exc_info=True)
         #: staging retention (ISSUE 14 satellite): permanently
         #: abandoned upload partials expire past this; GC rides the
         #: heartbeat/status paths, throttled to one pass per interval
@@ -562,7 +572,9 @@ class FleetCoordinator:
 
     def cache_blob(self, name: str) -> Tuple[int, Dict[str, Any]]:
         """``GET /fleet/cache/<name>`` — one verified entry's bytes
-        (the web layer streams ``doc["_blob"]`` as octet-stream)."""
+        (the web layer streams ``doc["_blob"]`` as octet-stream with
+        the ``doc["_mac"]`` HMAC in the response header, which the
+        worker verifies before unpickling anything)."""
         return self._guarded("fleet.cache", self._cache_blob, name)
 
     def _cache_blob(self, name: str) -> Tuple[int, Dict[str, Any]]:
@@ -571,7 +583,11 @@ class FleetCoordinator:
         blob = cc_fleet.read_entry(self.cache_dir(), name)
         if blob is None:
             return 404, {"error": f"no cache entry {name!r}"}
-        return 200, {"_blob": blob, "name": name}
+        doc: Dict[str, Any] = {"_blob": blob, "name": name}
+        secret = cc_fleet.shared_secret(self.base, create=True)
+        if secret is not None:
+            doc["_mac"] = cc_fleet.entry_mac(secret, blob)
+        return 200, doc
 
     def release(self, body: Dict[str, Any]
                 ) -> Tuple[int, Dict[str, Any]]:

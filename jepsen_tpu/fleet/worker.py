@@ -524,21 +524,35 @@ class FleetWorker:
         # claim's advertised AOT entries before executing, so this
         # worker's first cell of a known shape class dispatches a
         # pre-built executable instead of compiling; snapshot the
-        # store so freshly minted entries can be pushed back after
+        # store so freshly minted entries can be pushed back after.
+        # The baseline snapshot is its own guarded step BEFORE the
+        # pull — a failed pull must not void it, or the post-cell push
+        # would re-upload the entire local store every cell.
         cc_dir: Optional[str] = None
         cc_pre: set = set()
+        cc_secret: Optional[bytes] = None
         try:
             from jepsen_tpu import compilecache
             from jepsen_tpu.compilecache import fleet as cc_fleet
 
             cc_dir = compilecache.cache_dir()
-            if cc_dir and cc_advert:
-                cc_fleet.pull_missing(self.url, cc_advert, cc_dir,
-                                      timeout_s=self.timeout_s)
             cc_pre = cc_fleet.entry_names(cc_dir)
+            cc_secret = cc_fleet.shared_secret(self.base)
         except Exception:  # noqa: BLE001 — never fail a cell on cache
-            logger.warning("fleet worker %s: compile-cache pull "
+            logger.warning("fleet worker %s: compile-cache snapshot "
                            "failed", self.name, exc_info=True)
+        if cc_dir and cc_advert:
+            try:
+                cc_fleet.pull_missing(self.url, cc_advert, cc_dir,
+                                      cc_secret,
+                                      timeout_s=self.timeout_s)
+                # pulled entries are not "minted here": fold them into
+                # the baseline so the push sends only what this cell
+                # compiles
+                cc_pre = cc_fleet.entry_names(cc_dir)
+            except Exception:  # noqa: BLE001
+                logger.warning("fleet worker %s: compile-cache pull "
+                               "failed", self.name, exc_info=True)
         # distributed trace (ISSUE 14): adopt the claim's trace id —
         # equal to the locally derivable one (both are pure functions
         # of the run id), so a claim from an older coordinator still
@@ -732,7 +746,8 @@ class FleetWorker:
 
                     new = cc_fleet.entry_names(cc_dir) - cc_pre
                     if new:
-                        cc_fleet.push_new(self, new, cc_dir)
+                        cc_fleet.push_new(self, new, cc_dir,
+                                          cc_secret)
             except Exception:  # noqa: BLE001 — push is an optimization
                 logger.warning("fleet worker %s: compile-cache push "
                                "failed", self.name, exc_info=True)

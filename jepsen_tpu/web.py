@@ -1406,8 +1406,13 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
             return self._send_json(code, doc)
         code, doc = self.fleet.cache_blob(name)
         blob = doc.pop("_blob", None)
+        mac = doc.pop("_mac", None)
         if code == 200 and isinstance(blob, bytes):
-            return self._send(200, blob, "application/octet-stream")
+            from jepsen_tpu.compilecache import fleet as cc_fleet
+
+            extra = {cc_fleet.MAC_HEADER: mac} if mac else None
+            return self._send(200, blob, "application/octet-stream",
+                              extra)
         return self._send_json(code, doc)
 
     def _fleet_status_doc(self):

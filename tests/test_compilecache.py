@@ -117,6 +117,11 @@ def test_ladder():
     assert bucket.ladder(max_txns=5000) == \
         sorted(set(bucket.LADDER) | {2048, 4096, 8192})
     assert bucket.ladder(sizes=[100, 100, 3]) == [8, 128]
+    # --max-txns CAPS the ladder (the CLI help's contract): rungs
+    # above the bucket are dropped, never warmed
+    assert bucket.ladder(max_txns=128) == [64, 128]
+    assert bucket.ladder(max_txns=200) == [64, 128, 256]
+    assert bucket.ladder(max_txns=5) == [8]
 
 
 def test_ir_bucket_class_shared_across_sizes():
@@ -225,6 +230,45 @@ def test_corrupt_entry_falls_through_and_reserializes(tmp_path):
     [e2] = store.entries(str(tmp_path))
     with open(os.path.join(str(tmp_path), e2["name"]), "rb") as fh:
         assert store.unpack_entry(fh.read()) is not None
+
+
+def test_loaded_entry_raising_at_dispatch_self_heals(tmp_path,
+                                                     monkeypatch):
+    """An entry that deserializes fine but whose executable raises at
+    dispatch (execute-time skew) is DELETED, the call falls through to
+    plain jit, and the next call recompiles + re-persists — the cache
+    never pays deserialize + fall-through forever."""
+    from jax.experimental import serialize_executable as se
+
+    compilecache.set_cache_dir(str(tmp_path))
+    f = _jit_double()
+    x = _arange(64)
+    want = np.asarray(x) * 2 + 1
+    compilecache.call("t.skew", f, x)
+    assert len(store.entries(str(tmp_path))) == 1
+
+    class _Broken:
+        def __call__(self, *a):
+            raise RuntimeError("Symbols not found (execute-time skew)")
+
+    compilecache.clear()
+    compilecache.reset_stats()
+    monkeypatch.setattr(se, "deserialize_and_load",
+                        lambda *a, **kw: _Broken())
+    out = compilecache.call("t.skew", f, x)
+    assert np.array_equal(np.asarray(out), want)  # fell through, right
+    st = compilecache.stats()
+    assert st["fallthroughs"] == 1
+    assert store.entries(str(tmp_path)) == [], \
+        "the skewed entry must be deleted, not retried forever"
+    monkeypatch.undo()
+    compilecache.clear()
+    compilecache.reset_stats()
+    out2 = compilecache.call("t.skew", f, x)
+    assert np.array_equal(np.asarray(out2), want)
+    st = compilecache.stats()
+    assert st["misses"] == 1 and st["fallthroughs"] == 0
+    assert len(store.entries(str(tmp_path))) == 1, "re-persisted"
 
 
 def test_chaos_plan_fires_only_when_named(tmp_path):
@@ -398,27 +442,57 @@ def test_export_index_memo_and_read(tmp_path):
     store.put(d, "b" * 40, {"site": "t"}, b"p")
     [row] = cc_fleet.export_index(d)
     assert row["name"] == "b" * 40 + store.SUFFIX
-    assert row["digest"] == store.file_digest(
-        os.path.join(d, row["name"]))
-    # memoized by (size, mtime): a second export returns the same row
+    path = os.path.join(d, row["name"])
+    assert row["digest"] == store.file_digest(path)
+    # memoized by path + (size, mtime_ns): a second export returns the
+    # same row
     assert cc_fleet.export_index(d) == [row]
+    assert path in cc_fleet._digests
     blob = cc_fleet.read_entry(d, row["name"])
     assert blob is not None and store.unpack_entry(blob) is not None
     assert cc_fleet.read_entry(d, "../" + row["name"]) is None
     assert cc_fleet.read_entry(d, "nope" + store.SUFFIX) is None
+    # the memo never outlives its file: a deleted entry's digest is
+    # pruned on the next export, and compilecache.clear() empties it
+    store.delete(d, "b" * 40)
+    assert cc_fleet.export_index(d) == []
+    assert path not in cc_fleet._digests
+    store.put(d, "b" * 40, {"site": "t"}, b"p")
+    cc_fleet.export_index(d)
+    compilecache.clear()
+    assert cc_fleet._digests == {}
 
 
-def test_absorb_verifies_and_flattens(tmp_path):
-    base = str(tmp_path)
+def _mint_batch(base, entries):
+    """Stage a pushed-batch dir: (name, blob, mac|None) triples."""
     batch = os.path.join(base, "compilecache", "cc-test")
-    os.makedirs(batch)
+    os.makedirs(batch, exist_ok=True)
+    for name, blob, mac in entries:
+        with open(os.path.join(batch, name), "wb") as f:
+            f.write(blob)
+        if mac is not None:
+            with open(os.path.join(batch,
+                                   name + cc_fleet.MAC_SUFFIX),
+                      "wb") as f:
+                f.write(mac.encode())
+    return batch
+
+
+def test_absorb_verifies_and_flattens(tmp_path, monkeypatch):
+    monkeypatch.setenv(cc_fleet.SECRET_ENV, "test-secret")
+    secret = cc_fleet.shared_secret(None)
+    base = str(tmp_path)
     good = store.pack_entry({"site": "t"}, b"p")
-    with open(os.path.join(batch, "c" * 40 + store.SUFFIX), "wb") as f:
-        f.write(good)
-    with open(os.path.join(batch, "d" * 40 + store.SUFFIX), "wb") as f:
-        f.write(b"corrupt")
-    with open(os.path.join(batch, "notes.txt"), "wb") as f:
-        f.write(b"skip me")
+    other = store.pack_entry({"site": "t"}, b"q")
+    batch = _mint_batch(base, [
+        ("c" * 40 + store.SUFFIX, good, cc_fleet.entry_mac(secret,
+                                                           good)),
+        ("d" * 40 + store.SUFFIX, b"corrupt",
+         cc_fleet.entry_mac(secret, b"corrupt")),
+        ("e" * 40 + store.SUFFIX, other, "0" * 64),  # forged MAC
+        ("f" * 40 + store.SUFFIX, other, None),      # no sidecar
+        ("notes.txt", b"skip me", None),
+    ])
     n = cc_fleet.absorb(base, "compilecache/cc-test")
     assert n == 1
     assert not os.path.exists(batch), "batch dir must be removed"
@@ -427,15 +501,67 @@ def test_absorb_verifies_and_flattens(tmp_path):
         ["c" * 40 + store.SUFFIX]
 
 
-def test_fleet_prewarmed_first_claim_zero_miss(tmp_path):
+def test_transfers_refuse_without_secret(tmp_path, monkeypatch):
+    """The RCE guard: no shared secret means NO network bytes are ever
+    unpickled — absorb drops the whole batch, pull and push refuse
+    outright.  The local cache is untouched either way."""
+    monkeypatch.delenv(cc_fleet.SECRET_ENV, raising=False)
+    base = str(tmp_path)
+    good = store.pack_entry({"site": "t"}, b"p")
+    batch = _mint_batch(base, [
+        ("c" * 40 + store.SUFFIX, good, None)])
+    assert cc_fleet.shared_secret(base) is None
+    # a FILE at <base>/fleet makes the coordinator's auto-mint fail,
+    # pinning the secretless-absorb branch: the whole batch drops
+    with open(os.path.join(base, "fleet"), "wb") as f:
+        f.write(b"not a dir")
+    assert cc_fleet.absorb(base, "compilecache/cc-test") == 0
+    assert not os.path.exists(batch)
+    assert store.entries(os.path.join(base, "compilecache")) == []
+    # with a mintable secret, an entry missing its MAC sidecar is
+    # still dropped — unauthenticated bytes are never unpickled
+    os.remove(os.path.join(base, "fleet"))
+    batch = _mint_batch(base, [
+        ("c" * 40 + store.SUFFIX, good, None)])
+    assert cc_fleet.absorb(base, "compilecache/cc-test") == 0
+    assert store.entries(os.path.join(base, "compilecache")) == []
+    # worker side: no secret -> pull refuses before any HTTP
+    adv = [{"name": "c" * 40 + store.SUFFIX, "digest": "0" * 64,
+            "size": 1}]
+    d = os.path.join(base, "wdir")
+    assert cc_fleet.pull_missing("http://127.0.0.1:9", adv, d,
+                                 secret=None) == 0
+    assert cc_fleet.push_new(object(), {"x" + store.SUFFIX}, d,
+                             secret=None) is False
+
+
+def test_shared_secret_mint_and_reuse(tmp_path, monkeypatch):
+    monkeypatch.delenv(cc_fleet.SECRET_ENV, raising=False)
+    base = str(tmp_path)
+    assert cc_fleet.shared_secret(base) is None, "no mint on read"
+    s = cc_fleet.shared_secret(base, create=True)
+    assert s and len(s) == 64  # token_hex(32)
+    assert cc_fleet.shared_secret(base) == s, "stable across reads"
+    assert os.stat(os.path.join(base, "fleet", "secret")).st_mode \
+        & 0o777 == 0o600
+    monkeypatch.setenv(cc_fleet.SECRET_ENV, "env-wins")
+    assert cc_fleet.shared_secret(base) == b"env-wins"
+
+
+def test_fleet_prewarmed_first_claim_zero_miss(tmp_path, monkeypatch):
     """End to end over a real coordinator + HTTP server: the claim
-    adverts the coordinator's entries, the worker pulls what it lacks,
-    and its FIRST dispatch of those classes counts ZERO misses.  Wrong
-    digests are rejected; a worker-minted entry pushed over the
-    artifact channel lands in the coordinator's flat store."""
+    adverts the coordinator's entries, the worker pulls what it lacks
+    (HMAC-verified under the shared secret), and its FIRST dispatch of
+    those classes counts ZERO misses.  Wrong digests are rejected; a
+    worker-minted entry pushed over the artifact channel (with MAC
+    sidecars) lands in the coordinator's flat store."""
     from jepsen_tpu import web
     from jepsen_tpu.fleet import FleetCoordinator, FleetWorker
 
+    # the coordinator and (different-base) worker share the fleet
+    # secret the multi-host way: the env var
+    monkeypatch.setenv(cc_fleet.SECRET_ENV, "fleet-test-secret")
+    secret = cc_fleet.shared_secret(None)
     base1 = str(tmp_path / "coord")
     cdir = os.path.join(base1, "compilecache")
     compilecache.set_cache_dir(cdir)
@@ -461,8 +587,9 @@ def test_fleet_prewarmed_first_claim_zero_miss(tmp_path):
         base2 = str(tmp_path / "worker")
         wdir = os.path.join(base2, "compilecache")
         compilecache.set_cache_dir(wdir)
-        assert cc_fleet.pull_missing(url, adv, wdir) == 2
-        assert cc_fleet.pull_missing(url, adv, wdir) == 0  # idempotent
+        assert cc_fleet.pull_missing(url, adv, wdir, secret) == 2
+        assert cc_fleet.pull_missing(url, adv, wdir,
+                                     secret) == 0  # idempotent
         compilecache.clear()
         compilecache.reset_stats()
         for x in xs:
@@ -477,7 +604,13 @@ def test_fleet_prewarmed_first_claim_zero_miss(tmp_path):
         victim = sorted(names)[0]
         os.remove(os.path.join(wdir, victim))
         bad = [{"name": victim, "digest": "0" * 64, "size": 1}]
-        assert cc_fleet.pull_missing(url, bad, wdir) == 0
+        assert cc_fleet.pull_missing(url, bad, wdir, secret) == 0
+        assert victim not in cc_fleet.entry_names(wdir)
+
+        # a wrong SECRET fails the MAC check before anything else
+        good_adv = [r for r in adv if r["name"] == victim]
+        assert cc_fleet.pull_missing(url, good_adv, wdir,
+                                     b"wrong-secret") == 0
         assert victim not in cc_fleet.entry_names(wdir)
 
         # push: a worker-minted class travels back and is absorbed
@@ -486,7 +619,7 @@ def test_fleet_prewarmed_first_claim_zero_miss(tmp_path):
         new = cc_fleet.entry_names(wdir) - names
         assert len(new) == 1
         w = FleetWorker(url, base2, name="w1", poll_s=0.05)
-        assert cc_fleet.push_new(w, new, wdir)
+        assert cc_fleet.push_new(w, new, wdir, secret)
         assert new <= cc_fleet.entry_names(cdir)
     finally:
         srv.server_close()
