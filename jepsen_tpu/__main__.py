@@ -45,7 +45,34 @@ def _wl(name: str, opts: Dict[str, Any]):
     if name == "set":
         return sets.workload(rng=rng), MemClient(latency=lat)
     if name == "queue":
-        return queue.workload(rng=rng), MemClient(latency=lat)
+        adv = opts.get("queue-adversary") or {}
+        return (queue.workload(rng=rng, fifo=bool(adv.get("fifo"))),
+                MemClient(
+                    latency=lat, rng=random.Random(opts.get("seed")),
+                    dup_enqueue_p=float(adv.get("dup-enqueue-p") or 0.0),
+                    lose_enqueue_p=float(adv.get("lose-enqueue-p") or 0.0),
+                    reorder_dequeue_p=float(
+                        adv.get("reorder-dequeue-p") or 0.0)))
+    if name == "kafka":
+        from .workloads import kafka as kafka_wl
+
+        adv = opts.get("queue-adversary") or {}
+        store = kafka_wl.KafkaStore()
+        store.freeze_commits = bool(adv.get("freeze-commits"))
+        client = kafka_wl.KafkaClient(
+            store, rng=random.Random(opts.get("seed")),
+            lose_tail_p=float(adv.get("lose-tail-p") or 0.0),
+            dup_p=float(adv.get("dup-p") or 0.0),
+            dup_send_p=float(adv.get("dup-send-p") or 0.0),
+            reorder_p=float(adv.get("reorder-p") or 0.0),
+            zombie_p=float(adv.get("zombie-p") or 0.0),
+            torn_p=float(adv.get("torn-p") or 0.0))
+        return (kafka_wl.workload(
+            key_count=int(opts.get("kafka-key-count") or 4),
+            subscribe_frac=float(opts.get("kafka-subscribe-frac", 0.2)),
+            txn_frac=float(opts.get("kafka-txn-frac", 0.3)),
+            crash_frac=float(opts.get("kafka-crash-frac", 0.05)),
+            rng=rng), client)
     if name == "causal":
         return (causal.workload(rng=rng),
                 MemClient(txn_kind="rw-register", latency=lat))
@@ -87,7 +114,7 @@ def _demo_test(name: str):
 
 DEMOS = {n: _demo_test(n) for n in
          ("append", "wr", "lin-register", "bank", "long-fork", "set",
-          "queue", "causal", "write-skew", "session")}
+          "queue", "kafka", "causal", "write-skew", "session")}
 
 if __name__ == "__main__":
     cli.main(cli.test_all_cmd(DEMOS, prog="python -m jepsen_tpu"))

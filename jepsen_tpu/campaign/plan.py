@@ -45,7 +45,7 @@ __all__ = ["RunSpec", "expand", "load_spec", "spec_digest",
 #: checkers run freely
 DEVICE_WORKLOADS = frozenset({
     "append", "wr", "causal", "long-fork", "lin-register", "queue",
-    "bank", "write-skew", "session",
+    "bank", "write-skew", "session", "kafka",
 })
 
 #: extension point: name -> builder(opts_dict) -> test map (db suites

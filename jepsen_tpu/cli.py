@@ -73,6 +73,11 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    help='number of workers, e.g. "30" or "10n" (per node)')
     p.add_argument("--time-limit", type=float, default=60.0,
                    help="seconds to run the workload")
+    p.add_argument("--ops", type=int, default=None,
+                   help="cap on generated operations; with --time-limit, "
+                        "whichever bound hits first ends the workload. "
+                        "Without it workload size — and checker cost — "
+                        "scales with host speed")
     p.add_argument("--checker-time-limit", type=float, default=None,
                    help="seconds of analysis budget per check; past it "
                         "checkers return valid? = unknown with "
@@ -122,10 +127,15 @@ def opts_to_test_map(opts: argparse.Namespace) -> Dict[str, Any]:
 
 
 def _apply_time_limit(test: Dict[str, Any]) -> Dict[str, Any]:
+    if test.get("generator") is None:
+        return test
+    from .generator import core as g
     tl = test.get("time-limit")
-    if tl and test.get("generator") is not None:
-        from .generator import core as g
+    if tl:
         test["generator"] = g.time_limit(float(tl), test["generator"])
+    n = test.get("ops")
+    if n:
+        test["generator"] = g.limit(int(n), test["generator"])
     return test
 
 
@@ -394,6 +404,12 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
                   file=sys.stderr)
             return 2
         url = f"http://{opts.host}:{opts.port}"
+        mutate = None
+        if getattr(opts, "rotate", 0):
+            from jepsen_tpu.fleet import scenario_rotation
+            mutate = scenario_rotation(
+                pivot=tuple(getattr(opts, "pivot", None) or ()),
+                slots=opts.rotate)
         try:
             ap = Autopilot(
                 opts.spec, base, lease_s=opts.lease,
@@ -401,6 +417,8 @@ def fleet_cmd(opts: argparse.Namespace) -> int:
                 generations=getattr(opts, "generations", None),
                 spans=tuple(getattr(opts, "gate_span", None)
                             or ("workload", "check:*")),
+                parole_after=getattr(opts, "parole_after", None),
+                mutate=mutate,
                 coordinator_url=url,
                 min_workers=getattr(opts, "workers_min", 0),
                 max_workers=getattr(opts, "workers_max", 0),
@@ -1187,6 +1205,28 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                           "autopilot: target version — changing it on "
                           "a live loop rolls the pool one worker at "
                           "a time")
+    pfl.add_argument("--parole-after", dest="parole_after",
+                     type=int, default=None, metavar="N",
+                     help="autopilot: re-admit a quarantined cell "
+                          "after N closed generations with no "
+                          "regression since its quarantine — a "
+                          "re-offender is re-quarantined "
+                          "(docs/AUTOPILOT.md; default: quarantine "
+                          "is forever)")
+    pfl.add_argument("--rotate", dest="rotate", type=int, default=0,
+                     metavar="N",
+                     help="autopilot: rotate scenarios, not just "
+                          "seeds — each generation keeps the pivot "
+                          "cells and fills N slots by walking the "
+                          "template's remaining cells in order "
+                          "(docs/AUTOPILOT.md; 0 = run the full "
+                          "template every generation)")
+    pfl.add_argument("--pivot", dest="pivot", action="append",
+                     metavar="LABEL",
+                     help="autopilot --rotate: cell label/workload "
+                          "kept in EVERY generation so its span "
+                          "stays gate-comparable (repeatable; "
+                          "default: the template's first cell)")
     pfl.add_argument("--staging-retention", dest="staging_retention",
                      type=float, default=None,
                      help="serve: expire abandoned artifact-upload "

@@ -23,11 +23,12 @@ including rolling version upgrades — from its own crash-replayable
 journal.
 """
 
-from .autopilot import Autopilot, AutopilotJournal, autopilot_path
+from .autopilot import (Autopilot, AutopilotJournal, autopilot_path,
+                        scenario_rotation)
 from .coordinator import FleetCoordinator
 from .queue import WorkQueue, fleet_path, record_digest
 from .worker import FleetWorker
 
 __all__ = ["Autopilot", "AutopilotJournal", "FleetCoordinator",
            "FleetWorker", "WorkQueue", "autopilot_path",
-           "fleet_path", "record_digest"]
+           "fleet_path", "record_digest", "scenario_rotation"]

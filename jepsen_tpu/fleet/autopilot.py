@@ -69,7 +69,8 @@ from .coordinator import ALIVE_LEASES, FleetCoordinator
 
 logger = logging.getLogger("jepsen.fleet.autopilot")
 
-__all__ = ["Autopilot", "AutopilotJournal", "autopilot_path", "GATE_RC"]
+__all__ = ["Autopilot", "AutopilotJournal", "autopilot_path", "GATE_RC",
+           "scenario_rotation"]
 
 #: gate status -> the ``cli obs gate`` exit-code convention the loop
 #: reacts to: 1 quarantines, 2 degrades gracefully (never quarantine
@@ -85,6 +86,56 @@ def autopilot_path(name: str, base: Optional[str] = None) -> str:
                         store.sanitize(name) + ".autopilot.jsonl")
 
 
+def _cell_label(cell: Any) -> str:
+    """The name a rotation pivot matches against: a cell's explicit
+    ``label`` if it has one, else its workload ``name``."""
+    if isinstance(cell, dict):
+        return str(cell.get("label") or cell.get("name") or "")
+    return str(cell)
+
+
+def scenario_rotation(*, pivot: Tuple[str, ...] = (),
+                      slots: int = 1) -> Callable[[int, dict], dict]:
+    """A deterministic ``Autopilot(mutate=...)`` that rotates
+    SCENARIOS, not just seeds (ROADMAP 5c).
+
+    Each generation keeps the **pivot** cells — the workloads the
+    cross-generation gate tracks continuously, matched by cell label
+    or workload name (the template's first cell when ``pivot`` is
+    empty) — and fills ``slots`` extra slots by walking the remaining
+    template cells in order, ``slots`` at a time, wrapping around.
+    Over ``ceil(len(rest) / slots)`` generations every scenario in the
+    template has run, while the pivot's span stays gate-comparable
+    generation over generation.
+
+    Pure in ``(i, template)`` — no ambient state — which is what the
+    journal's replay-to-identical-digest discipline requires: resume
+    after kill -9 re-derives byte-identical generation specs.
+    Quarantine keys stay meaningful because rotation re-admits a cell
+    with the SAME key every time its slot comes around."""
+    pivots = tuple(str(p) for p in pivot)
+    n_slots = max(1, int(slots))
+
+    def mutate(i: int, sp: dict) -> dict:
+        cells = list(sp.get("workloads") or [])
+        if len(cells) <= 1:
+            return sp
+        if pivots:
+            keep = [c for c in cells if _cell_label(c) in pivots]
+            rest = [c for c in cells if _cell_label(c) not in pivots]
+        else:
+            keep, rest = [cells[0]], cells[1:]
+        if not rest:
+            return sp
+        k = (i * n_slots) % len(rest)
+        take = [rest[(k + j) % len(rest)]
+                for j in range(min(n_slots, len(rest)))]
+        sp["workloads"] = keep + take
+        return sp
+
+    return mutate
+
+
 class AutopilotJournal:
     """The autopilot's durable brain: an append-only fsync'd jsonl
     ledger with the exact `queue.WorkQueue` discipline — in-memory
@@ -95,9 +146,12 @@ class AutopilotJournal:
 
     Events: ``gen-open`` (a generation's durable intent — written
     BEFORE its cells are enqueued), ``gen-close`` (the gate verdicts),
-    ``quarantine``, ``shrink``, ``scale``.  Scale events are an audit
-    trail, not state: like the queue's requeue/duplicate counters they
-    are derived telemetry and excluded from the digest."""
+    ``quarantine``, ``parole`` (re-admission after clean neighbor
+    generations — ROADMAP 5d; a re-quarantine of a paroled key
+    archives the prior stint under ``history``), ``shrink``,
+    ``scale``.  Scale events are an audit trail, not state: like the
+    queue's requeue/duplicate counters they are derived telemetry and
+    excluded from the digest."""
 
     def __init__(self, path: str):
         self.path = path
@@ -155,9 +209,26 @@ class AutopilotJournal:
             g["closed"] = True
             g["verdicts"] = ev.get("verdicts") or []
         elif kind == "quarantine":
-            self.quarantined.setdefault(str(ev.get("key")), {
-                "gen": ev.get("gen"), "span": ev.get("span"),
-                "rel-delta": ev.get("rel-delta"), "ts": ev.get("ts")})
+            key = str(ev.get("key"))
+            cur = self.quarantined.get(key)
+            fresh = {"gen": ev.get("gen"), "span": ev.get("span"),
+                     "rel-delta": ev.get("rel-delta"),
+                     "ts": ev.get("ts")}
+            if cur is None:
+                self.quarantined[key] = fresh
+            elif "paroled-gen" in cur:
+                # a paroled key regressed again: archive the prior
+                # stint so old-generation replays still exclude it
+                hist = list(cur.get("history") or [])
+                hist.append({"gen": cur.get("gen"),
+                             "paroled-gen": cur.get("paroled-gen")})
+                fresh["history"] = hist
+                self.quarantined[key] = fresh
+            # an active quarantine absorbs duplicate events
+        elif kind == "parole":
+            v = self.quarantined.get(str(ev.get("key")))
+            if v is not None and "paroled-gen" not in v:
+                v["paroled-gen"] = ev.get("gen")
         elif kind == "shrink":
             self.shrinks[str(ev.get("key"))] = {
                 "gen": ev.get("gen"), "outcome": ev.get("outcome")}
@@ -204,6 +275,12 @@ class AutopilotJournal:
         self._event({"ev": "quarantine", "key": key, "gen": gen,
                      "span": span, "rel-delta": rel_delta})
 
+    def parole(self, key: str, *, gen: str) -> None:
+        """Re-admit a quarantined key: durable as of generation
+        `gen`'s close — the key re-enters the plan from the NEXT
+        generation on."""
+        self._event({"ev": "parole", "key": key, "gen": gen})
+
     def shrink(self, key: str, *, gen: str,
                outcome: Dict[str, Any]) -> None:
         self._event({"ev": "shrink", "key": key, "gen": gen,
@@ -229,7 +306,10 @@ class AutopilotJournal:
                           self.gens[l].get("verdicts"))
                          for l in self.order],
                 "quarantined": sorted(
-                    (k, v.get("gen"), v.get("span"))
+                    (k, v.get("gen"), v.get("span"),
+                     v.get("paroled-gen"),
+                     json.dumps(v.get("history") or [],
+                                sort_keys=True))
                     for k, v in self.quarantined.items()),
                 "shrinks": sorted(
                     (k, json.dumps(v, sort_keys=True, default=str))
@@ -259,6 +339,7 @@ class Autopilot:
                  spans: Tuple[str, ...] = ("workload", "check:*"),
                  alpha: float = 0.05, threshold: float = 0.25,
                  min_runs: int = 3,
+                 parole_after: Optional[int] = None,
                  mutate: Optional[Callable[[int, dict], dict]] = None,
                  on_generation: Optional[
                      Callable[["Autopilot", dict], None]] = None,
@@ -285,6 +366,8 @@ class Autopilot:
         self.spans = tuple(spans)
         self.alpha, self.threshold = float(alpha), float(threshold)
         self.min_runs = int(min_runs)
+        self.parole_after = int(parole_after) if parole_after \
+            else None
         self.mutate = mutate
         self.on_generation = on_generation
         self.coordinator_url = coordinator_url
@@ -356,14 +439,28 @@ class Autopilot:
         sp.setdefault("opts", {})["autopilot-gen"] = self._label(i)
         return sp
 
+    def _quarantined_at(self, v: Dict[str, Any], i: int) -> bool:
+        """Was this key out of the plan at generation i?  A key is
+        excluded during every quarantine STINT — from the generation
+        after its quarantine through its parole generation inclusive
+        (re-admission starts the generation after the parole), with
+        prior stints preserved under ``history`` so old-generation
+        replays stay byte-identical after a re-quarantine."""
+        for stint in list(v.get("history") or []) + [v]:
+            q = self._gen_index(stint.get("gen"))
+            p = stint.get("paroled-gen")
+            if q < i and (p is None or self._gen_index(p) >= i):
+                return True
+        return False
+
     def _plan(self, i: int) -> list:
         """Generation i's cells, minus keys quarantined by an EARLIER
-        generation's gate — a replay of an old generation applies the
-        quarantine state as of that generation, so resume re-admits
-        byte-identical cell sets."""
+        generation's gate and not yet paroled — a replay of an old
+        generation applies the quarantine/parole state as of that
+        generation, so resume re-admits byte-identical cell sets."""
         specs = plan_mod.expand(plan_mod.load_spec(self._gen_spec(i)))
         quarantined = {k for k, v in self.journal.quarantined.items()
-                       if self._gen_index(v.get("gen")) < i}
+                       if self._quarantined_at(v, i)}
         return [rs for rs in specs if rs.key not in quarantined]
 
     def _next_index(self) -> int:
@@ -441,8 +538,11 @@ class Autopilot:
             if v.get("status") != "regression":
                 continue
             key = v.get("key")
-            if not key or key in self.journal.quarantined:
-                continue
+            cur = self.journal.quarantined.get(str(key)) \
+                if key else None
+            if not key or (cur is not None
+                           and "paroled-gen" not in cur):
+                continue  # active quarantine — nothing new to do
             self.journal.quarantine(
                 str(key), gen=label, span=v.get("span"),
                 rel_delta=v.get("key-rel-delta"))
@@ -455,8 +555,40 @@ class Autopilot:
                 outcome=out if ok else {"error": out})
         if quarantined:
             summary["quarantined"] = quarantined
+        paroled = self._parole_tick(label)
+        if paroled:
+            summary["paroled"] = paroled
         self._update_gauges()
         return summary
+
+    def _parole_tick(self, label: str) -> List[str]:
+        """Quarantine parole (ROADMAP 5d): once ``parole_after``
+        closed generations SINCE a key's quarantine came back with no
+        regression anywhere — its neighbors ran clean without it —
+        the key is re-admitted starting with the next generation.  A
+        paroled key that regresses again is re-quarantined (prior
+        stint archived), so parole is a retrial, not an acquittal."""
+        if not self.parole_after:
+            return []
+        clean = []
+        for l in self.journal.closed_labels():
+            vs = self.journal.gens[l].get("verdicts") or []
+            if all(v.get("rc") != 1 for v in vs):
+                clean.append(self._gen_index(l))
+        out = []
+        for key, v in sorted(self.journal.quarantined.items()):
+            if "paroled-gen" in v:
+                continue
+            q = self._gen_index(v.get("gen"))
+            n = sum(1 for ci in clean if ci > q)
+            if n >= self.parole_after:
+                self.journal.parole(key, gen=label)
+                out.append(key)
+                logger.info(
+                    "autopilot %s: paroled %s after %d clean "
+                    "generation(s) (quarantined at %s)",
+                    self.name, key, n, v.get("gen"))
+        return out
 
     def run(self) -> Dict[str, Any]:
         """The unattended loop: generations until ``generations`` (or
@@ -809,8 +941,12 @@ class Autopilot:
             from jepsen_tpu import telemetry
 
             reg = telemetry.registry()
-            reg.gauge("fleet-quarantined-cells").set(
-                len(self.journal.quarantined))
+            active = [k for k, v in
+                      self.journal.quarantined.items()
+                      if "paroled-gen" not in v]
+            reg.gauge("fleet-quarantined-cells").set(len(active))
+            reg.gauge("fleet-paroled-cells").set(
+                len(self.journal.quarantined) - len(active))
             reg.gauge("fleet-autopilot-generations").set(
                 len(self.journal.closed_labels()))
         except Exception:  # noqa: BLE001 — observability only

@@ -69,6 +69,7 @@ class HistoryIR(History):
         self._padded: Dict[str, Any] = {}
         self._rw_inf = None
         self._bank: Dict[Any, Any] = {}
+        self._queue: Dict[str, Any] = {}
         self._lin_ops: Optional[List[Any]] = None
         self._packed_source: Optional[PackedTxns] = None
         if isinstance(source, PackedTxns):
@@ -146,6 +147,20 @@ class HistoryIR(History):
             pb = self._bank[key] = _booked(
                 lambda: pack_bank(self, accounts))
         return pb
+
+    def queue(self, kind: str = "kafka"):
+        """The queue-family packing: ``"kafka"`` -> PackedKafka
+        (send/poll/epoch columns + derived orders), ``"fifo"`` ->
+        PackedFifo (enqueue/dequeue counting columns + the
+        per-consumer dequeue order)."""
+        pq = self._queue.get(kind)
+        if pq is None:
+            from jepsen_tpu.checkers.queue import packed as q_packed
+
+            build = (q_packed.pack_kafka if kind == "kafka"
+                     else q_packed.pack_fifo)
+            pq = self._queue[kind] = _booked(lambda: build(self))
+        return pq
 
     def lin_ops(self) -> List[Any]:
         """The knossos linearizability entry table (LinOp rows)."""

@@ -42,6 +42,7 @@ __all__ = ["resolve_checker", "is_device_checker", "host_equivalent",
 DEVICE_CHECKER_NAMES = frozenset({
     "list-append", "rw-register", "Linearizable", "QueueChecker",
     "bank", "long-fork", "write-skew", "session",
+    "kafka", "total-queue",
 })
 
 #: workload-kind (stamped into test maps by the workload bundles) ->
@@ -52,13 +53,17 @@ _KIND_CHECKERS = {
     "long-fork": ("long_fork", "LongForkChecker"),
     "write-skew": ("write_skew", "WriteSkewChecker"),
     "session": ("session", "SessionChecker"),
+    "kafka": ("..checkers.queue.kafka", "PackedKafkaChecker"),
+    "queue": ("..checkers.queue.fifo", "PackedQueueChecker"),
 }
 
 
 def _wl_checker(mod: str, cls: str):
     import importlib
 
-    m = importlib.import_module(f"jepsen_tpu.workloads.{mod}")
+    name = (f"jepsen_tpu.{mod[2:]}" if mod.startswith("..")
+            else f"jepsen_tpu.workloads.{mod}")
+    m = importlib.import_module(name)
     return getattr(m, cls)()
 
 
@@ -92,6 +97,10 @@ def resolve_checker(test: Optional[dict], history: History
             return _wl_checker(*_KIND_CHECKERS["bank"])
         if op.f == "read" and isinstance(op.value, dict):
             return _wl_checker(*_KIND_CHECKERS["bank"])
+        if op.f in ("send", "poll", "subscribe", "assign"):
+            return _wl_checker(*_KIND_CHECKERS["kafka"])
+        if op.f in ("enqueue", "dequeue"):
+            return _wl_checker(*_KIND_CHECKERS["queue"])
         if op.f == "txn" and isinstance(op.value, (list, tuple)):
             for m in op.value:
                 if not (isinstance(m, (list, tuple)) and m):
@@ -104,6 +113,8 @@ def resolve_checker(test: Optional[dict], history: History
                     from jepsen_tpu.workloads.wr import WrChecker
 
                     return WrChecker()
+                if m[0] in ("send", "poll"):
+                    return _wl_checker(*_KIND_CHECKERS["kafka"])
         if op.f in ("write", "cas"):
             return checker_api.Linearizable()
         if op.f == "read":
@@ -194,6 +205,28 @@ def host_equivalent(chk: checker_api.Checker
                                   **kw)
 
         return checker_api.FnChecker(sess_fn, "session-host")
+    if _name(chk) == "kafka":
+        # the packed kafka checker's use_device=False path is the host
+        # oracle twin (same packing, numpy reductions) — exact, minus
+        # the per-candidate device dispatch
+        from jepsen_tpu.checkers.queue import kafka as q_kafka
+
+        def kafka_fn(test, history, opts):
+            return q_kafka.check(history, test, use_device=False,
+                                 deadline=(opts or {}).get("deadline"))
+
+        return checker_api.FnChecker(kafka_fn, "kafka-host")
+    if _name(chk) == "total-queue":
+        from jepsen_tpu.checkers.queue import fifo as q_fifo
+
+        want_fifo = bool(getattr(chk, "fifo", False))
+
+        def tq_fn(test, history, opts):
+            return q_fifo.check(history, test, fifo=want_fifo,
+                                use_device=False,
+                                deadline=(opts or {}).get("deadline"))
+
+        return checker_api.FnChecker(tq_fn, "total-queue-host")
     return None
 
 

@@ -89,10 +89,12 @@ def load_witness(run_dir: str) -> Optional[Dict[str, Any]]:
         with open(paths["meta"]) as f:
             doc = json.load(f)
         ops = []
-        with open(paths["ops"]) as f:
+        with open(paths["ops"], "rb") as f:
             for line in f:
                 if line.strip():
-                    ops.append(Op.from_dict(json.loads(line)))
+                    # the codec, not json.loads: save_witness writes
+                    # codec-tagged dicts (tuples, int-keyed poll maps)
+                    ops.append(Op.from_dict(codec.loads(line)))
     except (OSError, ValueError, KeyError):
         return None
     doc["history"] = History(ops, reindex=False)

@@ -105,10 +105,42 @@ def _verdict_cell(v: Any, error: Any = None, degraded: Any = None,
             f"{_verdict_badges(v, error, degraded, deadline)}</td>")
 
 
-def _model_anomaly_html(e: Any) -> str:
-    """Model-specific witness evidence (the invariants family): bank
-    bad-reads, long-fork/write-skew pairs, and session violations get
-    readable renderings; anything unrecognized falls back to JSON."""
+def _model_anomaly_html(e: Any, name: str = "") -> str:
+    """Model-specific witness evidence (the invariants family + the
+    queue family): bank bad-reads, long-fork/write-skew pairs, session
+    violations, and kafka lost/duplicate/stale messages get readable
+    renderings; anything unrecognized falls back to JSON."""
+    if name == "lost-write" and isinstance(e, (list, tuple)) \
+            and len(e) == 3:
+        k, off, v = e
+        return (f"<li>message <code>{html.escape(json.dumps(v))}</code> "
+                f"on key <code>{html.escape(json.dumps(k))}</code>, acked "
+                f"at offset <b>{off}</b>, was never polled although later "
+                f"offsets of that key were — a lost write</li>")
+    if name == "duplicate" and isinstance(e, (list, tuple)) \
+            and len(e) == 3:
+        k, v, offs = e
+        return (f"<li>message <code>{html.escape(json.dumps(v))}</code> "
+                f"on key <code>{html.escape(json.dumps(k))}</code> was "
+                f"delivered at {len(offs)} distinct offsets "
+                f"<code>{html.escape(json.dumps(list(offs)))}</code> — a "
+                f"duplicate delivery</li>")
+    if name == "inconsistent-offsets" and isinstance(e, (list, tuple)) \
+            and len(e) == 3:
+        k, off, vals = e
+        return (f"<li>offset <b>{off}</b> of key "
+                f"<code>{html.escape(json.dumps(k))}</code> was observed "
+                f"holding {len(vals)} different values "
+                f"<code>{html.escape(json.dumps(list(vals)))}</code></li>")
+    if name == "stale-consumer-group" and isinstance(e, dict) \
+            and "generation" in e:
+        return (f"<li>consumer group generation <b>{e['generation']}</b> "
+                f"re-polled key "
+                f"<code>{html.escape(json.dumps(e.get('key')))}</code> from "
+                f"offset <b>{e.get('start')}</b> {e.get('polls')} times "
+                f"while the log moved past its window ({e.get('behind')} "
+                f"poll(s) behind the key's head) — a stale consumer "
+                f"group</li>")
     if not isinstance(e, dict):
         return f"<pre>{html.escape(json.dumps(e, indent=1))}</pre>"
     if "why" in e:  # long-fork / write-skew carry their own sentence
@@ -460,7 +492,7 @@ td, th {{ border: 1px solid #bbb; padding: 3px 8px; }}
             for e in entries if isinstance(entries, list) else []:
                 cyc = e.get("cycle") if isinstance(e, dict) else None
                 if not cyc:
-                    frag = _model_anomaly_html(e)
+                    frag = _model_anomaly_html(e, name)
                     if frag.startswith("<li>"):
                         items.append(frag)
                     else:

@@ -56,6 +56,23 @@ from ..checkers import api as checker_api
 from ..client import Client
 from ..history.ops import INFO, INVOKE, OK
 
+#: minimum same-start subscribe-mode batches before a frozen committed
+#: offset counts as stale (pinned equal to
+#: `checkers.queue.kafka.STALE_MIN_POLLS` by tests so the scan twin and
+#: the packed passes can't drift)
+STALE_MIN_POLLS = 3
+
+#: seeded adversarial-client FaultPlan sites (strictly opt-in: a plan
+#: must name the site for the client to even consult it).  Caller index
+#: is ``member * _FAULT_STRIDE + op-ordinal``, the interpreter idiom.
+SITE_DUP = "client.dup-send"
+SITE_REORDER = "client.reorder-send"
+SITE_ZOMBIE = "client.zombie-resend"
+SITE_TORN = "client.torn-send"
+ADVERSARY_SITES = {SITE_DUP: "dup-send", SITE_REORDER: "reorder-send",
+                   SITE_ZOMBIE: "zombie-resend", SITE_TORN: "torn-send"}
+_FAULT_STRIDE = 1_000_003
+
 
 # ---------------------------------------------------------------------------
 # Generator
@@ -132,6 +149,11 @@ def final_gen():
 # In-memory kafka-ish broker + client (the sim-cluster db)
 
 
+#: broker-side tombstone for torn writes: the offset exists, the
+#: payload is gone (never returned by read_from)
+_TOMB = object()
+
+
 class KafkaStore:
     """Partitioned append-only logs + one consumer group with round-robin
     rebalancing and per-group committed offsets."""
@@ -144,6 +166,10 @@ class KafkaStore:
         self.committed: Dict[Any, int] = {}       # key -> committed offset
         self.generation = 0                        # bumped per rebalance
         self._member_ids = itertools.count()
+        # fault knob: auto-commits stop advancing — subscribe-mode
+        # consumers re-read the same window while the log moves on (the
+        # stale-consumer-group shape)
+        self.freeze_commits = False
 
     def new_member(self) -> int:
         return next(self._member_ids)
@@ -153,9 +179,19 @@ class KafkaStore:
         log.append(v)
         return len(log) - 1
 
+    def append_lost(self, k) -> int:
+        """A torn write: the broker allocates (and acks) the offset but
+        the payload never lands — consumers skip the hole, so the acked
+        offset sits below later polled offsets without ever being
+        polled: the checker's **lost-write** shape."""
+        log = self.logs.setdefault(k, [])
+        log.append(_TOMB)
+        return len(log) - 1
+
     def read_from(self, k, pos: int, limit: int) -> List[Tuple[int, Any]]:
         log = self.logs.get(k, [])
-        return [(i, log[i]) for i in range(pos, min(len(log), pos + limit))]
+        return [(i, log[i]) for i in range(pos, min(len(log), pos + limit))
+                if log[i] is not _TOMB]
 
     # -- consumer group (caller holds the lock) --
 
@@ -193,40 +229,151 @@ class KafkaClient(Client):
 
     Fault knobs for checker tests: `lose_tail_p` — on send, the broker
     "acks" but drops the message (a lost write); `dup_p` — the append is
-    applied twice (a duplicate)."""
+    applied twice (a duplicate).
+
+    Adversarial-client shapes (ISSUE 19) — the behaviors real message
+    systems break under, each producing an anomaly the matching packed
+    checker pass attributes.  Triggered EITHER by the probability knobs
+    (seeded corpora) or by a seeded `FaultPlan` naming the matching
+    ``client.*`` site (strictly opt-in, the interpreter idiom):
+
+    - `dup_send_p` / ``client.dup-send`` — the duplicate-request retry:
+      every send mop of the op is applied twice (**duplicate**);
+    - `reorder_p` / ``client.reorder-send`` — the broker applies one
+      op's sends in reverse arrival order; completions still report
+      each mop's true landing offset (**int-send-skip** /
+      **nonmonotonic-send**);
+    - `zombie_p` / ``client.zombie-resend`` — a zombie retry re-appends
+      the client's last ACKED message after the fact, invisibly to its
+      own history (**duplicate** at a later offset);
+    - `torn_p` / ``client.torn-send`` — a multi-key send is torn: only
+      the first key's sends reach the log, the rest are acked with
+      fabricated offsets (**lost-write** / **inconsistent-offsets**).
+    """
 
     def __init__(self, store: Optional[KafkaStore] = None, *,
                  poll_limit: int = 8, lose_tail_p: float = 0.0,
-                 dup_p: float = 0.0, rng: Optional[random.Random] = None):
+                 dup_p: float = 0.0, dup_send_p: float = 0.0,
+                 reorder_p: float = 0.0, zombie_p: float = 0.0,
+                 torn_p: float = 0.0,
+                 rng: Optional[random.Random] = None):
         self.store = store or KafkaStore()
         self.poll_limit = poll_limit
         self.lose_tail_p = lose_tail_p
         self.dup_p = dup_p
+        self.dup_send_p = dup_send_p
+        self.reorder_p = reorder_p
+        self.zombie_p = zombie_p
+        self.torn_p = torn_p
         self.rng = rng or random.Random(0)
         self.member = -1
         self.mode = "assign"
         self.assigned: List[Any] = []
         self.pos: Dict[Any, int] = {}
+        self._acked: Optional[Tuple[Any, Any]] = None
+        self._op_n = 0
 
     def open(self, test, node):
         c = KafkaClient(self.store, poll_limit=self.poll_limit,
                         lose_tail_p=self.lose_tail_p, dup_p=self.dup_p,
-                        rng=self.rng)
+                        dup_send_p=self.dup_send_p,
+                        reorder_p=self.reorder_p, zombie_p=self.zombie_p,
+                        torn_p=self.torn_p, rng=self.rng)
         c.member = self.store.new_member()
         return c
 
+    # -- adversarial shapes --
+
+    def _inj(self, shape: str) -> None:
+        from .. import telemetry
+
+        telemetry.registry().counter(
+            "queue-adversarial-injections", shape=shape).inc()
+
+    def _adversary(self, test) -> set:
+        """Which adversarial shapes apply to THIS op: seeded FaultPlan
+        sites (only consulted when the plan names them) plus the
+        probability knobs.  Caller-indexed so fuzz accounting stays
+        deterministic per (member, op-ordinal)."""
+        self._op_n += 1
+        shapes = set()
+        plan = None
+        if isinstance(test, dict):
+            from ..resilience import faults as faults_mod
+
+            plan = faults_mod.plan_for(test)
+        if plan is not None:
+            idx = self.member * _FAULT_STRIDE + self._op_n
+            for site, shape in ADVERSARY_SITES.items():
+                if not plan.targets_site(site):
+                    continue
+                try:
+                    plan.fire_at(site, idx)
+                except faults_mod.FaultInjected:
+                    shapes.add(shape)
+        for p, shape in ((self.dup_send_p, "dup-send"),
+                         (self.reorder_p, "reorder-send"),
+                         (self.zombie_p, "zombie-resend"),
+                         (self.torn_p, "torn-send")):
+            if p and self.rng.random() < p:
+                shapes.add(shape)
+        return shapes
+
     # -- mop handlers (store lock held) --
 
-    def _do_send(self, mop):
+    def _do_send(self, mop, dup: bool = False):
         s = self.store
         _kind, k, v = mop
         if self.lose_tail_p and self.rng.random() < self.lose_tail_p:
             # broker acks but drops: offset it claims is bogus
             return ("send", k, (len(s.logs.get(k, [])), v))
         off = s.append(k, v)
-        if self.dup_p and self.rng.random() < self.dup_p:
+        self._acked = (k, v)
+        if dup or (self.dup_p and self.rng.random() < self.dup_p):
             s.append(k, v)  # duplicated append
         return ("send", k, (off, v))
+
+    def _do_mops(self, mops, shapes: set):
+        """Apply an op's send/poll mops with the adversarial shapes."""
+        s = self.store
+        mops = list(mops)
+        send_idx = [n for n, m in enumerate(mops) if m[0] == "send"]
+        apply_order = list(range(len(mops)))
+        if "reorder-send" in shapes and len(send_idx) >= 2:
+            # reverse arrival order for this op's sends; each mop slot
+            # still reports the offset its value actually landed at
+            rev = dict(zip(send_idx, reversed(send_idx)))
+            apply_order = [rev.get(n, n) for n in apply_order]
+            self._inj("reorder-send")
+        torn_keys: set = set()
+        if "torn-send" in shapes:
+            keys: List[Any] = []
+            for n in send_idx:
+                if mops[n][1] not in keys:
+                    keys.append(mops[n][1])
+            if len(keys) >= 2:
+                torn_keys = set(keys[1:])
+                self._inj("torn-send")
+        dup = "dup-send" in shapes
+        if dup and send_idx:
+            self._inj("dup-send")
+        out: List[Any] = [None] * len(mops)
+        for n in apply_order:
+            m = mops[n]
+            if m[0] != "send":
+                out[n] = self._do_poll()
+            elif m[1] in torn_keys:
+                # torn: the broker allocates and acks the offset but
+                # the payload is lost
+                out[n] = ("send", m[1], (s.append_lost(m[1]), m[2]))
+            else:
+                out[n] = self._do_send(m, dup=dup)
+        if "zombie-resend" in shapes and self._acked is not None:
+            # a zombie retry of the last acked send, invisible to this
+            # client's own completions
+            s.append(*self._acked)
+            self._inj("zombie-resend")
+        return out
 
     def _do_poll(self):
         s = self.store
@@ -242,7 +389,7 @@ class KafkaClient(Client):
             if msgs:
                 nxt = msgs[-1][0] + 1
                 self.pos[k] = nxt
-                if self.mode == "subscribe":
+                if self.mode == "subscribe" and not s.freeze_commits:
                     s.committed[k] = nxt      # auto-commit
             batch[k] = msgs
         return ("poll", batch)
@@ -252,7 +399,7 @@ class KafkaClient(Client):
         s = self.store
         with s.lock:
             if f == "send":
-                out = [self._do_send(m) for m in op["value"]]
+                out = self._do_mops(op["value"], self._adversary(test))
                 return dict(op, type="ok", value=out)
             if f == "poll":
                 done = dict(op, type="ok", value=[self._do_poll()])
@@ -263,8 +410,7 @@ class KafkaClient(Client):
                     done["rebalance"] = s.generation
                 return done
             if f == "txn":
-                out = [self._do_send(m) if m[0] == "send"
-                       else self._do_poll() for m in op["value"]]
+                out = self._do_mops(op["value"], self._adversary(test))
                 done = dict(op, type="ok", value=out)
                 if self.mode == "subscribe":
                     done["rebalance"] = s.generation
@@ -475,6 +621,35 @@ class KafkaChecker(checker_api.Checker):
                         precommitted.append({"key": k, "value": v,
                                              "poll-op": i, "send-op": j})
 
+        # ---- stale consumer group ----------------------------------------
+        # a frozen committed offset: >= STALE_MIN_POLLS subscribe-mode
+        # batches of one (key, rebalance-generation) re-reading the SAME
+        # start offset while the key's log has moved past them.  1-2
+        # same-start re-reads happen benignly around rebalances; three
+        # with the log ahead mean the group's commit stopped advancing.
+        key_max: Dict[Any, int] = {}
+        for (k, off, _v, _i, _p) in sends:
+            key_max[k] = max(key_max.get(k, -1), off)
+        for k, offs in polled_offsets.items():
+            key_max[k] = max(key_max.get(k, -1), max(offs))
+        stale_groups: Dict[Tuple[Any, int, int], List[int]] = {}
+        for (k, msgs, _p, _i, _s, gen) in polls:
+            if not msgs or gen is None:
+                continue
+            stale_groups.setdefault(
+                (k, gen, msgs[0][0]), []).append(msgs[-1][0])
+        stale = []
+        for (k, gen, start), lasts in stale_groups.items():
+            if len(lasts) < STALE_MIN_POLLS:
+                continue
+            behind = sum(1 for la in lasts if key_max.get(k, -1) > la)
+            if behind:
+                stale.append({"key": k, "generation": gen,
+                              "start": start, "polls": len(lasts),
+                              "behind": behind})
+        stale.sort(key=lambda e: (repr(e["key"]), e["generation"],
+                                  e["start"]))
+
         anomalies = {
             "lost-write": lost[:16],
             "duplicate": duplicates[:16],
@@ -486,6 +661,7 @@ class KafkaChecker(checker_api.Checker):
             "nonmonotonic-send": nonmono_send[:16],
             "int-send-skip": int_send_skip[:16],
             "precommitted-read": precommitted[:16],
+            "stale-consumer-group": stale[:16],
         }
         found = {k: v for k, v in anomalies.items() if v}
         out = {
@@ -503,11 +679,14 @@ class KafkaChecker(checker_api.Checker):
 def workload(*, key_count: int = 4, crash_frac: float = 0.0,
              subscribe_frac: float = 0.0, txn_frac: float = 0.0,
              rng: Optional[random.Random] = None) -> dict:
+    from ..checkers.queue.kafka import PackedKafkaChecker
+
     return {
         "generator": gen(key_count=key_count, crash_frac=crash_frac,
                          subscribe_frac=subscribe_frac, txn_frac=txn_frac,
                          rng=rng),
         "final-generator": final_gen(),
-        "checker": KafkaChecker(),
+        "checker": PackedKafkaChecker(),
         "kafka-key-count": key_count,
+        "workload-kind": "kafka",
     }

@@ -96,13 +96,27 @@ class MemClient(Client):
     def __init__(self, store: Optional[MemStore] = None, *,
                  latency: float = 0.0, crash_p: float = 0.0,
                  fail_p: float = 0.0, rng: Optional[random.Random] = None,
-                 txn_kind: str = "list-append"):
+                 txn_kind: str = "list-append",
+                 dup_enqueue_p: float = 0.0, lose_enqueue_p: float = 0.0,
+                 reorder_dequeue_p: float = 0.0):
         self.store = store or MemStore()
         self.latency = latency
         self.crash_p = crash_p
         self.fail_p = fail_p
         self.rng = rng or random.Random(0)
         self.txn_kind = txn_kind  # "list-append" | "rw-register"
+        # queue adversarial shapes (ISSUE 19): duplicate-request retry
+        # (applied twice, acked once -> queue-phantom), ack-without-apply
+        # (-> queue-lost), tail-pop reorder (-> queue-fifo-violation)
+        self.dup_enqueue_p = dup_enqueue_p
+        self.lose_enqueue_p = lose_enqueue_p
+        self.reorder_dequeue_p = reorder_dequeue_p
+
+    def _inj(self, shape: str) -> None:
+        from .. import telemetry
+
+        telemetry.registry().counter(
+            "queue-adversarial-injections", shape=shape).inc()
 
     def open(self, test, node):
         # connectionless — all "nodes" share the store — but each
@@ -145,11 +159,24 @@ class MemClient(Client):
                 s.set_elems.add(v)
                 out = dict(op, type="ok")
             elif f == "enqueue":
-                s.queue.append(v)
+                if self.lose_enqueue_p and \
+                        self.rng.random() < self.lose_enqueue_p:
+                    self._inj("lose-enqueue")   # acked, never applied
+                else:
+                    s.queue.append(v)
+                    if self.dup_enqueue_p and \
+                            self.rng.random() < self.dup_enqueue_p:
+                        s.queue.append(v)       # retry applied twice
+                        self._inj("dup-enqueue")
                 out = dict(op, type="ok")
             elif f == "dequeue":
                 if s.queue:
-                    out = dict(op, type="ok", value=s.queue.pop(0))
+                    i = 0
+                    if len(s.queue) >= 2 and self.reorder_dequeue_p and \
+                            self.rng.random() < self.reorder_dequeue_p:
+                        i = -1                  # tail pop: FIFO broken
+                        self._inj("reorder-dequeue")
+                    out = dict(op, type="ok", value=s.queue.pop(i))
                 else:
                     out = dict(op, type="fail", error="empty")
             elif f == "transfer":

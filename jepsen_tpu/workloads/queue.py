@@ -73,11 +73,18 @@ def drain(n: int = 10_000) -> Any:
     return g.clients(g.each_thread(g.limit(n, _Drain())))
 
 
-def workload(*, total: bool = True, drain_ops: int = 10_000,
+def workload(*, total: bool = True, fifo: bool = False,
+             drain_ops: int = 10_000,
              rng: Optional[random.Random] = None) -> dict:
+    if total:
+        from ..checkers.queue.fifo import PackedQueueChecker
+
+        checker: Any = PackedQueueChecker(fifo=fifo)
+    else:
+        checker = checker_api.QueueChecker()
     return {
         "generator": gen(rng=rng),
         "final-generator": drain(drain_ops),
-        "checker": (checker_api.TotalQueueChecker() if total
-                    else checker_api.QueueChecker()),
+        "checker": checker,
+        "workload-kind": "queue",
     }

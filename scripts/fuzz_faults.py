@@ -426,6 +426,148 @@ def compilecache_chaos_round(seed: int, p: float = 0.5) -> dict:
     return row
 
 
+def queue_chaos_round(seed: int, p: float = 0.3,
+                      deadline_s: float = 60.0) -> dict:
+    """Chaos on the queue family's two seams at once (ISSUE 19).
+
+    Client seam: a seeded FaultPlan naming the adversarial ``client.*``
+    sites drives a full kafka run through `core.run` — the broker
+    applies duplicate-request, reorder, zombie-resend and torn-send
+    damage, and the run's verdict must ATTRIBUTE what was applied
+    (every applied duplicate-shape injection ends in a ``duplicate``
+    anomaly; the run never crashes or hangs).
+
+    Checker seam: the SAME chaos history is then re-checked with a
+    plan naming ``queue.check`` — the device pass must absorb the
+    faults via host fallback with the IDENTICAL verdict (full dict
+    equality against both the packed host path and the legacy scan
+    twin), or surface an attributed deadline unknown.  A mem-store
+    total-queue leg runs the same bar over the fifo checker."""
+    import random as _random
+
+    from jepsen_tpu import core as jcore
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.checkers import api as checker_api
+    from jepsen_tpu.checkers.queue import fifo as q_fifo
+    from jepsen_tpu.checkers.queue import kafka as q_kafka
+    from jepsen_tpu.generator import core as g
+    from jepsen_tpu.history.ops import history as mk_history
+    from jepsen_tpu.resilience import Deadline, FaultPlan, RetryPolicy
+    from jepsen_tpu.workloads import kafka as wk
+    from jepsen_tpu.workloads.mem import MemClient, MemStore
+
+    row = {"seed": seed, "client_injected": 0, "checker_injected": 0,
+           "applied": {}, "anomalies": [], "degraded": 0, "unknown": 0}
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, seed=seed)
+    reg = telemetry.registry()
+    shapes = ("dup-send", "reorder-send", "zombie-resend", "torn-send")
+
+    def _adv_counts():
+        return {s: reg.counter("queue-adversarial-injections",
+                               shape=s).value for s in shapes}
+
+    # --- kafka leg: adversarial client under a full harness run --------
+    wl = wk.workload(rng=_random.Random(seed), subscribe_frac=0.3,
+                     txn_frac=0.4, crash_frac=0.05)
+    t = {
+        "name": f"queue-chaos-{seed}", "nodes": ["n1", "n2"],
+        "client": wk.KafkaClient(rng=_random.Random(seed + 1)),
+        "concurrency": 4, "store-dir": None,
+        "kafka-key-count": wl["kafka-key-count"],
+        "workload-kind": "kafka",
+        "generator": g.clients(g.limit(120, wl["generator"])),
+        "final-generator": wl["final-generator"],
+        "checker": wl["checker"],
+        "faults": {"seed": seed, "p": max(p, 0.25), "kinds": ["oom"],
+                   "sites": "|".join(sorted(wk.ADVERSARY_SITES))},
+    }
+    before = _adv_counts()
+    done = jcore.run(t)
+    applied = {s: int(v - before[s]) for s, v in _adv_counts().items()
+               if v > before[s]}
+    row["applied"] = applied
+    plan = done.get("faults-plan")
+    row["client_injected"] = len(plan.injected) if plan is not None else 0
+    res = done["results"]
+    assert "valid?" in res, f"kafka chaos run has no verdict ({res})"
+    row["anomalies"] = sorted(res.get("anomaly-types") or [])
+    if applied.get("dup-send") or applied.get("zombie-resend"):
+        # duplicate applications are fully observable (the final drain
+        # assigns every key and polls to quiet), so the verdict MUST
+        # attribute them — a silent pass here is a checker bug
+        assert res["valid?"] is False and "duplicate" in row["anomalies"], \
+            f"applied {applied} but verdict did not attribute a " \
+            f"duplicate ({res.get('anomaly-types')})"
+
+    # --- checker seam: device==twin on the SAME chaos history ----------
+    hist = done["history"]
+    twin = wk.KafkaChecker().check(None, hist, {})
+    host = q_kafka.check(hist, use_device=False)
+    assert host == twin, "packed host path diverged from the scan twin"
+    chaos = FaultPlan(seed=seed + 2, p=max(p, 0.6),
+                      kinds=("oom", "xla", "stall"), stall_s=0.005,
+                      sites="queue.check")
+    dev = q_kafka.check(hist, plan=chaos, policy=policy,
+                        deadline=Deadline(deadline_s))
+    row["checker_injected"] += len(chaos.injected)
+    if dev.pop("degraded", None):
+        row["degraded"] += 1
+    if dev.get("valid?") == "unknown" and dev.get("error"):
+        row["unknown"] += 1
+    else:
+        assert dev == twin, \
+            "kafka device verdict changed under queue.check chaos"
+
+    # --- total-queue leg: mem-store adversarial knobs + checker seam ---
+    rng = _random.Random(seed + 3)
+    mc = MemClient(MemStore(), rng=_random.Random(seed + 4),
+                   dup_enqueue_p=0.15, lose_enqueue_p=0.1,
+                   reorder_dequeue_p=0.25).open(None, "n1")
+    raw, idx, counter = [], 0, 0
+    for i in range(100):
+        if rng.random() < 0.45:
+            op = {"f": "enqueue", "value": counter}
+            counter += 1
+        else:
+            op = {"f": "dequeue", "value": None}
+        op = dict(op, process=i % 3, index=idx, type="invoke")
+        idx += 1
+        raw.append(op)
+        out = dict(mc.invoke(None, dict(op)), index=idx)
+        idx += 1
+        raw.append(out)
+    for i in range(counter):  # drain
+        op = {"f": "dequeue", "value": None, "process": 3,
+              "index": idx, "type": "invoke"}
+        idx += 1
+        raw.append(op)
+        out = dict(mc.invoke(None, dict(op)), index=idx)
+        idx += 1
+        raw.append(out)
+        if out["type"] == "fail":
+            break
+    qh = mk_history(raw, reindex=False)
+    tq_twin = checker_api.TotalQueueChecker().check(None, qh, {})
+    tq_host = q_fifo.check(qh, fifo=True, use_device=False)
+    for k, v in tq_twin.items():
+        assert tq_host[k] == v, \
+            f"total-queue host path diverged from twin on {k!r}"
+    chaos_q = FaultPlan(seed=seed + 5, p=max(p, 0.6),
+                        kinds=("oom", "xla", "stall"), stall_s=0.005,
+                        sites="queue.check")
+    tq_dev = q_fifo.check(qh, fifo=True, plan=chaos_q, policy=policy,
+                          deadline=Deadline(deadline_s))
+    row["checker_injected"] += len(chaos_q.injected)
+    if tq_dev.pop("degraded", None):
+        row["degraded"] += 1
+    if tq_dev.get("valid?") == "unknown" and tq_dev.get("error"):
+        row["unknown"] += 1
+    else:
+        assert tq_dev == tq_host, \
+            "total-queue device verdict changed under queue.check chaos"
+    return row
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rounds", type=int, default=10)
@@ -439,7 +581,34 @@ def main() -> int:
     ap.add_argument("--compilecache", action="store_true",
                     help="run the AOT compile-cache seam-chaos rounds "
                          "instead (load/compile/warm fall-through)")
+    ap.add_argument("--queue", action="store_true",
+                    help="run the queue-family chaos rounds instead "
+                         "(adversarial client sites + queue.check seam)")
     args = ap.parse_args()
+
+    if args.queue:
+        t0 = time.time()
+        inj = cinj = 0
+        shape_totals: dict = {}
+        for seed in range(args.seed0, args.seed0 + args.rounds):
+            row = queue_chaos_round(seed, max(args.p, 0.25),
+                                    args.deadline)
+            inj += row["client_injected"]
+            cinj += row["checker_injected"]
+            for s, n in row["applied"].items():
+                shape_totals[s] = shape_totals.get(s, 0) + n
+            print(f"seed {seed}: client-injected={row['client_injected']} "
+                  f"applied={row['applied']} "
+                  f"checker-injected={row['checker_injected']} "
+                  f"anomalies={row['anomalies']} "
+                  f"degraded={row['degraded']} unknown={row['unknown']}")
+        assert shape_totals, \
+            "no adversarial shape was ever applied — raise --p or --rounds"
+        print(f"\n{args.rounds} queue rounds in {time.time() - t0:.1f}s: "
+              f"{inj} client-site faults, {cinj} checker-seam faults, "
+              f"shapes applied {shape_totals} — every round terminated "
+              "with an attributable verdict, device == twin throughout")
+        return 0
 
     if args.compilecache:
         t0 = time.time()

@@ -79,15 +79,31 @@ def host_info_series(url, timeout=2.0) -> int:
 
 
 def template(seeds):
-    return {"name": NAME, "workloads": ["bank"], "seeds": list(seeds),
+    """A mini production-traffic mix (specs/production-traffic.json
+    shape): the bank pivot every generation runs, plus queue/kafka
+    scenarios the rotation walks through one slot at a time."""
+    return {"name": NAME,
+            "workloads": ["bank",
+                          {"name": "queue", "label": "queue"},
+                          {"name": "kafka", "label": "kafka",
+                           "opts": {"kafka-subscribe-frac": 0.2,
+                                    "kafka-txn-frac": 0.3}}],
+            "seeds": list(seeds),
             "opts": {"telemetry": True, "time-limit": 0.5,
                      "ops": 200, "concurrency": 3,
                      "client-latency": 0.004}}
 
 
 def mutate(i, sp):
-    """Generation >= 2 regresses: slower clients (the span the gate
-    watches) plus a skew window (a real anomaly for the shrinker)."""
+    """Scenario rotation (ROADMAP 5c) composed with the seeded
+    regression: every generation keeps the bank pivot and one
+    rotating queue/kafka cell; generation >= 2 regresses — slower
+    clients (the span the gate watches) plus a skew window (a real
+    anomaly for the shrinker).  Attribution can only land on a key
+    present in BOTH generations, i.e. the pivot."""
+    from jepsen_tpu.fleet import scenario_rotation
+
+    sp = scenario_rotation(pivot=("bank",), slots=1)(i, sp)
     if i >= 2:
         o = sp.setdefault("opts", {})
         o["client-latency"] = 0.01
@@ -252,7 +268,7 @@ def main() -> int:
                 return None
             apst = st.get("autopilot") or {}
             if apst.get("generations-closed", 0) >= 1 \
-                    and st.get("done", 0) > args.seeds:
+                    and st.get("done", 0) > 2 * args.seeds:
                 return st
             return None
 
@@ -300,7 +316,9 @@ def main() -> int:
                      f"{d_final}")
     c = summary["counts"]
     q = len(summary["quarantined"])
-    expect_cells = args.gens * args.seeds - q * (args.gens - 3)
+    # 2 workloads per generation (bank pivot + 1 rotated slot) x
+    # seeds, minus the quarantined pivot key's post-quarantine gens
+    expect_cells = args.gens * 2 * args.seeds - q * (args.gens - 3)
     if c["duplicates"] != 0:
         fails.append(f"{c['duplicates']} duplicate verdicts")
     if c["done"] != c["cells"] or c["cells"] != expect_cells:

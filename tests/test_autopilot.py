@@ -231,6 +231,67 @@ def test_regression_quarantined_and_autoshrunk(tmp_path, monkeypatch):
     assert st["autopilot"]["journal-digest"] == ap.journal.digest()
 
 
+# ------------------------------------------- quarantine parole (5d)
+
+def _fixed_then_reoffending_spans(sp):
+    """g0001 regresses every cell (seed 2 hardest), the bug is
+    'fixed' for two clean generations, then g0005 regresses again —
+    the paroled cell re-offends."""
+    gen = (sp.get("opts") or {}).get("autopilot-gen")
+    s = int(sp["seed"])
+    bad = gen in ("g0001", "g0005")
+    dur = (0.3 + 0.01 * s) if bad else (0.1 + 0.001 * s)
+    return {"spans": {"workload": dur}, "valid?": not bad,
+            "dir": f"runs/{sp['run_id']}"}
+
+
+def test_quarantine_parole_readmits_then_requarantines(
+        tmp_path, monkeypatch):
+    from jepsen_tpu import minimize
+
+    monkeypatch.setattr(minimize, "shrink", lambda run_dir, **kw: {
+        "ops": 3, "source-ops": 12, "digest": "abc123",
+        "anomaly-types": ["G-single"], "probes": 5, "cached": 1,
+        "fault-windows": []})
+    base = str(tmp_path / "store")
+    ap = Autopilot(SPEC, base, generations=6, spans=("workload",),
+                   poll_s=0.02, parole_after=2)
+    out = _run(ap, _fixed_then_reoffending_spans)
+    key = "bank|nofault|s2"
+    assert out["generations"] == 6
+
+    # g0001: quarantined; g0002+g0003 close clean without it ->
+    # paroled at g0003's close, back in the plan from g0004 on
+    v = ap.journal.quarantined[key]
+    assert v["history"] == [{"gen": "g0001", "paroled-gen": "g0003"}]
+    assert [g["runs"] for g in
+            (ap.journal.gens[l] for l in
+             ("g0000", "g0001", "g0002", "g0003", "g0004"))] == \
+        [3, 3, 2, 2, 3]
+
+    # g0005 regresses again: the re-offender is re-quarantined with
+    # the prior stint archived, and is NOT paroled anew
+    assert v["gen"] == "g0005" and "paroled-gen" not in v
+    g5 = ap.journal.gens["g0005"]["verdicts"][0]
+    assert g5["status"] == "regression" and g5["key"] == key
+
+    # plan membership per generation honors BOTH stints on replay
+    plans = {i: [rs.key for rs in ap._plan(i)] for i in range(7)}
+    assert key in plans[1]          # quarantined AT g0001's close
+    assert key not in plans[2] and key not in plans[3]
+    assert key in plans[4] and key in plans[5]
+    assert key not in plans[6]      # second stint
+
+    # gauges split active vs paroled; journal replay reaches the
+    # identical digest with parole + re-quarantine events applied
+    g = {m["name"]: m["value"]
+         for m in telemetry.registry().snapshot()["gauges"]}
+    assert g["fleet-quarantined-cells"] == 1
+    assert g["fleet-paroled-cells"] == 0
+    assert AutopilotJournal(ap.journal.path).digest() == \
+        ap.journal.digest()
+
+
 # ------------------------------------------------------------- chaos
 
 def test_chaos_on_every_seam_never_wedges(tmp_path):

@@ -449,7 +449,8 @@ def test_cli_demo_causal(tmp_path, capsys):
     from jepsen_tpu.__main__ import DEMOS
     rc = cli.run(cli.test_all_cmd(DEMOS),
                  ["--store-dir", str(tmp_path / "s"),
-                  "test-all", "--only", "causal", "--time-limit", "2"])
+                  "test-all", "--only", "causal", "--time-limit", "2",
+                  "--ops", "4000"])
     assert rc == 0
     out = capsys.readouterr().out
     assert "demo-causal" in out and "valid? = True" in out

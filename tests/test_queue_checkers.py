@@ -1,0 +1,325 @@
+"""checkers/queue/ — packed queue/kafka anomaly passes (ISSUE 19).
+
+Covers the tentpole contracts:
+
+- **completeness**: every adversarial-client shape produces a history
+  the matching checker attributes — dup-send and zombie-resend to
+  ``duplicate``, torn-send to ``lost-write``, reorder-send to
+  ``int-send-skip``/``nonmonotonic-send``, frozen offset commits to
+  ``stale-consumer-group`` — and clean traffic stays valid;
+- **differential twins**: on every corpus (including adversarial
+  ones) the packed host path, the device path, and the legacy scan
+  checkers (`workloads.kafka.KafkaChecker`,
+  `checkers.api.TotalQueueChecker`) agree verdict for verdict;
+- **resilience**: chaos on the ``queue.check`` seam degrades to the
+  host path with the identical verdict, never a changed one;
+- the **golden queue witness**: the checked-in minimal witness for a
+  seeded torn-send history (tests/data/witness-queue-lost-golden.json)
+  — shrinking reproduces the digest and the witness NAMES the lost
+  message;
+- the **acceptance pin**: an invalid kafka campaign cell (torn-send
+  adversary) auto-shrinks to a witness whose re-check names the
+  lost message's key, value, and acked offset.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from jepsen_tpu import core as jcore
+from jepsen_tpu import minimize, store
+from jepsen_tpu.checkers import api as checker_api
+from jepsen_tpu.checkers.queue import fifo as q_fifo
+from jepsen_tpu.checkers.queue import kafka as q_kafka
+from jepsen_tpu.history.ops import history as mk_history
+from jepsen_tpu.resilience import Deadline, FaultPlan, RetryPolicy
+from jepsen_tpu.workloads import kafka as wk
+from jepsen_tpu.workloads.mem import MemClient, MemStore
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data",
+                      "witness-queue-lost-golden.json")
+
+
+# ---------------------------------------------------------- helpers
+
+def _sim_kafka(seed, *, ops=80, n_clients=3, freeze=False,
+               gen_kw=None, **knobs):
+    """A deterministic single-threaded kafka sim: seeded generator,
+    seeded per-client adversary rngs, no scheduler noise — the
+    corpus IS a function of (seed, knobs)."""
+    rng = random.Random(seed)
+    st = wk.KafkaStore()
+    st.freeze_commits = freeze
+    clients = [wk.KafkaClient(st, rng=random.Random(seed * 100 + i),
+                              **knobs)
+               for i in range(n_clients)]
+    for c in clients:
+        c.member = st.new_member()
+    g = wk.gen(rng=rng, **(gen_kw or dict(
+        key_count=3, crash_frac=0.05, subscribe_frac=0.5,
+        txn_frac=0.3)))
+    raw, idx = [], 0
+    for i in range(ops):
+        c = clients[i % n_clients]
+        op = dict(g(None, None), process=i % n_clients,
+                  index=idx, type="invoke")
+        idx += 1
+        raw.append(op)
+        done = dict(c.invoke(None, dict(op)), index=idx)
+        idx += 1
+        raw.append(done)
+    return mk_history(raw, reindex=False)
+
+
+def _triple(h):
+    """(legacy scan twin, packed host, packed device) verdicts —
+    device stripped of its ``degraded`` flag for comparability."""
+    twin = wk.KafkaChecker().check(None, h, {})
+    host = q_kafka.check(h, use_device=False)
+    dev = q_kafka.check(h, use_device=True)
+    dev.pop("degraded", None)
+    return twin, host, dev
+
+
+def _sim_mem_queue(seed, *, ops=60, drain=True, **knobs):
+    rng = random.Random(seed)
+    mc = MemClient(MemStore(), rng=random.Random(seed + 1),
+                   **knobs).open(None, "n1")
+    raw, idx, counter = [], 0, 0
+    for i in range(ops):
+        if rng.random() < 0.5:
+            op = {"f": "enqueue", "value": counter}
+            counter += 1
+        else:
+            op = {"f": "dequeue", "value": None}
+        op = dict(op, process=i % 3, index=idx, type="invoke")
+        idx += 1
+        raw.append(op)
+        out = dict(mc.invoke(None, dict(op)), index=idx)
+        idx += 1
+        raw.append(out)
+    while drain:
+        op = {"f": "dequeue", "value": None, "process": 3,
+              "index": idx, "type": "invoke"}
+        idx += 1
+        raw.append(op)
+        out = dict(mc.invoke(None, dict(op)), index=idx)
+        idx += 1
+        raw.append(out)
+        if out["type"] == "fail":
+            break
+    return mk_history(raw, reindex=False)
+
+
+# --------------------------------------------------- drift pins
+
+def test_stale_min_polls_pinned_to_twin():
+    """The packed checker and the scan twin must agree on when a
+    consumer group counts as observed-then-stale, or the differential
+    contract silently breaks."""
+    assert wk.STALE_MIN_POLLS == q_kafka.STALE_MIN_POLLS
+
+
+def test_adversary_sites_cover_every_shape():
+    assert sorted(wk.ADVERSARY_SITES.values()) == \
+        ["dup-send", "reorder-send", "torn-send", "zombie-resend"]
+
+
+# ----------------------------------------- completeness + parity
+
+SHAPES = [
+    ("dup-send", dict(dup_send_p=0.3), {"duplicate"}),
+    ("zombie-resend", dict(zombie_p=0.3), {"duplicate"}),
+    ("torn-send", dict(torn_p=0.5), {"lost-write"}),
+    ("reorder-send", dict(reorder_p=0.5),
+     {"int-send-skip", "nonmonotonic-send"}),
+]
+
+
+@pytest.mark.parametrize("shape,knobs,expected",
+                         SHAPES, ids=[s[0] for s in SHAPES])
+def test_injected_anomaly_detected_and_twins_agree(
+        shape, knobs, expected):
+    """Each adversarial-client shape is ATTRIBUTED (the expected
+    anomaly appears across the seeded corpus) and every corpus —
+    clean or broken — keeps twin == packed host == packed device."""
+    seen = set()
+    for seed in range(10):
+        h = _sim_kafka(seed, **knobs)
+        twin, host, dev = _triple(h)
+        assert host == twin, f"{shape} s{seed}: host != twin"
+        assert dev == twin, f"{shape} s{seed}: device != twin"
+        seen.update(twin.get("anomaly-types") or [])
+    assert seen & expected, \
+        f"{shape}: expected one of {expected}, corpus showed {seen}"
+
+
+def test_stale_consumer_group_detected_and_twins_agree():
+    seen = set()
+    for seed in range(8):
+        h = _sim_kafka(seed, ops=60, n_clients=2, freeze=True,
+                       gen_kw=dict(key_count=2, subscribe_frac=0.2))
+        twin, host, dev = _triple(h)
+        assert host == twin and dev == twin
+        seen.update(twin.get("anomaly-types") or [])
+    assert "stale-consumer-group" in seen
+
+
+def test_clean_controls_stay_valid():
+    for seed in range(4):
+        h = _sim_kafka(seed, gen_kw=dict(
+            key_count=3, crash_frac=0.0, subscribe_frac=0.5,
+            txn_frac=0.3))
+        twin, host, dev = _triple(h)
+        assert twin["valid?"] is True
+        assert host == twin and dev == twin
+
+
+def test_chaos_on_check_seam_degrades_to_host_verdict():
+    """queue.check faults flip the device pass to the host scan —
+    same verdict, ``degraded`` flagged, injections logged."""
+    h = _sim_kafka(2, dup_send_p=0.2, torn_p=0.3)
+    host = q_kafka.check(h, use_device=False)
+    plan = FaultPlan(seed=5, p=1.0, kinds=("oom",),
+                     sites="queue.check")
+    pol = RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                      max_delay_s=0.0)
+    dev = q_kafka.check(h, plan=plan, policy=pol,
+                        deadline=Deadline(30.0))
+    assert plan.injected, "the chaos plan never fired"
+    assert dev.pop("degraded", None) == "host-fallback"
+    assert dev == host
+
+
+# ------------------------------------ mem-store queue adversaries
+
+def test_mem_queue_lose_enqueue_attributed_as_lost():
+    h = _sim_mem_queue(0, lose_enqueue_p=1.0)
+    twin = checker_api.TotalQueueChecker().check(None, h, {})
+    host = q_fifo.check(h, fifo=True, use_device=False)
+    assert twin["valid?"] is False
+    assert "queue-lost" in host["anomaly-types"]
+    for k, v in twin.items():
+        assert host[k] == v
+
+
+def test_mem_queue_dup_enqueue_attributed_as_phantom():
+    h = _sim_mem_queue(1, dup_enqueue_p=1.0)
+    twin = checker_api.TotalQueueChecker().check(None, h, {})
+    host = q_fifo.check(h, fifo=True, use_device=False)
+    assert twin["valid?"] is False
+    assert "queue-phantom" in host["anomaly-types"]
+    for k, v in twin.items():
+        assert host[k] == v
+
+
+def test_mem_queue_reorder_trips_fifo_mode_only():
+    """The reorder knob reorders deliveries without losing or
+    duplicating anything: the total-queue contract stays valid, the
+    stricter FIFO pass attributes the violation."""
+    hit = False
+    for seed in range(6):
+        h = _sim_mem_queue(seed, reorder_dequeue_p=0.5)
+        total = q_fifo.check(h, fifo=False, use_device=False)
+        fifo = q_fifo.check(h, fifo=True, use_device=False)
+        twin = checker_api.TotalQueueChecker().check(None, h, {})
+        for k, v in twin.items():
+            assert total[k] == v
+        if "queue-fifo-violation" in (fifo.get("anomaly-types") or []):
+            hit = True
+            assert total["valid?"] is True
+    assert hit, "reorder knob never produced a FIFO violation"
+
+
+def test_mem_queue_device_matches_host():
+    for seed in range(4):
+        h = _sim_mem_queue(seed, dup_enqueue_p=0.2,
+                           lose_enqueue_p=0.1,
+                           reorder_dequeue_p=0.3)
+        host = q_fifo.check(h, fifo=True, use_device=False)
+        dev = q_fifo.check(h, fifo=True, use_device=True)
+        dev.pop("degraded", None)
+        assert dev == host
+
+
+# ---------------------------------------------------------- golden
+
+def _save_run(tmp_path, h, name="queue-inv"):
+    base = str(tmp_path / "s")
+    test = jcore.noop_test(name=name)
+    test["store-dir"] = base
+    test["history"] = h
+    store.save_0(test)
+    test["results"] = q_kafka.check(h, use_device=False)
+    store.save_1(test)
+    return base, store.test_dir(test)
+
+
+def test_golden_queue_witness(tmp_path):
+    """The checked-in minimal witness for the canonical seeded
+    torn-send history: shrinking must reproduce the golden digest and
+    ops, and the witness names WHICH message was lost (key, value,
+    acked offset)."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    g = golden["generator"]
+    h = _sim_kafka(g["seed"], ops=g["ops"], torn_p=g["torn_p"])
+    assert q_kafka.check(h, use_device=False)["valid?"] is False
+    base, d = _save_run(tmp_path, h)
+    s = minimize.shrink(d, host_oracle=True, anomalies="lost-write")
+    assert s["digest"] == golden["digest"]
+    got = json.loads(json.dumps(
+        [[op.type, op.process, op.f, op.value]
+         for op in s["witness-history"]], default=str))
+    assert got == golden["ops"]
+    res = q_kafka.check(s["witness-history"], use_device=False)
+    lost = [list(e) for e in res["anomalies"]["lost-write"]]
+    assert lost == golden["lost"]
+
+
+# ------------------------------------------------- acceptance pin
+
+def test_campaign_kafka_cell_autoshrinks_naming_lost_message(
+        tmp_path):
+    """ISSUE 19 acceptance: an invalid queue campaign cell (torn-send
+    adversary) auto-shrinks to a witness that names the lost
+    message."""
+    from jepsen_tpu import campaign
+
+    base = str(tmp_path / "s")
+    spec = {"name": "queue-accept",
+            "workloads": [{"name": "kafka", "label": "kafka-torn",
+                           "opts": {"queue-adversary":
+                                    {"torn-p": 0.6},
+                                    "kafka-txn-frac": 0.6,
+                                    "kafka-subscribe-frac": 0.3,
+                                    "kafka-crash-frac": 0.0}}],
+            "seeds": [3],
+            "opts": {"ops": 150, "concurrency": 2,
+                     "time-limit": 1.0, "client-latency": 0.0,
+                     "shrink": {"host-oracle": True,
+                                "probe-deadline": 20}}}
+    summary = campaign.run_campaign(spec, base, workers=1)
+    row = summary["rows"][0]
+    assert row["valid?"] is False
+    w = row["witness"]
+    assert w and not w.get("error"), row
+    assert "lost-write" in w["anomaly-types"]
+    wit = minimize.load_witness(os.path.join(base, row["dir"]))
+    res = q_kafka.check(wit["history"], use_device=False)
+    lost = res["anomalies"]["lost-write"]
+    assert lost, "witness re-check lost the lost-write attribution"
+    k, off, v = lost[0]
+    # the named message was really acked at that offset by a send
+    # mop inside the witness itself
+    acked = {(m[1], tuple(m[2]) if isinstance(m[2], list)
+              else m[2])
+             for op in wit["history"]
+             if op.type == "ok" and isinstance(op.value, list)
+             for m in op.value
+             if isinstance(m, (list, tuple)) and m
+             and m[0] == "send" and m[2] is not None}
+    assert (k, (off, v)) in acked, \
+        f"lost message {(k, off, v)} not acked in the witness"

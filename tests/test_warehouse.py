@@ -893,7 +893,16 @@ def _golden_exposition(base):
     reg.gauge("fleet-queue-depth").set(4)
     reg.gauge("fleet-claim-latency-p95-s").set(0.42)
     reg.gauge("fleet-quarantined-cells").set(1)
+    reg.gauge("fleet-paroled-cells").set(1)
     reg.gauge("fleet-autopilot-generations").set(5)
+    # queue family (ISSUE 19): anomalies the packed checkers attribute
+    # and adversarial-client injections by shape
+    reg.counter("queue-anomalies-found", anomaly="lost-write").inc(2)
+    reg.counter("queue-anomalies-found", anomaly="duplicate").inc(3)
+    reg.counter("queue-adversarial-injections",
+                shape="torn-send").inc(2)
+    reg.counter("queue-adversarial-injections",
+                shape="zombie-resend").inc(1)
     cdir = os.path.join(str(base), "campaigns")
     os.makedirs(cdir, exist_ok=True)
     with open(os.path.join(cdir, "soak.live.json"), "w") as f:
