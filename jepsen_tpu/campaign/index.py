@@ -116,6 +116,7 @@ class Index:
         self._load_lock = threading.Lock()
         self._wh: Optional[tuple] = None  # cached (warehouse, rel)
         self._wh_resolved = False
+        self._wh_compacted = False
         #: byte offset of the last durable record seen at load; a
         #: resuming WRITER truncates to it before its first append
         self._good_bytes: Optional[int] = None
@@ -128,23 +129,37 @@ class Index:
                     self._load()
         return self._records
 
-    def _warehouse(self):
+    #: queries a COMPACTED ledger's warehouse still answers exactly:
+    #: their rollup rows (flip_rollup / span_gen_rollup / the kept
+    #: witness records) survive compaction untouched.  Everything else
+    #: lost its raw rows and must fall back to the jsonl scan.
+    _COMPACT_SAFE = frozenset({"flips", "span_trend", "witness_diffs"})
+
+    def _warehouse(self, query: Optional[str] = None):
         """(warehouse, ledger-rel) when the SQL fast path may answer
         for this ledger, else None.  Resolved (freshness-checked) once
         per Index and cached — the same point-in-time semantics as the
         one-shot jsonl load — and invalidated by :meth:`append`, which
-        makes the warehouse stale by definition."""
+        makes the warehouse stale by definition.  ``query`` gates
+        per-query on compaction (ISSUE 20): once a ledger's raw rows
+        were folded past the generation horizon, only the
+        ``_COMPACT_SAFE`` queries keep the SQL path."""
         if not self.use_warehouse:
             return None
-        if self._wh_resolved:
-            return self._wh
-        try:
-            from jepsen_tpu.telemetry import warehouse as wmod
+        if not self._wh_resolved:
+            try:
+                from jepsen_tpu.telemetry import warehouse as wmod
 
-            self._wh = wmod.for_ledger(self.path)
-        except Exception:  # noqa: BLE001 — fast path only, never fail
-            self._wh = None
-        self._wh_resolved = True
+                self._wh = wmod.for_ledger(self.path)
+                self._wh_compacted = bool(
+                    self._wh is not None and
+                    self._wh[0].ledger_compacted(self._wh[1]))
+            except Exception:  # noqa: BLE001 — fast path, never fail
+                self._wh = None
+                self._wh_compacted = False
+            self._wh_resolved = True
+        if self._wh_compacted and query not in self._COMPACT_SAFE:
+            return None
         return self._wh
 
     # -- persistence --------------------------------------------------------
@@ -231,7 +246,7 @@ class Index:
         ``regression`` marks the bad direction (away from True) — the
         "which (workload, seed) flipped valid? since the last campaign"
         query."""
-        wh = self._warehouse()
+        wh = self._warehouse("flips")
         if wh is not None:
             return wh[0].flips(wh[1])
         out: List[Dict[str, Any]] = []
@@ -260,7 +275,7 @@ class Index:
         an unchanged spec is the "the minimal repro MOVED" signal — a
         different failure than last generation, even when the verdict
         column still just says False."""
-        wh = self._warehouse()
+        wh = self._warehouse("witness_diffs")
         if wh is not None:
             return witness_pair_diffs(wh[0].witness_records(wh[1]))
         by_key: Dict[str, List[Dict[str, Any]]] = {}
@@ -283,7 +298,7 @@ class Index:
     def span_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-span duration aggregates across every indexed run:
         count / min / p50 / p95 / max (seconds)."""
-        wh = self._warehouse()
+        wh = self._warehouse("span_stats")
         if wh is not None:
             return wh[0].span_stats(wh[1])
         return {
@@ -302,7 +317,7 @@ class Index:
         """(gen, duration) samples for one span name, in append order —
         the material for :meth:`span_trend` and the ``cli obs gate``
         regression gate."""
-        wh = self._warehouse()
+        wh = self._warehouse("span_samples")
         if wh is not None:
             return wh[0].span_samples(wh[1], name)
         out: List[Tuple[Optional[str], float]] = []
@@ -317,7 +332,7 @@ class Index:
         order — the "checker p95 span duration trend" query.  The
         warehouse answers from its materialized per-generation rollup;
         the jsonl path recomputes from the raw samples."""
-        wh = self._warehouse()
+        wh = self._warehouse("span_trend")
         if wh is not None:
             return wh[0].span_trend(wh[1], name)
         by_gen: Dict[str, List[float]] = {}
@@ -335,7 +350,7 @@ class Index:
         :mod:`jepsen_tpu.telemetry.forensics` (``obs diff`` / ``obs
         gate --explain``).  Warehouse and jsonl scan MUST return the
         identical shape so both paths reach the same verdict."""
-        wh = self._warehouse()
+        wh = self._warehouse("forensic_records")
         if wh is not None:
             return wh[0].forensic_records(wh[1])
         return [(r.get("gen"), r.get("spans") or {},
@@ -348,7 +363,7 @@ class Index:
         Warehouse-backed from the ``span_profile`` table when fresh;
         the fallback re-reads each run dir's telemetry.json through the
         same extraction (``forensics.profile_from_doc``)."""
-        wh = self._warehouse()
+        wh = self._warehouse("profile")
         if wh is not None:
             return wh[0].campaign_profile(wh[1])
         from jepsen_tpu.telemetry.forensics import profile_rows_from_dirs
@@ -371,7 +386,7 @@ class Index:
         workload/fault/seed/valid?/error/degraded/deadline/dir/ops/
         wall_s/gen/ts/witness) — per-span durations stay in
         :meth:`span_stats`/:meth:`span_samples`, not here."""
-        wh = self._warehouse()
+        wh = self._warehouse("latest_by_run")
         if wh is not None:
             return wh[0].latest_by_run(wh[1])
         latest: Dict[str, Dict[str, Any]] = {}

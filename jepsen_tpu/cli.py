@@ -787,11 +787,43 @@ def obs_cmd(opts: argparse.Namespace) -> int:
         # is fresh and falls back to the jsonl scan otherwise, so these
         # work (identically) with or without an ingested warehouse
         return _obs_campaign_cmd(opts, base)
+    if opts.action == "alerts":
+        # the watchtower (ISSUE 20): warehouse signals are best-effort,
+        # so this works on a store with no warehouse at all
+        return _obs_alerts_cmd(opts, base)
     wh = wmod.open_if_exists(base)
     if wh is None:
         print(f"obs: no warehouse at {wmod.warehouse_path(base)} "
               "(run `obs ingest` first)", file=sys.stderr)
         return 2
+    if opts.action == "compact":
+        cdir = os.path.join(base, "campaigns")
+        want = opts.campaign or opts.query
+        names = ([want] if want else sorted(
+            fn[:-len(".jsonl")] for fn in (
+                os.listdir(cdir) if os.path.isdir(cdir) else ())
+            if fn.endswith(".jsonl")))
+        if not names:
+            print("obs: no campaign ledgers to compact", file=sys.stderr)
+            return 2
+        total = {"gens-compacted": 0, "dropped-records": 0,
+                 "dropped-spans": 0, "kept-witnesses": 0}
+        for name in names:
+            path = os.path.join(cdir, f"{name}.jsonl")
+            if not os.path.exists(path):
+                print(f"obs: no ledger for campaign {name!r}",
+                      file=sys.stderr)
+                return 2
+            stats = wh.compact_ledger(path, base,
+                                      keep_gens=opts.keep_gens)
+            print(f"compact {name}: " + ", ".join(
+                f"{v} {k}" for k, v in sorted(stats.items())))
+            for k, v in stats.items():
+                total[k] = total.get(k, 0) + v
+        if len(names) > 1:
+            print("total: " + ", ".join(
+                f"{v} {k}" for k, v in sorted(total.items())))
+        return 0
     if opts.action == "bench":
         rows = wh.bench_series()
         if not rows:
@@ -837,6 +869,68 @@ def obs_cmd(opts: argparse.Namespace) -> int:
     return 2
 
 
+def _obs_alerts_cmd(opts: argparse.Namespace, base: str) -> int:
+    """`obs alerts` — render the watchtower's durable alert state
+    (docs/ALERTS.md).  Plain: replay <store>/alerts.jsonl read-only.
+    With --eval: run one engine tick against the live registry,
+    campaign heartbeats, store counters, and warehouse rollups first
+    (journaling transitions + notifying sinks — the headless cron
+    form of the autopilot's alert tick).  Exit 1 while anything is
+    firing, so CI and cron wrappers get the red exit for free."""
+    import json as _json
+
+    from .telemetry import alerts as alerts_mod
+
+    if opts.alerts_eval:
+        from .telemetry import warehouse as wmod
+
+        eng = alerts_mod.AlertEngine(base)
+        eng.evaluate(warehouse=wmod.open_if_exists(base))
+        jr = eng.journal
+    else:
+        path = alerts_mod.alerts_path(base)
+        if not os.path.exists(path):
+            print(f"obs: no alert journal at {path} (the autopilot's "
+                  "alert tick or `obs alerts --eval` creates it)",
+                  file=sys.stderr)
+            return 2
+        jr = alerts_mod.AlertJournal(path)
+    order = {"firing": 0, "pending": 1, "resolved": 2}
+    rows = sorted(jr.states.items(),
+                  key=lambda kv: (order.get(kv[1].get("state"), 3),
+                                  kv[0]))
+    if opts.json_out:
+        doc = {"digest": jr.digest(),
+               "sends-ok": jr.sends_ok,
+               "sends-failed": jr.sends_failed,
+               "states": {r: dict(d) for r, d in rows}}
+        if opts.json_out == "-":
+            _json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+            print()
+        else:
+            with open(opts.json_out, "w") as f:
+                _json.dump(doc, f, indent=2, sort_keys=True)
+            print(f"report written: {opts.json_out}")
+    firing = [r for r, d in rows if d.get("state") == "firing"]
+    if opts.json_out != "-":
+        print(f"alerts: {len(firing)} firing, "
+              f"{sum(1 for _r, d in rows if d.get('state') == 'pending')} "
+              f"pending ({len(rows)} rule(s) journaled) · digest "
+              f"{jr.digest()} · notifications {jr.sends_ok} ok / "
+              f"{jr.sends_failed} failed")
+        if rows:
+            w = max(len(r) for r, _d in rows)
+            print(f"{'rule':<{w}} {'severity':<8} {'state':<8} "
+                  f"{'value':>12} since")
+            for r, d in rows:
+                v = d.get("value")
+                print(f"{r:<{w}} {str(d.get('severity')):<8} "
+                      f"{str(d.get('state')):<8} "
+                      f"{(f'{v:.4g}' if isinstance(v, (int, float)) else '-'):>12} "
+                      f"{d.get('since')}")
+    return 1 if firing else 0
+
+
 def _obs_campaign_cmd(opts: argparse.Namespace, base: str) -> int:
     """`obs gate|profile|diff` — the campaign-scoped observatory
     queries (docs/TELEMETRY.md "Performance observatory").  Exit codes:
@@ -872,11 +966,19 @@ def _obs_campaign_cmd(opts: argparse.Namespace, base: str) -> int:
             base, campaign, from_gen=opts.from_gen, to_gen=opts.to_gen,
             spans=opts.span or None, alpha=opts.alpha,
             threshold=opts.threshold, min_runs=opts.min_runs)
-        print(forensics.render_diff(report))
-        if opts.json_out:
-            with open(opts.json_out, "w") as f:
-                _json.dump(report, f, indent=2, sort_keys=True)
-            print(f"report written: {opts.json_out}")
+        if opts.json_out == "-":
+            # machine form on stdout (ISSUE 20 satellite): the human
+            # rendering moves to stderr so `obs diff --json - | jq`
+            # sees pure JSON
+            print(forensics.render_diff(report), file=sys.stderr)
+            _json.dump(report, sys.stdout, indent=2, sort_keys=True)
+            print()
+        else:
+            print(forensics.render_diff(report))
+            if opts.json_out:
+                with open(opts.json_out, "w") as f:
+                    _json.dump(report, f, indent=2, sort_keys=True)
+                print(f"report written: {opts.json_out}")
         return {"pass": 0, "regression": 1}.get(report.get("status"), 2)
     # gate: repeated --span flags, each an exact name or a * glob
     if not opts.span:
@@ -892,6 +994,8 @@ def _obs_campaign_cmd(opts: argparse.Namespace, base: str) -> int:
               f"{', '.join(sorted(known)) or 'none'})", file=sys.stderr)
         return 2
     statuses = []
+    results = []
+    out = sys.stderr if opts.json_out == "-" else sys.stdout
     for i, span in enumerate(wanted):
         res = gate_mod.run_gate(
             base, campaign, span,
@@ -900,13 +1004,32 @@ def _obs_campaign_cmd(opts: argparse.Namespace, base: str) -> int:
             min_runs=opts.min_runs)
         statuses.append(res.get("status"))
         if i:
-            print()
-        print(gate_mod.render_gate(res))
+            print(file=out)
+        print(gate_mod.render_gate(res), file=out)
+        entry = None
         if opts.explain and res.get("status") == "regression":
             entry = forensics.attribute_span(
                 span, records, res["from-gen"], res["to-gen"])
             for line in forensics.render_attribution(entry):
-                print("  " + line)
+                print("  " + line, file=out)
+        results.append({"span": span, **res,
+                        **({"attribution": entry} if entry else {})})
+    if opts.json_out:
+        # machine form (ISSUE 20 satellite): '-' puts pure JSON on
+        # stdout for webhook payloads / CI without a tempfile
+        report = {"campaign": campaign,
+                  "status": ("regression" if "regression" in statuses
+                             else "pass" if all(s == "pass"
+                                                for s in statuses)
+                             else "insufficient-data"),
+                  "gates": results}
+        if opts.json_out == "-":
+            _json.dump(report, sys.stdout, indent=2, sort_keys=True)
+            print()
+        else:
+            with open(opts.json_out, "w") as f:
+                _json.dump(report, f, indent=2, sort_keys=True)
+            print(f"report written: {opts.json_out}")
     if "regression" in statuses:
         return 1
     return 0 if all(s == "pass" for s in statuses) else 2
@@ -1063,7 +1186,7 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
     po.add_argument("action",
                     choices=("ingest", "rebuild", "gate", "sql",
                              "bench", "timeline", "profile", "diff",
-                             "gc"))
+                             "gc", "alerts", "compact"))
     po.add_argument("query", nargs="?",
                     help="SQL for the sql action (read-only); run id "
                          "or 32-hex trace id for the timeline action; "
@@ -1081,8 +1204,20 @@ def single_test_cmd(test_fn, *, extra_opts: Optional[Callable] = None,
                     help="gate: on regression, attribute the delta "
                          "across phase buckets and forensic counters")
     po.add_argument("--json", dest="json_out", metavar="PATH",
-                    help="diff: also write the full report as a JSON "
-                         "artifact")
+                    help="gate/diff/alerts: also write the full "
+                         "report as a JSON artifact; '-' writes it to "
+                         "stdout (webhook payloads / CI embedding "
+                         "without a tempfile round-trip)")
+    po.add_argument("--eval", dest="alerts_eval", action="store_true",
+                    help="alerts: run one evaluation tick (registry + "
+                         "heartbeats + warehouse rollups) against the "
+                         "store's rule pack, journaling transitions "
+                         "and notifying sinks, before rendering")
+    po.add_argument("--keep-gens", dest="keep_gens", type=int,
+                    default=2,
+                    help="compact: generations of raw rows to keep "
+                         "live per ledger (default 2); older fold "
+                         "into bounded summary rows")
     po.add_argument("--from-gen", dest="from_gen", default=None,
                     help="gate: baseline generation (default: "
                          "second-latest)")

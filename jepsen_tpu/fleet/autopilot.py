@@ -275,11 +275,18 @@ class AutopilotJournal:
         self._event({"ev": "quarantine", "key": key, "gen": gen,
                      "span": span, "rel-delta": rel_delta})
 
-    def parole(self, key: str, *, gen: str) -> None:
+    def parole(self, key: str, *, gen: str,
+               twin: Any = None) -> None:
         """Re-admit a quarantined key: durable as of generation
         `gen`'s close — the key re-enters the plan from the NEXT
-        generation on."""
-        self._event({"ev": "parole", "key": key, "gen": gen})
+        generation on.  ``twin`` records the host-twin re-check that
+        justified the parole (ISSUE 20 satellite); it is audit
+        payload only — ``_apply`` reads key/gen alone, so journals
+        with and without it replay to the same state."""
+        ev = {"ev": "parole", "key": key, "gen": gen}
+        if twin is not None:
+            ev["twin"] = twin
+        self._event(ev)
 
     def shrink(self, key: str, *, gen: str,
                outcome: Dict[str, Any]) -> None:
@@ -352,6 +359,8 @@ class Autopilot:
                  worker_poll_s: float = 0.1,
                  worker_extra: Tuple[str, ...] = (),
                  shrink_knobs: Optional[Dict[str, Any]] = None,
+                 alert_rules: Optional[list] = None,
+                 alert_sinks: Optional[list] = None,
                  poll_s: float = 0.2):
         if isinstance(template, str):
             with open(template) as f:
@@ -396,6 +405,16 @@ class Autopilot:
         self._wseq = 0
         self._upgrading: Optional[Tuple[str, str]] = None
         self._last_scale = 0.0
+        #: witness digest -> (parole allowed, twin audit doc) — the
+        #: host-twin re-check is deterministic, so one verdict per
+        #: digest serves every parole tick (ISSUE 20 satellite)
+        self._twin_cache: Dict[str, Tuple[bool, Any]] = {}
+        from jepsen_tpu.telemetry.alerts import AlertEngine
+
+        #: the watchtower (ISSUE 20): evaluated on the scale cadence
+        #: while awaiting a generation, and once after each gate
+        self.alerts = AlertEngine(self.base, rules=alert_rules,
+                                  sinks=alert_sinks)
         self.coordinator = FleetCoordinator(
             self._gen_spec(0), self.base, lease_s=lease_s,
             run_deadline_s=run_deadline_s)
@@ -559,6 +578,10 @@ class Autopilot:
         if paroled:
             summary["paroled"] = paroled
         self._update_gauges()
+        # the gate's verdicts just changed the alertable state
+        # (gate-regression / rc2-streak / quarantine census): evaluate
+        # now instead of waiting for the next await tick
+        self._seam("alerts.evaluate", self._alert_tick)
         return summary
 
     def _parole_tick(self, label: str) -> List[str]:
@@ -581,14 +604,80 @@ class Autopilot:
                 continue
             q = self._gen_index(v.get("gen"))
             n = sum(1 for ci in clean if ci > q)
-            if n >= self.parole_after:
-                self.journal.parole(key, gen=label)
-                out.append(key)
+            if n < self.parole_after:
+                continue
+            allowed, twin = self._witness_twin_check(key)
+            if not allowed:
                 logger.info(
-                    "autopilot %s: paroled %s after %d clean "
-                    "generation(s) (quarantined at %s)",
-                    self.name, key, n, v.get("gen"))
+                    "autopilot %s: parole of %s DENIED by host-twin "
+                    "re-check (%s)", self.name, key, twin)
+                continue
+            self.journal.parole(key, gen=label, twin=twin)
+            out.append(key)
+            logger.info(
+                "autopilot %s: paroled %s after %d clean "
+                "generation(s) (quarantined at %s, twin %s)",
+                self.name, key, n, v.get("gen"), twin)
         return out
+
+    def _witness_twin_check(self, key: str) -> Tuple[bool, Any]:
+        """Parole on twin-pass (ROADMAP 5d remainder): a quarantined
+        key whose auto-shrink produced a WITNESS may only be paroled
+        if that witness's shrunken history re-checks VALID through its
+        host twin — the device-independent oracle.  Twin-valid means
+        the archived anomaly was a device-path false positive and the
+        neighbors-ran-clean evidence stands; twin-invalid means the
+        anomaly is real and clean neighbor generations prove nothing
+        (denied until the witness changes).  A missing/unreadable
+        witness denies conservatively; a shrink with NO witness (perf
+        regressions have nothing to re-check) keeps the plain
+        clean-generations criterion."""
+        outcome = (self.journal.shrinks.get(key) or {}).get(
+            "outcome") or {}
+        digest = outcome.get("digest")
+        if not digest:
+            return True, None
+        cached = self._twin_cache.get(digest)
+        if cached is not None:
+            return cached
+        res = self._twin_recheck(key, str(digest))
+        self._twin_cache[digest] = res
+        return res
+
+    def _twin_recheck(self, key: str, digest: str) -> Tuple[bool, Any]:
+        from jepsen_tpu.minimize import probe
+        from jepsen_tpu.minimize import witness as witness_mod
+
+        with self.coordinator._lock:
+            recs = [r for r in self.coordinator.idx.records
+                    if str(r.get("key")) == key and r.get("dir")
+                    and isinstance(r.get("witness"), dict)
+                    and r["witness"].get("digest") == digest]
+        if not recs:
+            return False, {"digest": digest,
+                           "error": "witness-record-missing"}
+        run_dir = os.path.join(self.base, str(recs[-1]["dir"]))
+        try:
+            w = witness_mod.load_witness(run_dir)
+            if w is None or w.get("digest") != digest:
+                return False, {"digest": digest,
+                               "error": "witness-artifact-missing"}
+            hist = w["history"]
+            chk = probe.resolve_checker(None, hist)
+            twin = probe.host_equivalent(chk) or chk
+            res = twin.check({}, hist, {})
+            valid = res.get("valid?") if isinstance(res, dict) else None
+        except Exception as e:  # noqa: BLE001 — deny conservatively
+            return False, {"digest": digest,
+                           "error": f"{type(e).__name__}: {e}"}
+        doc = {"digest": digest,
+               "checker": str(getattr(twin, "name",
+                                      type(twin).__name__)),
+               "valid?": valid}
+        return (valid is True), doc
+
+    def _alert_tick(self) -> Dict[str, Any]:
+        return self.alerts.evaluate(autopilot=self)
 
     def run(self) -> Dict[str, Any]:
         """The unattended loop: generations until ``generations`` (or
@@ -627,6 +716,7 @@ class Autopilot:
             if now - self._last_scale >= self.scale_interval_s:
                 self._last_scale = now
                 self._seam("autopilot.scale", self._scale_tick)
+                self._seam("alerts.evaluate", self._alert_tick)
             self.stop.wait(self.poll_s)
         return False
 
@@ -976,4 +1066,5 @@ class Autopilot:
             "last-verdicts": last or [],
             "workers": workers,
             "journal-digest": self.journal.digest(),
+            "alerts": self.alerts.status_doc(),
         }

@@ -30,8 +30,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import metrics as _metrics
 
 __all__ = ["exposition", "render_registry", "render_heartbeats",
-           "render_warehouse", "render_fleet", "metric_name",
-           "escape_label_value", "CONTENT_TYPE"]
+           "render_warehouse", "render_fleet", "render_alerts",
+           "metric_name", "escape_label_value", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -265,6 +265,42 @@ def render_warehouse(wh: Any) -> List[str]:
     return doc.render()
 
 
+def render_alerts(base: str,
+                  now: Optional[float] = None) -> List[str]:
+    """The watchtower's alert state (ISSUE 20) as the Prometheus
+    convention's literal ``ALERTS`` gauge family — NO ``jepsen_``
+    prefix, exactly the series an Alertmanager-era scraper expects:
+    ``ALERTS{alertname=...,severity=...,state="pending"|"firing"} 1``.
+
+    Replayed from the store's ``alerts.jsonl`` journal, read-only (a
+    reader never heals the journal).  Cardinality is bounded by
+    construction: a series exists ONLY while its rule is pending or
+    firing and retires the moment it resolves — the same discipline as
+    the fleet host series retiring with worker liveness."""
+    from . import alerts as alerts_mod
+
+    path = alerts_mod.alerts_path(base)
+    if not os.path.exists(path):
+        return []
+    try:
+        journal = alerts_mod.AlertJournal(path)
+        active = journal.active()
+    except Exception:  # noqa: BLE001 — alerts are best-effort
+        return []
+    if not active:
+        return []
+    doc = _Doc()
+    fam = doc.family("ALERTS", "gauge",
+                     "active watchtower alert rules by state")
+    for a in active:
+        fam.append(
+            "ALERTS" + _labels_str({
+                "alertname": a["rule"],
+                "severity": a.get("severity") or "warn",
+                "state": a.get("state")}) + " 1")
+    return doc.render()
+
+
 def render_fleet(fleet: Any) -> List[str]:
     """Metrics federation (ISSUE 14 tentpole b): the fleet
     coordinator's view of every ALIVE worker's last pushed metrics
@@ -345,6 +381,7 @@ def exposition(base: Optional[str] = None,
         lines += render_fleet(fleet)
     if base:
         lines += render_heartbeats(base, now=now)
+        lines += render_alerts(base, now=now)
         try:
             from . import warehouse as wmod
 

@@ -50,6 +50,7 @@ import re
 import sqlite3
 import threading
 import time
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("jepsen.warehouse")
@@ -58,7 +59,7 @@ __all__ = ["Warehouse", "warehouse_path", "open_if_exists", "for_ledger",
            "WAREHOUSE_FILE", "SCHEMA_VERSION"]
 
 WAREHOUSE_FILE = "warehouse.sqlite"
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS meta(
@@ -200,11 +201,31 @@ CREATE TABLE IF NOT EXISTS span_profile(
     device_dispatch_s REAL NOT NULL DEFAULT 0);
 CREATE INDEX IF NOT EXISTS spf_dir ON span_profile(dir);
 CREATE INDEX IF NOT EXISTS spf_site ON span_profile(site, shape);
+-- generation-horizon compaction (ISSUE 20, schema v7): past a kept
+-- horizon, a campaign ledger's raw per-record rows fold into bounded
+-- per-generation summaries and are DROPPED (witness-bearing rows
+-- survive so witness queries stay exact).  flip_rollup and
+-- span_gen_rollup rows are never dropped — the compact-safe queries
+-- (flips / span_trend / witness_diffs / the alert tick) union
+-- compacted + live transparently, everything else falls back to the
+-- jsonl scan once a ledger is compacted.
+CREATE TABLE IF NOT EXISTS gen_compact(
+    ledger TEXT NOT NULL, gen TEXT NOT NULL,
+    first_id INTEGER NOT NULL,      -- first record id: trend order
+    records INTEGER NOT NULL,
+    verdicts TEXT NOT NULL,         -- {"true": n, "false": n, ...}
+    PRIMARY KEY(ledger, gen));
+CREATE TABLE IF NOT EXISTS key_compact(
+    ledger TEXT NOT NULL, key TEXT NOT NULL,
+    last_valid TEXT,                -- last folded verdict (JSON)
+    last_id INTEGER NOT NULL,       -- its record id
+    PRIMARY KEY(ledger, key));
 """
 
 #: every row-holding table, in wipe order (rebuild / per-unit deletes)
 _DATA_TABLES = ("record_spans", "flip_rollup", "span_rollup",
-                "span_gen_rollup", "campaign_records", "ledgers",
+                "span_gen_rollup", "gen_compact", "key_compact",
+                "campaign_records", "ledgers",
                 "run_spans", "run_metrics", "span_profile",
                 "witnesses", "runs",
                 "events", "event_cursors", "verifier_sessions",
@@ -245,6 +266,7 @@ class Warehouse:
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lock = threading.RLock()
+        self._batch_depth = 0
         self.db = sqlite3.connect(path, check_same_thread=False)
         self.db.execute("PRAGMA journal_mode=WAL")
         self.db.execute("PRAGMA synchronous=NORMAL")
@@ -280,6 +302,10 @@ class Warehouse:
                 if col not in ccols:
                     self.db.execute("ALTER TABLE campaign_records "
                                     f"ADD COLUMN {col} TEXT")
+            # v6 -> v7 migration (ISSUE 20): the gen_compact /
+            # key_compact tables are covered by the CREATE IF NOT
+            # EXISTS above — no ALTERs; an existing warehouse upgrades
+            # in place and stays uncompacted until compact_ledger runs.
             # v5 -> v6 migration (ISSUE 18 satellite): runs.archived —
             # runs retired to _archive/ by `obs gc` stay queryable
             # (``obs sql``) with the dimension to tell them apart from
@@ -318,6 +344,42 @@ class Warehouse:
     def __exit__(self, *exc: Any) -> None:
         self.close()
 
+    # -- ingest batching (ISSUE 20 / ROADMAP 5a) -----------------------------
+
+    @contextmanager
+    def _txn(self) -> Any:
+        """One ingest unit's transaction boundary.  Standalone, this
+        is exactly the old ``with self.db:`` (commit on success, roll
+        the unit back on error).  Inside :meth:`batch` it is a no-op
+        participant — a nested ``with self.db:`` would COMMIT the
+        enclosing batch's work-in-progress on its own exit, which is
+        the sqlite footgun the depth counter exists to dodge."""
+        with self._lock:
+            if self._batch_depth > 0:
+                yield
+            else:
+                with self.db:
+                    yield
+
+    @contextmanager
+    def batch(self) -> Any:
+        """Group many ingest units into ONE transaction (one fsync,
+        one cursor flush) — the 100k-run ingest path.  Crash semantics
+        coarsen from per-unit to per-batch: a crash mid-batch rolls
+        the whole batch back and the next ingest redoes it, which the
+        byte cursors make idempotent.  Reentrant: a batch inside a
+        batch joins the outer transaction."""
+        with self._lock:
+            self._batch_depth += 1
+            try:
+                if self._batch_depth == 1:
+                    with self.db:
+                        yield
+                else:
+                    yield
+            finally:
+                self._batch_depth -= 1
+
     # -- ingest: byte-cursor jsonl core (campaign + fleet ledgers) -----------
 
     def _ingest_jsonl(self, path: str, base: str, *,
@@ -344,13 +406,13 @@ class Warehouse:
                 (rel,)).fetchone()
             cursor = row[0] if row else 0
             if size < cursor:
-                with self.db:
+                with self._txn():
                     wipe(rel)
                 cursor = 0
             if size == cursor:
                 return 0
             new = 0
-            with self.db, open(path, "rb") as f:
+            with self._txn(), open(path, "rb") as f:
                 f.seek(cursor)
                 for line in f:
                     if not line.endswith(b"\n"):
@@ -411,10 +473,20 @@ class Warehouse:
         prev = last_valid.get(key, _MISS)
         if prev is _MISS:
             row = self.db.execute(
-                "SELECT valid FROM campaign_records WHERE ledger = ? "
+                "SELECT id, valid FROM campaign_records WHERE ledger = ? "
                 "AND key = ? AND valid IS NOT NULL AND id < ? "
                 "ORDER BY id DESC LIMIT 1", (ledger, key, rid)).fetchone()
-            prev = _loads(row[0]) if row else _MISS
+            # compaction may have folded the key's raw history away
+            # (leaving at most witness-bearing rows): the folded last
+            # verdict lives in key_compact — prefer whichever is later
+            krow = self.db.execute(
+                "SELECT last_id, last_valid FROM key_compact "
+                "WHERE ledger = ? AND key = ?", (ledger, key)).fetchone()
+            if krow is not None and krow[1] is not None and \
+                    (row is None or krow[0] > row[0]):
+                prev = _loads(krow[1])
+            elif row is not None:
+                prev = _loads(row[1])
         if prev is not _MISS and prev != cur:
             self.db.execute(
                 "INSERT INTO flip_rollup(record_id, ledger, key, run, "
@@ -430,6 +502,12 @@ class Warehouse:
         the percentiles can't be maintained incrementally, so ingest
         re-derives them from ``record_spans`` (already in SQL) and the
         queries become single indexed lookups."""
+        # compacted generations' per-gen rollups are FROZEN — their
+        # raw record_spans are gone, so a recompute would lose them;
+        # the refresh only replaces the live gens' rows
+        compacted = {g for (g,) in self.db.execute(
+            "SELECT gen FROM gen_compact WHERE ledger = ?",
+            (ledger,)).fetchall()}
         for name in sorted(names):
             rows = self.db.execute(
                 "SELECT s.record_id, s.dur_s, r.gen FROM record_spans s "
@@ -439,9 +517,16 @@ class Warehouse:
             self.db.execute(
                 "DELETE FROM span_rollup WHERE ledger = ? AND name = ?",
                 (ledger, name))
-            self.db.execute(
-                "DELETE FROM span_gen_rollup WHERE ledger = ? "
-                "AND name = ?", (ledger, name))
+            if compacted:
+                self.db.execute(
+                    "DELETE FROM span_gen_rollup WHERE ledger = ? "
+                    "AND name = ? AND gen NOT IN (SELECT gen FROM "
+                    "gen_compact WHERE ledger = ?)",
+                    (ledger, name, ledger))
+            else:
+                self.db.execute(
+                    "DELETE FROM span_gen_rollup WHERE ledger = ? "
+                    "AND name = ?", (ledger, name))
             if not rows:
                 continue
             vals = [dur for _, dur, _ in rows]
@@ -463,28 +548,41 @@ class Warehouse:
                 "first_id, p95) VALUES (?, ?, ?, ?, ?)",
                 [(ledger, name, g, first[g],
                   round(_percentile(vs, 95), 6))
-                 for g, vs in by_gen.items()])
+                 for g, vs in by_gen.items() if g not in compacted])
 
     def _wipe_ledger(self, rel: str) -> None:
         for tbl in ("record_spans", "flip_rollup", "span_rollup",
-                    "span_gen_rollup"):
+                    "span_gen_rollup", "gen_compact", "key_compact"):
             self.db.execute(f"DELETE FROM {tbl} WHERE ledger = ?", (rel,))
         self.db.execute("DELETE FROM campaign_records WHERE ledger = ?",
                         (rel,))
         self.db.execute("DELETE FROM ledgers WHERE path = ?", (rel,))
 
     def _insert_record(self, ledger: str, rec: Dict[str, Any]) -> int:
+        # the id is allocated IN the insert, never below the persisted
+        # record_id_floor: sqlite's implicit rowid restarts at
+        # MAX(rowid)+1 of the rows *currently present*, so after
+        # compact_ledger drops a ledger's raw rows a fresh ingest
+        # would otherwise be handed ids BELOW the record_ids that
+        # flip_rollup / key_compact still reference — inverting
+        # ``ORDER BY key, record_id`` relative to jsonl append order
         w = rec.get("witness")
         phases = rec.get("phases")
         counters = rec.get("counters")
         cur = self.db.execute(
-            "INSERT INTO campaign_records(ledger, campaign, run, key, "
-            "workload, fault, seed, valid, error, degraded, deadline, "
-            "dir, ops, wall_s, gen, spec, ts, witness, trace, phases, "
-            "counters) "
-            "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, "
-            "?, ?, ?, ?, ?)",
-            (ledger, rec.get("campaign"), rec.get("run"), rec.get("key"),
+            "INSERT INTO campaign_records(id, ledger, campaign, run, "
+            "key, workload, fault, seed, valid, error, degraded, "
+            "deadline, dir, ops, wall_s, gen, spec, ts, witness, trace, "
+            "phases, counters) "
+            "VALUES (MAX((SELECT COALESCE(MAX(id), 0) "
+            "             FROM campaign_records), "
+            "            (SELECT COALESCE(CAST(value AS INTEGER), 0) "
+            "             FROM meta WHERE key = 'record_id_floor')) "
+            "        + 1, "
+            "?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, ?, "
+            "?, ?, ?)",
+            (ledger, rec.get("campaign"), rec.get("run"),
+             rec.get("key"),
              rec.get("workload"), rec.get("fault"),
              json.dumps(rec.get("seed")),
              json.dumps(rec["valid?"]) if "valid?" in rec else None,
@@ -573,7 +671,7 @@ class Warehouse:
             # the live rel is exactly the path minus the prefix)
             stale = (os.path.relpath(rel, "_archive")
                      if archived else None)
-            with self.db:
+            with self._txn():
                 for tbl in ("runs", "run_spans", "run_metrics",
                             "witnesses", "span_profile"):
                     self.db.execute(
@@ -827,7 +925,7 @@ class Warehouse:
                     evs.extend(read_events(p, spanning=False))
                 live_evs, new_cursor = self._read_incremental(live, 0)
                 evs.extend(live_evs)
-            with self.db:
+            with self._txn():
                 if not incremental:
                     self.db.execute("DELETE FROM events WHERE dir = ?",
                                     (rel,))
@@ -949,7 +1047,7 @@ class Warehouse:
             traces.append((origin, seg))
         if not rows:
             return 0
-        with self._lock, self.db:
+        with self._lock, self._txn():
             self.db.executemany(
                 "INSERT OR REPLACE INTO verifier_sessions(name, state, "
                 "valid, anomalies, txns, ops, segments, digest, "
@@ -1187,33 +1285,52 @@ class Warehouse:
 
     # -- ingest: whole store -------------------------------------------------
 
-    def ingest_store(self, base: str,
-                     events: bool = True) -> Dict[str, int]:
+    def ingest_store(self, base: str, events: bool = True,
+                     batch_units: int = 64) -> Dict[str, int]:
         """Incrementally ingest everything under a store dir: campaign
         ledgers, run dirs, and (optionally) event streams.  Re-running
-        on an unchanged store is a no-op."""
+        on an unchanged store is a no-op.
+
+        Units commit in batches of ``batch_units`` (ROADMAP 5a: one
+        transaction — one fsync, one cursor flush — per N ledgers/run
+        dirs instead of per unit), which is where the 100k-run ingest
+        speedup comes from; ``batch_units=1`` restores the old
+        per-unit commit behavior exactly."""
         from jepsen_tpu import store as store_mod
 
         stats = {"ledgers": 0, "records": 0, "runs": 0, "events": 0,
                  "sessions": 0, "fleet-events": 0, "archived": 0}
-        cdir = os.path.join(base, "campaigns")
-        if os.path.isdir(cdir):
-            for fn in sorted(os.listdir(cdir)):
-                if fn.endswith(".jsonl"):
-                    n = self.ingest_ledger(os.path.join(cdir, fn), base)
-                    stats["ledgers"] += 1
-                    stats["records"] += n
-        fdir = os.path.join(base, "fleet")
-        if os.path.isdir(fdir):
-            for fn in sorted(os.listdir(fdir)):
-                if fn.endswith(".jsonl"):
-                    stats["fleet-events"] += self.ingest_fleet_ledger(
-                        os.path.join(fdir, fn), base)
-        for d in store_mod.tests(base=base):
+        units: List[Any] = []
+
+        def ledger_unit(p: str) -> None:
+            stats["ledgers"] += 1
+            stats["records"] += self.ingest_ledger(p, base)
+
+        def fleet_unit(p: str) -> None:
+            stats["fleet-events"] += self.ingest_fleet_ledger(p, base)
+
+        def run_unit(d: str) -> None:
             if self.ingest_run_dir(d, base):
                 stats["runs"] += 1
             if events:
                 stats["events"] += self.ingest_events(d, base)
+
+        def archived_unit(d: str) -> None:
+            if self.ingest_run_dir(d, base, archived=True):
+                stats["archived"] += 1
+
+        cdir = os.path.join(base, "campaigns")
+        if os.path.isdir(cdir):
+            for fn in sorted(os.listdir(cdir)):
+                if fn.endswith(".jsonl"):
+                    units.append((ledger_unit, os.path.join(cdir, fn)))
+        fdir = os.path.join(base, "fleet")
+        if os.path.isdir(fdir):
+            for fn in sorted(os.listdir(fdir)):
+                if fn.endswith(".jsonl"):
+                    units.append((fleet_unit, os.path.join(fdir, fn)))
+        for d in store_mod.tests(base=base):
+            units.append((run_unit, d))
         # runs retired by `obs gc` (ISSUE 18 satellite): _archive/ has
         # the same <name>/<ts> layout, so the run-dir scan applies
         # as-is; rows land with archived = 1 (no event streams — those
@@ -1221,8 +1338,16 @@ class Warehouse:
         adir = store_mod.archive_dir(base)
         if os.path.isdir(adir):
             for d in store_mod.tests(base=adir):
-                if self.ingest_run_dir(d, base, archived=True):
-                    stats["archived"] += 1
+                units.append((archived_unit, d))
+        step = max(1, int(batch_units))
+        for i in range(0, len(units), step):
+            group = units[i:i + step]
+            if len(group) == 1:
+                group[0][0](group[0][1])
+            else:
+                with self.batch():
+                    for fn, arg in group:
+                        fn(arg)
         stats["sessions"] = self.ingest_verifier_sessions(base)
         return stats
 
@@ -1244,6 +1369,160 @@ class Warehouse:
             for tbl in _DATA_TABLES:
                 out[tbl] = self.db.execute(
                     f"SELECT COUNT(*) FROM {tbl}").fetchone()[0]
+        return out
+
+    # -- rollup compaction (ISSUE 20 / ROADMAP 5a) ---------------------------
+
+    def compact_ledger(self, path: str, base: str,
+                       keep_gens: int = 2) -> Dict[str, int]:
+        """Fold a campaign ledger's raw rows past the generation
+        horizon into bounded summary rows and DROP them.
+
+        Everything the compact-safe queries need survives exactly:
+        ``flip_rollup`` and ``span_gen_rollup`` rows are never touched
+        (flips / span_trend answer identically), witness-bearing
+        records are kept (witness_diffs answers identically), and
+        ``key_compact`` carries each key's last folded verdict so
+        future flip detection pairs across the horizon.  Everything
+        else (span_stats, latest_by_run, forensics, profile) loses its
+        raw rows — the Index falls back to the jsonl scan for those
+        once :meth:`ledger_compacted` is true.  The byte cursor is
+        untouched: re-ingesting a compacted, unchanged ledger stays a
+        no-op."""
+        rel = os.path.relpath(os.path.abspath(path),
+                              os.path.abspath(base))
+        stats = {"gens-compacted": 0, "dropped-records": 0,
+                 "dropped-spans": 0, "kept-witnesses": 0}
+        with self._lock, self._txn():
+            gens = self.db.execute(
+                "SELECT COALESCE(gen, '?'), MIN(id) "
+                "FROM campaign_records WHERE ledger = ? "
+                "GROUP BY COALESCE(gen, '?') ORDER BY MIN(id)",
+                (rel,)).fetchall()
+            if len(gens) <= max(0, int(keep_gens)):
+                return stats
+            kept = gens[len(gens) - int(keep_gens):] \
+                if keep_gens > 0 else []
+            cutoff = min(fid for _, fid in kept) if kept else \
+                self.db.execute(
+                    "SELECT COALESCE(MAX(id), 0) + 1 "
+                    "FROM campaign_records WHERE ledger = ?",
+                    (rel,)).fetchone()[0]
+            fold = [(g, fid) for g, fid in gens if fid < cutoff]
+            for g, fid in fold:
+                rows = self.db.execute(
+                    "SELECT valid, COUNT(*) FROM campaign_records "
+                    "WHERE ledger = ? AND COALESCE(gen, '?') = ? "
+                    "AND id < ? GROUP BY valid",
+                    (rel, g, cutoff)).fetchall()
+                verd: Dict[str, int] = {}
+                n_rec = 0
+                for valid, n in rows:
+                    n_rec += n
+                    if valid is None:
+                        k = "none"
+                    else:
+                        v = _loads(valid)
+                        k = ("true" if v is True else
+                             "false" if v is False else "unknown")
+                    verd[k] = verd.get(k, 0) + n
+                if not n_rec:
+                    continue
+                prior = self.db.execute(
+                    "SELECT first_id, records, verdicts FROM gen_compact "
+                    "WHERE ledger = ? AND gen = ?", (rel, g)).fetchone()
+                if prior is not None:
+                    old = json.loads(prior[2])
+                    for k, n in old.items():
+                        verd[k] = verd.get(k, 0) + n
+                    fid = min(fid, prior[0])
+                    n_rec += prior[1]
+                self.db.execute(
+                    "INSERT OR REPLACE INTO gen_compact(ledger, gen, "
+                    "first_id, records, verdicts) VALUES (?, ?, ?, ?, ?)",
+                    (rel, g, fid, n_rec, json.dumps(verd,
+                                                    sort_keys=True)))
+                stats["gens-compacted"] += 1
+            # each key's last folded verdict: the flip seed across the
+            # horizon (merged with any earlier compaction's entry —
+            # the new fold is always later)
+            krows = self.db.execute(
+                "SELECT r.key, r.valid, r.id FROM campaign_records r "
+                "JOIN (SELECT key, MAX(id) AS mid FROM campaign_records "
+                "      WHERE ledger = ? AND id < ? AND valid IS NOT "
+                "      NULL AND key IS NOT NULL AND key != '' "
+                "      GROUP BY key) t ON r.id = t.mid",
+                (rel, cutoff)).fetchall()
+            self.db.executemany(
+                "INSERT INTO key_compact(ledger, key, last_valid, "
+                "last_id) VALUES (?, ?, ?, ?) ON CONFLICT(ledger, key) "
+                "DO UPDATE SET last_valid = excluded.last_valid, "
+                "last_id = excluded.last_id",
+                [(rel, k, v, i) for k, v, i in krows])
+            stats["dropped-spans"] = self.db.execute(
+                "SELECT COUNT(*) FROM record_spans WHERE ledger = ? "
+                "AND record_id < ?", (rel, cutoff)).fetchone()[0]
+            self.db.execute(
+                "DELETE FROM record_spans WHERE ledger = ? "
+                "AND record_id < ?", (rel, cutoff))
+            stats["kept-witnesses"] = self.db.execute(
+                "SELECT COUNT(*) FROM campaign_records WHERE ledger = ? "
+                "AND id < ? AND witness IS NOT NULL",
+                (rel, cutoff)).fetchone()[0]
+            # pin the id floor BEFORE dropping rows: sqlite would
+            # otherwise hand the next ingest rowids below the
+            # record_ids flip_rollup / key_compact still reference
+            # (see _alloc_record_id)
+            top = self.db.execute(
+                "SELECT COALESCE(MAX(id), 0) FROM campaign_records"
+            ).fetchone()[0]
+            row = self.db.execute(
+                "SELECT value FROM meta WHERE key = 'record_id_floor'"
+            ).fetchone()
+            floor = max(top, int(row[0]) if row else 0)
+            self.db.execute(
+                "INSERT OR REPLACE INTO meta(key, value) VALUES "
+                "('record_id_floor', ?)", (str(floor),))
+            cur = self.db.execute(
+                "DELETE FROM campaign_records WHERE ledger = ? "
+                "AND id < ? AND witness IS NULL", (rel, cutoff))
+            stats["dropped-records"] = cur.rowcount
+        return stats
+
+    def ledger_compacted(self, rel: str) -> bool:
+        """True once :meth:`compact_ledger` folded anything for this
+        ledger — the per-query Index gate (compact-safe queries keep
+        the SQL fast path, the rest fall back to the jsonl scan)."""
+        with self._lock:
+            return self.db.execute(
+                "SELECT 1 FROM gen_compact WHERE ledger = ? LIMIT 1",
+                (rel,)).fetchone() is not None
+
+    def alert_signals(self) -> Dict[str, float]:
+        """The alert tick's warehouse leg: aggregates over ROLLUP
+        tables only (flip_rollup / span_gen_rollup / gen_compact) —
+        NEVER campaign_records or record_spans, so the tick costs the
+        same on a 100k-run store as on a 100-run one (the O(rollup
+        rows) acceptance pin, trace-asserted in tests)."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            n, reg = self.db.execute(
+                "SELECT COUNT(*), COALESCE(SUM(regression), 0) "
+                "FROM flip_rollup").fetchone()
+            out["flips"] = float(n)
+            out["flip-regressions"] = float(reg)
+            out["compacted-gens"] = float(self.db.execute(
+                "SELECT COUNT(*) FROM gen_compact").fetchone()[0])
+            rows = self.db.execute(
+                "SELECT s.name, s.p95 FROM span_gen_rollup s JOIN ("
+                "  SELECT ledger, name, MAX(first_id) AS mf "
+                "  FROM span_gen_rollup GROUP BY ledger, name) t "
+                "ON s.ledger = t.ledger AND s.name = t.name "
+                "AND s.first_id = t.mf").fetchall()
+        for name, p95 in rows:
+            if isinstance(p95, (int, float)):
+                key = f"span-p95-s:{name}"
+                out[key] = max(out.get(key, 0.0), float(p95))
         return out
 
     # -- SQL-backed campaign queries (Index fast paths) ----------------------

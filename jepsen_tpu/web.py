@@ -253,6 +253,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._verifier_list()
             if path.startswith("/verifier/"):
                 return self._verifier_session(path[len("/verifier/"):])
+            if path in ("/alerts", "/alerts/"):
+                return self._alerts_page()
             if path in ("/fleet", "/fleet/"):
                 return self._fleet_page()
             if path == "/fleet/status":
@@ -344,6 +346,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(500, {"error": str(e)})
 
     def _index(self):
+        from .telemetry import alerts as alerts_mod
         from .telemetry import stream as tel_stream
 
         rows = []
@@ -375,6 +378,8 @@ class _Handler(BaseHTTPRequestHandler):
             links.append('<a href="/verifier">verifier</a>')
         if self.fleet is not None:
             links.append('<a href="/fleet">fleet</a>')
+        if os.path.exists(alerts_mod.alerts_path(self.base)):
+            links.append('<a href="/alerts">alerts</a>')
         links.append('<a href="/metrics">metrics</a>')
         camp = "<p>" + " &middot; ".join(links) + "</p>"
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
@@ -1600,6 +1605,25 @@ td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
                 "<h3>managed workers</h3>"
                 "<table><tr><th>worker</th><th>version</th><th>pid</th>"
                 f"<th>state</th></tr>{arows or '<tr><td colspan=4>(none)</td></tr>'}</table>")
+            al = ap.get("alerts") or {}
+            alrows = "".join(
+                f"<tr><td><code>{html.escape(str(a.get('rule')))}"
+                "</code></td>"
+                f"<td>{html.escape(str(a.get('severity')))}</td>"
+                f"<td><b style=\"color:{'#b00' if a.get('state') == 'firing' else '#b60'}\">"
+                f"{html.escape(str(a.get('state')))}</b></td>"
+                f"<td>{a.get('value')}</td></tr>"
+                for a in al.get("active") or [])
+            ap_html += (
+                '<h3><a href="/alerts">alerts</a></h3>'
+                f"<p>{al.get('rules', 0)} rule(s) &middot; "
+                f"{len(al.get('firing') or [])} firing, "
+                f"{len(al.get('pending') or [])} pending &middot; "
+                f"notifications {al.get('sends-ok', 0)} ok / "
+                f"{al.get('sends-failed', 0)} failed &middot; journal "
+                f"<code>{html.escape(str(al.get('digest')))}</code></p>"
+                "<table><tr><th>rule</th><th>severity</th><th>state</th>"
+                f"<th>value</th></tr>{alrows or '<tr><td colspan=4>(quiet)</td></tr>'}</table>")
         name = str(s.get("campaign"))
         state = "finished" if s.get("finished") else "running"
         doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
@@ -1633,6 +1657,59 @@ completions discarded &middot; queue digest
 <table><tr><th>run</th><th>worker</th><th>deadline</th></tr>{lrows or
 '<tr><td colspan="3">(none)</td></tr>'}</table>
 {sched_html}
+</body></html>"""
+        self._send(200, doc.encode())
+
+    def _alerts_page(self):
+        """``/alerts`` (ISSUE 20): the watchtower view — every rule's
+        current state from the durable ``alerts.jsonl`` journal (replayed
+        read-only, so the page works on a dead store too), firing first."""
+        from .telemetry import alerts as alerts_mod
+
+        path = alerts_mod.alerts_path(self.base)
+        jr = alerts_mod.AlertJournal(path) if os.path.exists(path) \
+            else None
+        states = dict(jr.states) if jr is not None else {}
+        order = {"firing": 0, "pending": 1, "resolved": 2}
+
+        def _hist(rule):
+            return (f'<a href="/metrics">ALERTS{{alertname='
+                    f'&quot;{html.escape(rule)}&quot;}}</a>')
+
+        rows = "".join(
+            f"<tr><td><code>{html.escape(r)}</code></td>"
+            f"<td>{html.escape(str(d.get('severity')))}</td>"
+            f"<td><b style=\"color:"
+            f"{'#b00' if d.get('state') == 'firing' else '#b60' if d.get('state') == 'pending' else '#080'}\">"
+            f"{html.escape(str(d.get('state')))}</b></td>"
+            f"<td>{d.get('value')}</td>"
+            f"<td>{d.get('since')}</td>"
+            f"<td>{d.get('seq')}</td>"
+            f"<td>{_hist(r)}</td></tr>"
+            for r, d in sorted(
+                states.items(),
+                key=lambda kv: (order.get(kv[1].get("state"), 3),
+                                kv[0])))
+        meta = ""
+        if jr is not None:
+            meta = (f"<p>journal <code>{html.escape(jr.digest())}"
+                    "</code> &middot; notifications "
+                    f"{jr.sends_ok} ok / {jr.sends_failed} failed "
+                    f"&middot; <code>{html.escape(path)}</code></p>")
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>alerts</title><style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #bbb; padding: 4px 10px; }}
+{_BADGE_CSS}</style></head><body>
+<p><a href="/">&larr; runs</a>{' &middot; <a href="/fleet">fleet</a>'
+ if self.fleet is not None else ''} &middot;
+<a href="/metrics">metrics</a></p>
+<h1>alerts</h1>
+{meta}
+<table><tr><th>rule</th><th>severity</th><th>state</th><th>value</th>
+<th>since</th><th>seq</th><th>series</th></tr>{rows or
+'<tr><td colspan="7">(no alert journal yet)</td></tr>'}</table>
 </body></html>"""
         self._send(200, doc.encode())
 

@@ -337,6 +337,117 @@ def autopilot_chaos_round(seed: int, p: float = 0.35) -> dict:
             "generations": out["generations"]}
 
 
+def alerts_chaos_round(seed: int, p: float = 0.4) -> dict:
+    """Chaos on the watchtower's seams (ISSUE 20): a seeded FaultPlan
+    on ``alerts.evaluate`` + ``alerts.notify`` while the autopilot
+    drains generations with a rule pack guaranteed to fire (threshold
+    on the generations-closed gauge) and two sinks — a file sink and a
+    dead webhook.  The invariants: a failed evaluation tick or dead
+    webhook never wedges the loop (every generation still closes); an
+    independent journal replay reaches the identical alert-state
+    digest; notify intents are at-most-once per (rule, seq) so a
+    replayed engine re-fed the same breaching signals sends NOTHING
+    new; the file sink never holds more deliveries than journaled
+    intents."""
+    import json as _json
+    import tempfile as _tf
+    import threading as _th
+
+    from jepsen_tpu.fleet import Autopilot
+    from jepsen_tpu.resilience import FaultPlan, use
+    from jepsen_tpu.telemetry import alerts as alerts_mod
+
+    base = _tf.mkdtemp(prefix="fuzz-alerts-")
+    notif = os.path.join(base, "notifications.jsonl")
+    rules = alerts_mod.load_rules([
+        {"name": "gen-closed", "kind": "threshold", "severity": "info",
+         "signal": "gauge:fleet-autopilot-generations",
+         "op": ">=", "value": 1.0, "for": 0.0}])
+    sinks = [alerts_mod.FileSink(notif),
+             # nothing listens on the discard port: every webhook send
+             # dies in connect(), exercising the failure audit path
+             alerts_mod.WebhookSink("http://127.0.0.1:9/dead",
+                                    timeout=0.2)]
+    spec = {"name": "fuzz-alerts-ap", "workloads": ["bank"],
+            "seeds": [0, 1, 2], "opts": {"time-limit": 0.2}}
+    ap = Autopilot(spec, base, generations=2, spans=("workload",),
+                   poll_s=0.02, alert_rules=rules, alert_sinks=sinks)
+
+    def drain():
+        while not ap.stop.is_set():
+            code, out = ap.coordinator.claim({"worker": "syn"})
+            sp = out.get("spec") if code == 200 else None
+            if not sp:
+                time.sleep(0.01)
+                continue
+            key = (f'{sp["workload_label"]}|{sp["fault_label"]}'
+                   f'|s{sp["seed"]}')
+            ap.coordinator.complete({
+                "worker": "syn", "run": sp["run_id"],
+                "record": {"run": sp["run_id"], "key": key,
+                           "workload": sp["workload_label"],
+                           "fault": sp["fault_label"],
+                           "seed": sp["seed"], "valid?": True,
+                           "spans": {"workload": 0.1}}})
+
+    t = _th.Thread(target=drain, daemon=True)
+    t.start()
+    plan = FaultPlan(seed=seed, p=p, kinds=("oom", "stall"),
+                     stall_s=0.005,
+                     sites="alerts.evaluate|alerts.notify")
+    try:
+        with use(plan):
+            out = ap.run()
+    finally:
+        ap.stop.set()
+        t.join(timeout=5)
+        ap.coordinator.close()
+    assert out["generations"] == 2,         f"autopilot wedged under alert-seam chaos ({out})"
+
+    jpath = alerts_mod.alerts_path(base)
+    replay = alerts_mod.AlertJournal(jpath)
+    assert replay.digest() == ap.alerts.journal.digest(),         "alert journal replay diverged under seam chaos"
+
+    # at-most-once: each (rule, seq) transition journals its notify
+    # intent at most once, ever
+    intents: dict = {}
+    with open(jpath, "rb") as f:
+        for line in f:
+            try:
+                ev = _json.loads(line)
+            except ValueError:
+                continue
+            if ev.get("ev") == "notify":
+                k = (ev["rule"], ev["seq"])
+                intents[k] = intents.get(k, 0) + 1
+    assert intents and all(n == 1 for n in intents.values()),         f"duplicate notify intents under chaos: {intents}"
+
+    # the file sink can hold FEWER deliveries than intents (a faulted
+    # send is dropped, never retried past the policy) but never more
+    delivered = 0
+    if os.path.exists(notif):
+        with open(notif) as f:
+            delivered = sum(1 for ln in f if ln.strip())
+    assert delivered <= len(intents),         f"sink over-delivered: {delivered} > {len(intents)} intents"
+
+    # a replayed engine re-fed the same breaching signal must send
+    # nothing new: the journaled seq already covers the transition
+    class _Counting:
+        n = 0
+
+        def send(self, payload):
+            _Counting.n += 1
+
+    eng2 = alerts_mod.AlertEngine(base, rules=rules,
+                                  sinks=[_Counting()])
+    eng2.evaluate(signals={"gauge:fleet-autopilot-generations": 2.0})
+    assert _Counting.n == 0,         "engine double-fired after journal replay"
+    assert eng2.journal.digest() == replay.digest(),         "steady-state re-evaluation moved the digest"
+    return {"seed": seed, "injected": len(plan.injected),
+            "intents": len(intents), "delivered": delivered,
+            "webhook-failures": replay.sends_failed}
+
+
 def compilecache_chaos_round(seed: int, p: float = 0.5) -> dict:
     """Chaos on the AOT compile-cache seams (ISSUE 18): a seeded
     FaultPlan naming ``compilecache.load`` / ``.compile`` / ``.warm``
@@ -584,7 +695,30 @@ def main() -> int:
     ap.add_argument("--queue", action="store_true",
                     help="run the queue-family chaos rounds instead "
                          "(adversarial client sites + queue.check seam)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="run the watchtower seam-chaos rounds instead "
+                         "(alerts.evaluate/alerts.notify: no wedge, no "
+                         "double-fire after replay)")
     args = ap.parse_args()
+
+    if args.alerts:
+        t0 = time.time()
+        inj = intents = delivered = wf = 0
+        for seed in range(args.seed0, args.seed0 + args.rounds):
+            row = alerts_chaos_round(seed, max(args.p, 0.3))
+            inj += row["injected"]
+            intents += row["intents"]
+            delivered += row["delivered"]
+            wf += row["webhook-failures"]
+            print(f"seed {seed}: injected={row['injected']} "
+                  f"intents={row['intents']} "
+                  f"delivered={row['delivered']} "
+                  f"webhook-failures={row['webhook-failures']}")
+        print(f"\n{args.rounds} alert rounds in {time.time() - t0:.1f}s: "
+              f"{inj} seam faults injected, {intents} notify intents "
+              f"({delivered} delivered, {wf} webhook failures audited) "
+              "— no wedge, no double-fire, replay digest identical")
+        return 0
 
     if args.queue:
         t0 = time.time()

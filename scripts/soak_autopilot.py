@@ -20,16 +20,28 @@ The **parent** orchestrates the failure script:
   constructor digest must equal the parent's independent replay of
   the crashed journal).  It closes the resumed generation, catches the
   seeded regression (gate rc 1 -> quarantine -> REAL auto-shrink to a
-  witness), then flips ``worker_version`` v1 -> v2 and runs the last
-  generation through the rolling upgrade — one replacement at a time,
-  every cell landing, ``jepsen_fleet_host_info`` cardinality flat.
+  witness).  The watchtower's ``autopilot-gate-regression`` rule goes
+  pending -> firing on the autopilot's alert tick and the firing
+  notification lands in the FileSink — at which point the parent
+  ``kill -9``'s the host AGAIN, mid-firing;
+- child C (same ``--child b`` code path) resumes once more.  Its
+  alert-journal replay digest must equal the parent's independent
+  replay of the crashed ``alerts.jsonl``, and the already-journaled
+  notify intent must NOT re-send (zero duplicate notifications).  It
+  closes the remaining generations — the quarantine excludes the
+  regressed key, the gate goes green, the alert RESOLVES — then flips
+  ``worker_version`` v1 -> v2 and runs the last generation through
+  the rolling upgrade — one replacement at a time, every cell
+  landing, ``jepsen_fleet_host_info`` cardinality flat.
 
 The run FAILS unless: every admitted cell lands exactly one
 attributable verdict (done == cells, duplicates == 0), exactly one
 cell key is quarantined with a witness-bearing shrink outcome, the
-final journal replays to the child's reported digest, every surviving
-worker is v2, and the host_info series count is identical before and
-after the upgrade.
+final journal replays to the child's reported digest, the alert
+journal shows the full pending -> firing -> resolved arc with exactly
+one firing and one resolved notification line, every surviving worker
+is v2, and the host_info series count is identical before and after
+the upgrade.
 
 Usage::
 
@@ -48,6 +60,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import urllib.request
 
@@ -114,8 +127,13 @@ def mutate(i, sp):
 
 # ------------------------------------------------------------- child
 
+def notif_path(store):
+    return os.path.join(store, "alert-notifications.jsonl")
+
+
 def build(args, version):
     from jepsen_tpu.fleet import Autopilot
+    from jepsen_tpu.telemetry.alerts import FileSink
 
     return Autopilot(
         template(args.seed_list), args.store,
@@ -124,7 +142,8 @@ def build(args, version):
         coordinator_url=f"http://127.0.0.1:{args.port}",
         min_workers=2, max_workers=3, worker_version=version,
         scale_interval_s=0.25, worker_poll_s=0.05,
-        shrink_knobs={"probe-deadline": 15.0}, poll_s=0.05)
+        shrink_knobs={"probe-deadline": 15.0}, poll_s=0.05,
+        alert_sinks=[FileSink(notif_path(args.store))])
 
 
 def child_a(args) -> int:
@@ -145,10 +164,13 @@ def child_b(args) -> int:
     web.serve(args.port, args.store, fleet=ap.coordinator,
               background=True)
     url = f"http://127.0.0.1:{args.port}"
-    print(f"CHILD-B-RESUMED digest={ap.journal.digest()}", flush=True)
+    print(f"CHILD-B-RESUMED digest={ap.journal.digest()} "
+          f"alerts={ap.alerts.journal.digest()}", flush=True)
 
     # close every generation but the last (resumes the crashed one,
-    # then catches + quarantines + shrinks the seeded regression)
+    # then catches + quarantines + shrinks the seeded regression —
+    # the gate-regression alert fires on the closing step's alert
+    # tick, which is where the parent kill -9s phase b)
     while len(ap.journal.closed_labels()) < args.gens - 1:
         out = ap.step()
         print(f"CHILD-B-GEN {json.dumps(out, default=str)}",
@@ -156,10 +178,25 @@ def child_b(args) -> int:
         if out.get("stopped"):
             return 1
 
+    # warm the pool before taking the pre-upgrade cardinality
+    # baseline: a fresh resume (phase c skips the loop above) has no
+    # live workers yet, so host_info would read 0
+    warm = time.time() + 60.0
+    while time.time() < warm:
+        ap._scale_tick()
+        live = [n for n in ap._live_workers()
+                if not ap.workers[n]["draining"]]
+        if len(live) >= ap.min_workers \
+                and all(ap._worker_alive(n) for n in live) \
+                and host_info_series(url) == len(live):
+            break
+        time.sleep(0.25)
     pre = host_info_series(url)
-    ap.worker_version = "v2"  # the rolling upgrade rides the last gen
-    out = ap.step()
-    print(f"CHILD-B-GEN {json.dumps(out, default=str)}", flush=True)
+    if len(ap.journal.closed_labels()) < args.gens:
+        ap.worker_version = "v2"  # the rolling upgrade rides last gen
+        out = ap.step()
+        print(f"CHILD-B-GEN {json.dumps(out, default=str)}",
+              flush=True)
 
     # settle: tick the scaler until the pool is all-v2 per the
     # COORDINATOR's view and the old workers' series have retired
@@ -189,6 +226,7 @@ def child_b(args) -> int:
         "counts": ap.coordinator.queue.counts(),
         "host-info-pre": pre, "host-info-post": flat,
         "workers-final": finals,
+        "alerts": ap.alerts.status_doc(),
     }
     print(f"CHILD-B-SUMMARY {json.dumps(summary)}", flush=True)
     ap.close()
@@ -205,6 +243,34 @@ def wait_for(pred, deadline_s, what):
             return v
         time.sleep(0.05)
     raise SystemExit(f"FAIL: timed out waiting for {what}")
+
+
+def spawn_streaming(cmd, env):
+    """Run a child with stdout piped through to ours while a side
+    buffer keeps every line for post-hoc parsing (the parent polls
+    the control plane concurrently, so a blocking read won't do)."""
+    p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         text=True)
+    lines = []
+
+    def pump():
+        for line in p.stdout:
+            sys.stdout.write(line)
+            sys.stdout.flush()
+            lines.append(line)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return p, lines, t
+
+
+def parse_resumed(lines):
+    """(autopilot digest, alert digest) from a CHILD-B-RESUMED line."""
+    for line in lines:
+        if line.startswith("CHILD-B-RESUMED"):
+            toks = dict(t.split("=", 1) for t in line.split()[1:])
+            return toks.get("digest"), toks.get("alerts")
+    return None, None
 
 
 def kill_host(proc, pids):
@@ -249,6 +315,7 @@ def main() -> int:
 
     from jepsen_tpu.fleet import AutopilotJournal, WorkQueue, \
         autopilot_path, fleet_path
+    from jepsen_tpu.telemetry.alerts import AlertJournal, alerts_path
 
     base = args.store or tempfile.mkdtemp(prefix="soak-autopilot-")
     port = args.port or free_port()
@@ -286,30 +353,75 @@ def main() -> int:
 
     d_crash = AutopilotJournal(autopilot_path(NAME, base)).digest()
 
-    b = subprocess.Popen(cmd + ["--child", "b"], env=env,
-                         stdout=subprocess.PIPE, text=True)
-    summary, resumed = None, None
+    # phase b: resume, catch the seeded regression, quarantine; the
+    # parent waits for the gate-regression alert to go FIRING (and
+    # its notification line to land), then kill -9s the host again
+    b, blines, bt = spawn_streaming(cmd + ["--child", "b"], env)
     try:
-        for line in b.stdout:
-            sys.stdout.write(line)
-            sys.stdout.flush()
-            if line.startswith("CHILD-B-RESUMED"):
-                resumed = line.split("digest=")[1].strip()
-            if line.startswith("CHILD-B-SUMMARY "):
-                summary = json.loads(
-                    line.split("CHILD-B-SUMMARY ", 1)[1])
-        rc = b.wait(timeout=300)
+        def firing():
+            try:
+                st = http_json(url, "/fleet/status")
+            except OSError:
+                return None
+            al = (st.get("autopilot") or {}).get("alerts") or {}
+            if "autopilot-gate-regression" not in al.get("firing", []):
+                return None
+            try:
+                with open(notif_path(base)) as f:
+                    sent = [json.loads(l) for l in f if l.strip()]
+            except OSError:
+                return None
+            if any(n["alertname"] == "autopilot-gate-regression"
+                   and n["state"] == "firing" for n in sent):
+                return st
+            return None
+
+        st = wait_for(firing, 240, "gate-regression alert firing")
+        pids = [w["pid"] for w in
+                (st["autopilot"].get("workers") or {}).values()
+                if w.get("running")]
+        print(f"parent: killing host MID-FIRING "
+              f"(coordinator pid {b.pid} + workers {pids})",
+              flush=True)
+        kill_host(b, pids)
     except BaseException:
-        b.kill()
+        kill_host(b, [])
         raise
+    bt.join(timeout=10)
+    resumed, _ = parse_resumed(blines)
+
+    d_crash2 = AutopilotJournal(autopilot_path(NAME, base)).digest()
+    d_alert_crash = AlertJournal(alerts_path(base)).digest()
+
+    # phase c: resume mid-firing, close out (quarantine excludes the
+    # regressed key -> gate green -> alert RESOLVES), roll the upgrade
+    c, clines, ct = spawn_streaming(cmd + ["--child", "b"], env)
+    try:
+        rc = c.wait(timeout=300)
+    except BaseException:
+        c.kill()
+        raise
+    ct.join(timeout=10)
+    resumed_c, alerts_c = parse_resumed(clines)
+    summary = None
+    for line in clines:
+        if line.startswith("CHILD-B-SUMMARY "):
+            summary = json.loads(line.split("CHILD-B-SUMMARY ", 1)[1])
     if rc != 0 or summary is None:
-        print(f"FAIL: child B rc={rc}, summary={summary is not None}")
+        print(f"FAIL: child C rc={rc}, summary={summary is not None}")
         return 1
 
     fails = []
     if resumed != d_crash:
         fails.append(f"resume digest {resumed} != independent replay "
                      f"of the crashed journal {d_crash}")
+    if resumed_c != d_crash2:
+        fails.append(f"mid-firing resume digest {resumed_c} != "
+                     f"independent replay {d_crash2}")
+    if alerts_c != d_alert_crash:
+        fails.append(f"mid-firing alert digest {alerts_c} != "
+                     f"independent replay of the crashed alerts "
+                     f"journal {d_alert_crash}")
     d_final = AutopilotJournal(autopilot_path(NAME, base)).digest()
     if summary["digest"] != d_final:
         fails.append(f"final digest {summary['digest']} != replay "
@@ -349,6 +461,50 @@ def main() -> int:
             f"{summary['host-info-pre']} -> "
             f"{summary['host-info-post']} (workers {len(finals)})")
 
+    # the watchtower arc: the final alert journal replays to the
+    # child's reported digest, the gate-regression rule walked
+    # pending -> firing -> resolved, intents are at-most-once, and
+    # the FileSink carries exactly one firing + one resolved line
+    # despite the mid-firing kill -9
+    aj = AlertJournal(alerts_path(base))
+    al = summary.get("alerts") or {}
+    if al.get("digest") != aj.digest():
+        fails.append(f"final alert digest {al.get('digest')} != "
+                     f"replay {aj.digest()}")
+    if al.get("firing"):
+        fails.append(f"alerts still firing at end: {al['firing']}")
+    arc, intents = [], {}
+    with open(alerts_path(base)) as f:
+        for line in f:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # torn tail
+            if ev.get("rule") != "autopilot-gate-regression":
+                continue
+            if ev.get("ev") == "state":
+                arc.append(ev.get("state"))
+            elif ev.get("ev") == "notify":
+                k = (ev["rule"], ev["seq"])
+                intents[k] = intents.get(k, 0) + 1
+    if arc != ["pending", "firing", "resolved"]:
+        fails.append(f"gate-regression arc {arc} != "
+                     f"['pending', 'firing', 'resolved']")
+    if any(n > 1 for n in intents.values()):
+        fails.append(f"duplicate notify intents: {intents}")
+    sent = {}
+    with open(notif_path(base)) as f:
+        for line in f:
+            n = json.loads(line)
+            k = (n["alertname"], n["state"])
+            sent[k] = sent.get(k, 0) + 1
+    gr = "autopilot-gate-regression"
+    if sent.get((gr, "firing")) != 1 or sent.get((gr, "resolved")) != 1:
+        fails.append(f"notification lines for {gr}: {sent} — want "
+                     f"exactly one firing and one resolved")
+    if any(n > 1 for n in sent.values()):
+        fails.append(f"duplicate notifications delivered: {sent}")
+
     wall = time.time() - t_start
     if fails:
         for f in fails:
@@ -357,7 +513,8 @@ def main() -> int:
     print(f"SOAK PASS gens={len(summary['closed'])} "
           f"cells={c['cells']} duplicates={c['duplicates']} "
           f"quarantined={key} witness-ops={sk.get('witness-ops')} "
-          f"upgrade=v1->v2 "
+          f"alert-arc=pending->firing->resolved "
+          f"notifications={sum(sent.values())} upgrade=v1->v2 "
           f"host-info={summary['host-info-pre']}->"
           f"{summary['host-info-post']} wall={wall:.1f}s")
     if not args.store:

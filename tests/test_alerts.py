@@ -1,0 +1,508 @@
+"""The watchtower (ISSUE 20): declarative SLO/alert engine.
+
+Contracts under test:
+
+- **journal durability**: ``alerts.jsonl`` is the AutopilotJournal
+  discipline verbatim — fsync'd appends, torn-tail tolerance with
+  heal-once, independent replay to the identical state digest;
+- **state machine**: breach → pending, held ``for_s`` → firing,
+  clean → resolved; ``for_s == 0`` fires in the same tick with both
+  transitions journaled in order;
+- **at-most-once notification**: the journaled intent is the commit
+  point — a kill -9 between intent and send DROPS the delivery, a
+  replayed engine re-fed the same breaching signals never re-sends;
+- **signal collection**: registry gauges/counters (summed across
+  label sets), heartbeat ages, store byte watermarks, autopilot gate
+  state, warehouse rollups — each source best-effort;
+- **twin-pass parole** (satellite): a quarantined key whose witness
+  re-checks INVALID through its host twin is never paroled, however
+  many clean generations pass; twin-valid (device false positive)
+  paroles; the parole journal event stays replay-stable.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from jepsen_tpu.telemetry import alerts as alerts_mod
+from jepsen_tpu.telemetry.alerts import (
+    AlertEngine,
+    AlertJournal,
+    Rule,
+    alerts_path,
+    collect_signals,
+    load_rules,
+    stock_rules,
+)
+
+
+def _engine(base, rules, **kw):
+    return AlertEngine(str(base), rules=load_rules(rules), **kw)
+
+
+class _Capture:
+    """A sink that records every payload it is handed."""
+
+    def __init__(self, fail=False):
+        self.sent = []
+        self.fail = fail
+
+    def send(self, payload):
+        if self.fail:
+            raise ConnectionError("sink down")
+        self.sent.append(payload)
+
+
+# ------------------------------------------------------ rule parsing
+
+def test_rule_roundtrip_and_aliases():
+    r = Rule("x", kind="rate", severity="page", signal="gauge:g",
+             op=">=", value=2.5, for_s=7.0, window_s=30.0)
+    assert Rule.from_dict(r.to_dict()).to_dict() == r.to_dict()
+    # Prometheus-style spellings parse to the canonical fields
+    alias = Rule.from_dict({"name": "y", "for": 9.0, "window": 45.0})
+    assert alias.for_s == 9.0 and alias.window_s == 45.0
+
+
+def test_rule_validation_rejects_unknowns():
+    with pytest.raises(ValueError):
+        Rule("x", kind="nope")
+    with pytest.raises(ValueError):
+        Rule("x", severity="whatever")
+    with pytest.raises(ValueError):
+        Rule("x", op="!=")
+
+
+def test_stock_pack_covers_the_known_smells():
+    names = {r.name for r in stock_rules()}
+    assert {"campaign-heartbeat-stale", "fleet-claim-latency-p95-high",
+            "fleet-workers-alive-low", "quarantine-storm",
+            "autopilot-gate-regression", "autopilot-gate-rc2-streak",
+            "fleet-journal-bytes-growth", "worker-rss-watermark",
+            "compile-cache-fallthrough-rate"} == names
+
+
+def test_store_config_overrides_pack_and_declares_sinks(tmp_path):
+    with open(tmp_path / "alerts.json", "w") as f:
+        json.dump({"rules": [{"name": "only", "signal": "gauge:x",
+                              "value": 1.0}],
+                   "sinks": [{"file": "notes.jsonl"}]}, f)
+    eng = AlertEngine(str(tmp_path))
+    assert [r.name for r in eng.rules] == ["only"]
+    assert len(eng.sinks) == 1
+    # relative file sink lands inside the store
+    eng.evaluate(signals={"gauge:x": 5.0}, now=10.0)
+    assert os.path.exists(tmp_path / "notes.jsonl")
+
+
+def test_shipped_example_pack_matches_stock(tmp_path):
+    spec = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "specs", "alert-rules.json")
+    with open(spec) as f:
+        doc = json.load(f)
+    assert {r.name for r in load_rules(doc)} == \
+        {r.name for r in stock_rules()}
+
+
+# ---------------------------------------------------- state machine
+
+def test_threshold_debounce_pending_then_firing(tmp_path):
+    cap = _Capture()
+    eng = _engine(tmp_path, [{"name": "hot", "signal": "gauge:t",
+                              "op": ">", "value": 10.0, "for": 5.0}],
+                  sinks=[cap])
+    eng.evaluate(signals={"gauge:t": 11.0}, now=100.0)
+    assert eng.journal.states["hot"]["state"] == "pending"
+    assert not cap.sent  # pending never notifies
+    eng.evaluate(signals={"gauge:t": 12.0}, now=103.0)
+    assert eng.journal.states["hot"]["state"] == "pending"
+    eng.evaluate(signals={"gauge:t": 12.0}, now=105.0)
+    st = eng.journal.states["hot"]
+    assert st["state"] == "firing" and st["since"] == 105.0
+    assert [p["state"] for p in cap.sent] == ["firing"]
+    # resolve notifies exactly once, from firing only
+    eng.evaluate(signals={"gauge:t": 1.0}, now=106.0)
+    assert eng.journal.states["hot"]["state"] == "resolved"
+    assert [p["state"] for p in cap.sent] == ["firing", "resolved"]
+
+
+def test_for_zero_fires_same_tick_both_events_journaled(tmp_path):
+    eng = _engine(tmp_path, [{"name": "now", "signal": "gauge:t",
+                              "op": ">", "value": 0.0}], sinks=[])
+    eng.evaluate(signals={"gauge:t": 1.0}, now=50.0)
+    assert eng.journal.states["now"]["state"] == "firing"
+    kinds = []
+    with open(alerts_path(str(tmp_path)), "rb") as f:
+        for line in f:
+            ev = json.loads(line)
+            if ev.get("ev") == "state":
+                kinds.append(ev["state"])
+    assert kinds == ["pending", "firing"]
+
+
+def test_pending_resolves_quietly(tmp_path):
+    cap = _Capture()
+    eng = _engine(tmp_path, [{"name": "blip", "signal": "gauge:t",
+                              "op": ">", "value": 0.0, "for": 60.0}],
+                  sinks=[cap])
+    eng.evaluate(signals={"gauge:t": 1.0}, now=10.0)
+    eng.evaluate(signals={"gauge:t": 0.0}, now=11.0)
+    assert eng.journal.states["blip"]["state"] == "resolved"
+    assert not cap.sent  # a blip that never fired never notifies
+
+
+def test_absence_and_freshness_kinds(tmp_path):
+    eng = _engine(tmp_path, [
+        {"name": "gone", "kind": "absence", "signal": "gauge:must"},
+        {"name": "stale", "kind": "freshness",
+         "signal": "heartbeat:max-age-s", "value": 300.0}], sinks=[])
+    # absence breaches on a missing signal; freshness stays QUIET on
+    # one (an idle store must not page) and breaches only past the age
+    eng.evaluate(signals={}, now=10.0)
+    assert eng.journal.states["gone"]["state"] == "firing"
+    assert "stale" not in eng.journal.states
+    eng.evaluate(signals={"gauge:must": 1.0,
+                          "heartbeat:max-age-s": 301.0}, now=20.0)
+    assert eng.journal.states["gone"]["state"] == "resolved"
+    assert eng.journal.states["stale"]["state"] == "firing"
+
+
+def test_rate_rule_needs_covered_window_then_breaches(tmp_path):
+    eng = _engine(tmp_path, [{"name": "surge", "kind": "rate",
+                              "signal": "counter:c", "op": ">",
+                              "value": 1.0, "window": 10.0}],
+                  sinks=[])
+    # growth of 50/10s = 5/s, but the window is not yet covered:
+    # a fresh engine must not alert off two early samples
+    eng.evaluate(signals={"counter:c": 0.0}, now=100.0)
+    eng.evaluate(signals={"counter:c": 50.0}, now=102.0)
+    assert "surge" not in eng.journal.states
+    eng.evaluate(signals={"counter:c": 150.0}, now=111.0)
+    assert eng.journal.states["surge"]["state"] == "firing"
+    # flat signal over a full window resolves
+    eng.evaluate(signals={"counter:c": 150.0}, now=122.0)
+    assert eng.journal.states["surge"]["state"] == "resolved"
+
+
+def test_rate_window_restarts_after_replay(tmp_path):
+    rules = [{"name": "surge", "kind": "rate", "signal": "counter:c",
+              "op": ">", "value": 1.0, "window": 10.0}]
+    eng = _engine(tmp_path, rules, sinks=[])
+    eng.evaluate(signals={"counter:c": 0.0}, now=100.0)
+    eng.evaluate(signals={"counter:c": 200.0}, now=110.5)
+    assert eng.journal.states["surge"]["state"] == "firing"
+    # the sample ring is derived state, never journaled: a restarted
+    # engine needs a fresh covered window before it can re-breach —
+    # the conservative side — but the journaled FIRING state survives
+    eng2 = _engine(tmp_path, rules, sinks=[])
+    assert eng2.journal.states["surge"]["state"] == "firing"
+    assert eng2._samples == {}
+
+
+# ------------------------------------------------- journal durability
+
+def test_journal_replay_identical_digest(tmp_path):
+    eng = _engine(tmp_path, [{"name": "a", "signal": "gauge:x",
+                              "op": ">", "value": 0.0}], sinks=[])
+    eng.evaluate(signals={"gauge:x": 1.0}, now=10.0)
+    eng.evaluate(signals={"gauge:x": 0.0}, now=20.0)
+    eng.evaluate(signals={"gauge:x": 2.0}, now=30.0)
+    replay = AlertJournal(alerts_path(str(tmp_path)))
+    assert replay.digest() == eng.journal.digest()
+    assert replay.states == eng.journal.states
+
+
+def test_torn_tail_ignored_then_healed_on_next_append(tmp_path):
+    eng = _engine(tmp_path, [{"name": "a", "signal": "gauge:x",
+                              "op": ">", "value": 0.0}], sinks=[])
+    eng.evaluate(signals={"gauge:x": 1.0}, now=10.0)
+    good = eng.journal.digest()
+    path = alerts_path(str(tmp_path))
+    with open(path, "ab") as f:
+        f.write(b'{"ev": "state", "rule": "a", "state": "resol')
+    # the torn tail is invisible to replay...
+    j2 = AlertJournal(path)
+    assert j2.digest() == good
+    # ...and the next append through that journal truncates it first
+    j2.transition(Rule("a", signal="gauge:x"), "resolved", 0.0,
+                  at=20.0)
+    j3 = AlertJournal(path)
+    assert j3.states["a"]["state"] == "resolved"
+    assert j3.digest() == j2.digest()
+
+
+def test_notify_intent_is_at_most_once_across_replay(tmp_path):
+    rules = [{"name": "a", "signal": "gauge:x", "op": ">",
+              "value": 0.0}]
+    cap = _Capture()
+    eng = _engine(tmp_path, rules, sinks=[cap])
+    eng.evaluate(signals={"gauge:x": 1.0}, now=10.0)
+    assert len(cap.sent) == 1
+    # a replayed engine re-fed the same breaching signal: state is
+    # already firing at the journaled seq -> nothing new to send
+    cap2 = _Capture()
+    eng2 = _engine(tmp_path, rules, sinks=[cap2])
+    eng2.evaluate(signals={"gauge:x": 1.0}, now=20.0)
+    assert not cap2.sent
+    assert eng2.journal.digest() == eng.journal.digest()
+
+
+def test_failed_sink_audited_not_fatal_and_not_retried(tmp_path):
+    dead = _Capture(fail=True)
+    live = _Capture()
+    eng = _engine(tmp_path, [{"name": "a", "signal": "gauge:x",
+                              "op": ">", "value": 0.0}],
+                  sinks=[dead, live])
+    eng.evaluate(signals={"gauge:x": 1.0}, now=10.0)
+    # the dead sink never blocks the live one; the failure is audited
+    assert len(live.sent) == 1
+    assert eng.journal.sends_failed >= 1 and eng.journal.sends_ok == 1
+    # audit counters are observability, NOT state: replay digest
+    # matches even though notify-result events differ per delivery
+    assert AlertJournal(alerts_path(str(tmp_path))).digest() == \
+        eng.journal.digest()
+
+
+def test_kill9_mid_firing_replays_identical_no_duplicate(tmp_path):
+    """The acceptance criterion's crash seam, in miniature: SIGKILL a
+    process that journaled the firing transition + notify intent, then
+    replay — identical digest, and re-evaluation sends nothing new."""
+    store = tmp_path / "store"
+    notif = tmp_path / "notif.jsonl"
+    prog = textwrap.dedent(f"""
+        import os, signal
+        from jepsen_tpu.telemetry import alerts as A
+        eng = A.AlertEngine({str(store)!r}, rules=A.load_rules(
+            [{{"name": "a", "signal": "gauge:x", "op": ">",
+               "value": 0.0}}]),
+            sinks=[A.FileSink({str(notif)!r})])
+        eng.evaluate(signals={{"gauge:x": 1.0}}, now=10.0)
+        print("FIRED", eng.journal.digest(), flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", prog],
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    word, digest = proc.stdout.split()
+    assert word == "FIRED"
+    replay = AlertJournal(alerts_path(str(store)))
+    assert replay.digest() == digest
+    assert replay.states["a"]["state"] == "firing"
+    with open(notif) as f:
+        n0 = sum(1 for ln in f if ln.strip())
+    assert n0 == 1
+    # the restarted engine re-fed the same breach: zero new deliveries
+    eng = AlertEngine(str(store), rules=load_rules(
+        [{"name": "a", "signal": "gauge:x", "op": ">", "value": 0.0}]),
+        sinks=[alerts_mod.FileSink(str(notif))])
+    eng.evaluate(signals={"gauge:x": 1.0}, now=20.0)
+    with open(notif) as f:
+        assert sum(1 for ln in f if ln.strip()) == n0
+    assert eng.journal.digest() == digest
+
+
+# ----------------------------------------------------------- signals
+
+def test_registry_signals_sum_label_sets(tmp_path):
+    from jepsen_tpu.telemetry import metrics
+
+    reg = metrics.Registry()
+    reg.gauge("fleet-cells", state="queued").set(4)
+    reg.gauge("fleet-cells", state="done").set(6)
+    reg.counter("compile-cache-fallthrough", site="a").inc(2)
+    reg.counter("compile-cache-fallthrough", site="b").inc(3)
+    out = collect_signals(str(tmp_path), registry=reg, now=100.0)
+    assert out["gauge:fleet-cells"] == 10.0
+    assert out["counter:compile-cache-fallthrough"] == 5.0
+    assert out["store:fleet-bytes"] == 0.0
+
+
+def test_heartbeat_signals_ages_and_max(tmp_path):
+    cdir = tmp_path / "campaigns"
+    os.makedirs(cdir)
+    with open(cdir / "soak.live.json", "w") as f:
+        json.dump({"campaign": "soak", "updated": 900.0, "total": 10,
+                   "done": 4, "finished": False}, f)
+    with open(cdir / "old.live.json", "w") as f:
+        json.dump({"campaign": "old", "updated": 100.0, "done": 9,
+                   "total": 9, "finished": True}, f)
+    from jepsen_tpu.telemetry import metrics
+
+    out = collect_signals(str(tmp_path), registry=metrics.Registry(),
+                          now=1000.0)
+    assert out["heartbeat:soak:age-s"] == 100.0
+    assert out["heartbeat:soak:done"] == 4.0
+    assert out["heartbeat:old:finished"] == 1.0
+    # finished campaigns never drive max-age (they are DONE, not stale)
+    assert out["heartbeat:max-age-s"] == 100.0
+
+
+def test_autopilot_gate_signals(tmp_path):
+    from jepsen_tpu.fleet import AutopilotJournal
+
+    j = AutopilotJournal(str(tmp_path / "ap.jsonl"))
+    j.open_gen("g0000", runs=3)
+    j.close_gen("g0000", [{"span": "workload", "rc": 2}])
+    j.open_gen("g0001", runs=3)
+    j.close_gen("g0001", [{"span": "workload", "rc": 1,
+                           "key": "k", "status": "regression"}])
+    out = {}
+    alerts_mod._autopilot_signals(out, j)
+    assert out["autopilot:gate-regression"] == 1.0
+    assert out["autopilot:gate-rc2-streak"] == 0.0
+    assert out["autopilot:quarantined-active"] == 0.0
+
+
+# --------------------------------------------------- twin-pass parole
+
+SPEC = {"name": "twin", "workloads": ["bank"], "seeds": [0],
+        "opts": {"time-limit": 0.2}}
+
+
+def _quarantined_ap(tmp_path, digest):
+    """An autopilot whose journal holds one quarantined key with a
+    shrink outcome carrying `digest` (None = shrink had no witness)."""
+    from jepsen_tpu.fleet import Autopilot
+
+    ap = Autopilot(SPEC, str(tmp_path / "store"), generations=1,
+                   poll_s=0.02)
+    key = "bank|nofault|s0"
+    ap.journal.open_gen("g0000", runs=1)
+    ap.journal.close_gen("g0000", [])
+    ap.journal.quarantine(key, gen="g0000", span="workload")
+    outcome = {"run": "r0"}
+    if digest is not None:
+        outcome["digest"] = digest
+    ap.journal.shrink(key, gen="g0000", outcome=outcome)
+    return ap, key
+
+
+def _witnessed_run(ap, key, history, tmp_path):
+    """Archive `history` as the key's witness run: witness artifacts
+    on disk + the index record the autopilot's shrink would append."""
+    from jepsen_tpu.minimize import witness as witness_mod
+
+    run_dir = str(tmp_path / "store" / "runs" / "r0")
+    digest = witness_mod.history_digest(history)[:16]
+    witness_mod.save_witness(run_dir, history, {"target": "any"})
+    with ap.coordinator._lock:
+        ap.coordinator.idx.append(
+            {"run": "r0", "key": key, "dir": "runs/r0",
+             "witness": {"digest": digest, "ops": len(history)}})
+    return digest
+
+
+def test_twin_pass_allows_parole_on_valid_witness(tmp_path):
+    from jepsen_tpu.workloads import synth
+
+    h = synth.la_history(n_txns=15, n_keys=3, concurrency=3, seed=1)
+    ap, key = _quarantined_ap(tmp_path, None)
+    try:
+        digest = _witnessed_run(ap, key, h, tmp_path)
+        ap.journal.shrink(key, gen="g0000",
+                          outcome={"digest": digest})
+        allowed, twin = ap._witness_twin_check(key)
+        assert allowed is True
+        assert twin["valid?"] is True and twin["digest"] == digest
+    finally:
+        ap.close()
+
+
+def test_twin_fail_denies_parole_on_real_anomaly(tmp_path):
+    from jepsen_tpu.workloads import synth
+
+    h = synth.la_history(n_txns=15, n_keys=3, concurrency=3, seed=2)
+    assert synth.inject_wr_cycle(h)
+    ap, key = _quarantined_ap(tmp_path, None)
+    try:
+        digest = _witnessed_run(ap, key, h, tmp_path)
+        ap.journal.shrink(key, gen="g0000",
+                          outcome={"digest": digest})
+        allowed, twin = ap._witness_twin_check(key)
+        assert allowed is False
+        assert twin["valid?"] is False
+        # the verdict is cached per digest: a second ask is identical
+        assert ap._witness_twin_check(key) == (allowed, twin)
+    finally:
+        ap.close()
+
+
+def test_twin_missing_witness_denies_conservatively(tmp_path):
+    ap, key = _quarantined_ap(tmp_path, "feedbeefcafe0000")
+    try:
+        allowed, twin = ap._witness_twin_check(key)
+        assert allowed is False
+        assert "error" in twin
+    finally:
+        ap.close()
+
+
+def test_no_witness_digest_keeps_plain_criterion(tmp_path):
+    # perf-only regressions shrink to nothing: no digest in the
+    # outcome -> the clean-generations criterion stands alone
+    ap, key = _quarantined_ap(tmp_path, None)
+    try:
+        assert ap._witness_twin_check(key) == (True, None)
+    finally:
+        ap.close()
+
+
+def test_parole_event_with_twin_field_is_replay_stable(tmp_path):
+    from jepsen_tpu.fleet import AutopilotJournal
+
+    path = str(tmp_path / "ap.jsonl")
+    j = AutopilotJournal(path)
+    j.open_gen("g0000", runs=1)
+    j.close_gen("g0000", [])
+    j.quarantine("k", gen="g0000", span="workload")
+    j.parole("k", gen="g0000",
+             twin={"digest": "abc", "valid?": True})
+    with open(path) as f:
+        evs = [json.loads(ln) for ln in f if ln.strip()]
+    assert any(e.get("ev") == "parole" and e.get("twin")
+               for e in evs)
+    # replay applies key/gen alone: a journal WITHOUT the twin field
+    # reaches the identical digest
+    stripped = str(tmp_path / "stripped.jsonl")
+    with open(stripped, "w") as f:
+        for e in evs:
+            e.pop("twin", None)
+            f.write(json.dumps(e, sort_keys=True) + "\n")
+    assert AutopilotJournal(stripped).digest() == \
+        AutopilotJournal(path).digest()
+
+
+# ------------------------------------------------------ status + web
+
+def test_status_doc_shape(tmp_path):
+    eng = _engine(tmp_path, [{"name": "a", "signal": "gauge:x",
+                              "op": ">", "value": 0.0, "for": 60.0}],
+                  sinks=[])
+    eng.evaluate(signals={"gauge:x": 1.0}, now=10.0)
+    doc = eng.status_doc()
+    assert doc["pending"] == ["a"] and doc["firing"] == []
+    assert doc["active"][0]["rule"] == "a"
+    assert doc["rules"] == 1 and "digest" in doc
+
+
+def test_exposition_renders_only_active_alerts(tmp_path):
+    from jepsen_tpu.telemetry import metrics, prometheus as prom
+
+    eng = _engine(tmp_path, [{"name": "a", "signal": "gauge:x",
+                              "op": ">", "value": 0.0}], sinks=[])
+    eng.evaluate(signals={"gauge:x": 1.0}, now=10.0)
+    expo = prom.exposition(base=str(tmp_path),
+                           registry=metrics.Registry(), now=11.0)
+    assert ('ALERTS{alertname="a",severity="warn",state="firing"} 1'
+            in expo)
+    eng.evaluate(signals={"gauge:x": 0.0}, now=12.0)
+    expo = prom.exposition(base=str(tmp_path),
+                           registry=metrics.Registry(), now=13.0)
+    assert "ALERTS{" not in expo

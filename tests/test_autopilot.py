@@ -253,6 +253,14 @@ def test_quarantine_parole_readmits_then_requarantines(
         "ops": 3, "source-ops": 12, "digest": "abc123",
         "anomaly-types": ["G-single"], "probes": 5, "cached": 1,
         "fault-windows": []})
+    # the shrink above is synthetic (no witness on disk): stand in a
+    # passing host-twin verdict so the parole path itself is exercised
+    # (the twin gate has its own denial tests below)
+    monkeypatch.setattr(
+        Autopilot, "_twin_recheck",
+        lambda self, key, digest: (True, {"digest": digest,
+                                          "checker": "stub",
+                                          "valid?": True}))
     base = str(tmp_path / "store")
     ap = Autopilot(SPEC, base, generations=6, spans=("workload",),
                    poll_s=0.02, parole_after=2)
@@ -385,7 +393,10 @@ def test_host_info_series_pinned_to_alive_versioned_workers():
 
 def test_soak_autopilot_fast():
     """The unattended acceptance: generations streamed, a seeded
-    regression gate-caught -> quarantined -> auto-shrunk, coordinator
+    regression gate-caught -> quarantined -> auto-shrunk, the
+    gate-regression alert walking pending -> firing -> resolved with a
+    second kill -9 landing MID-FIRING (alert journal replays to the
+    identical digest, zero duplicate notifications), coordinator
     kill -9 resume with zero duplicate cells, rolling worker upgrade
     with flat /metrics cardinality."""
     script = os.path.join(os.path.dirname(__file__), "..",
@@ -400,3 +411,4 @@ def test_soak_autopilot_fast():
     assert "SOAK PASS" in out.stdout
     assert "duplicates=0" in out.stdout
     assert "quarantined=" in out.stdout
+    assert "alert-arc=pending->firing->resolved" in out.stdout

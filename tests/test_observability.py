@@ -199,7 +199,22 @@ def test_federation_cardinality_flat_under_worker_churn(tmp_path):
     from jepsen_tpu.fleet import coordinator as coord_mod
     from jepsen_tpu.telemetry import prometheus as prom
 
+    from jepsen_tpu.telemetry import alerts as alerts_mod
+
     coord = _mk_coordinator(tmp_path, lease_s=0.05)
+    # an alert engine churning fire→resolve alongside the workers
+    # (ISSUE 20 satellite): ALERTS series exist only while
+    # pending/firing and retire on resolve — the exposition never
+    # grows with the number of alerts that EVER fired
+    eng = alerts_mod.AlertEngine(str(tmp_path), rules=alerts_mod.load_rules([
+        {"name": "churn-alert", "kind": "threshold", "severity": "warn",
+         "signal": "gauge:churn-x", "op": ">", "value": 0.5,
+         "for": 0.0}]), sinks=[])
+
+    def _n_alert_series(expo):
+        return sum(1 for ln in expo.splitlines()
+                   if ln.startswith("ALERTS{"))
+
     counts = []
     for gen in range(6):
         name = f"churn-{gen}"
@@ -210,7 +225,17 @@ def test_federation_cardinality_flat_under_worker_churn(tmp_path):
                           {"name": "worker-rss-peak-bytes",
                            "kind": "gauge", "labels": {},
                            "value": 1000 + gen}])
-        expo = prom.exposition(base=str(tmp_path), fleet=coord)
+        now = 100.0 + 10.0 * gen
+        eng.evaluate(signals={"gauge:churn-x": 1.0}, now=now)
+        expo = prom.exposition(base=str(tmp_path), fleet=coord,
+                               now=now)
+        assert _n_alert_series(expo) == 1, expo
+        assert ('ALERTS{alertname="churn-alert",severity="warn",'
+                'state="firing"} 1') in expo
+        eng.evaluate(signals={"gauge:churn-x": 0.0}, now=now + 1.0)
+        expo = prom.exposition(base=str(tmp_path), fleet=coord,
+                               now=now + 1.0)
+        assert _n_alert_series(expo) == 0, expo
         counts.append(sum(1 for ln in expo.splitlines()
                           if ln.startswith("jepsen_fleet_host_")
                           and not ln.startswith("#")))

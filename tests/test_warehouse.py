@@ -263,6 +263,199 @@ def test_1k_campaign_speedup_10x(tmp_path):
         f"({t_scan / t_sql:.1f}x, need >= 10x)"
 
 
+# ---------------------------------- batching + compaction (ISSUE 20)
+
+def test_batched_ingest_equivalent_and_fewer_commits(tmp_path):
+    """ROADMAP 5a: batch_units groups N ingest units into ONE sqlite
+    transaction.  Equivalence (tables + query results identical to the
+    per-unit path) and economy (commit count shrinks with the batch)
+    are both pinned."""
+    for sub in ("a", "b"):
+        b = tmp_path / sub
+        os.makedirs(b)
+        _write_ledger(b, name="s1", n=8, flip_every=3, seed=1)
+        _write_ledger(b, name="s2", n=8, flip_every=3, seed=2)
+        _write_ledger(b, name="s3", n=8, flip_every=3, seed=3)
+
+    def commits(wh, fn):
+        seen = []
+        wh.db.set_trace_callback(
+            lambda s: seen.append(s) if "COMMIT" in s.upper() else None)
+        try:
+            fn()
+        finally:
+            wh.db.set_trace_callback(None)
+        return len(seen)
+
+    wa = wmod.open_or_create(str(tmp_path / "a"))
+    wb = wmod.open_or_create(str(tmp_path / "b"))
+    na = commits(wa, lambda: wa.ingest_store(str(tmp_path / "a"),
+                                             events=False,
+                                             batch_units=1))
+    nb = commits(wb, lambda: wb.ingest_store(str(tmp_path / "b"),
+                                             events=False,
+                                             batch_units=64))
+    assert wa.counts() == wb.counts()
+    for name in ("s1", "s2", "s3"):
+        pa = os.path.join(str(tmp_path / "a"), "campaigns",
+                          name + ".jsonl")
+        pb = os.path.join(str(tmp_path / "b"), "campaigns",
+                          name + ".jsonl")
+        assert Index(pa).flips() == Index(pb).flips()
+    assert nb < na, (na, nb)
+    # both paths leave the cursors flush: re-ingest is a no-op
+    again = wb.ingest_store(str(tmp_path / "b"), events=False)
+    assert again["records"] == 0
+
+
+def test_compaction_parity_for_safe_queries(tmp_path):
+    """Folding old generations into gen_compact/key_compact must not
+    change what flips/span_trend/witness_diffs answer (rollups are
+    never touched), while raw rows below the horizon are dropped and
+    witness-bearing records survive."""
+    path = _write_ledger(tmp_path, gens=("g1", "g2", "g3", "g4"),
+                         n=30, scale={"g4": 1.3}, flip_every=7,
+                         witness_every=10)
+    wh = _fresh(tmp_path, path)
+    idx = Index(path)
+    before = (idx.flips(), idx.span_trend("check:la"),
+              idx.witness_diffs())
+    n_before = wh.counts()["campaign_records"]
+
+    stats = wh.compact_ledger(path, str(tmp_path), keep_gens=2)
+    assert stats["gens-compacted"] == 2
+    assert stats["dropped-records"] > 0
+    assert stats["kept-witnesses"] > 0
+    rel = os.path.relpath(path, str(tmp_path))
+    assert wh.ledger_compacted(rel)
+    assert wh.counts()["campaign_records"] < n_before
+
+    idx2 = Index(path)
+    after = (idx2.flips(), idx2.span_trend("check:la"),
+             idx2.witness_diffs())
+    assert after == before
+    # ...and all three still match the raw jsonl scan
+    scan = Index(path, use_warehouse=False)
+    assert after == (scan.flips(), scan.span_trend("check:la"),
+                     scan.witness_diffs())
+    # the safe set answers from SQL; everything else falls back to
+    # the scan (still identical — the jsonl is untouched)
+    assert idx2._warehouse("flips") is not None
+    assert idx2._warehouse("span_stats") is None
+    assert idx2.span_stats() == scan.span_stats()
+    # compaction never moves the byte cursor: re-ingest is a no-op
+    again = wh.ingest_store(str(tmp_path), events=False)
+    assert again["records"] == 0
+
+
+def test_flip_detection_across_compaction_horizon(tmp_path):
+    """A key's last verdict below the horizon lives only in
+    key_compact; a NEW record flipping against it must still roll up
+    as a flip, identically to the jsonl scan (which sees every raw
+    line)."""
+    path = _write_ledger(tmp_path, gens=("g1", "g2"), n=12,
+                         flip_every=5)
+    wh = _fresh(tmp_path, path)
+    wh.compact_ledger(path, str(tmp_path), keep_gens=0)
+    assert wh.counts()["campaign_records"] == 0
+    # append g3 flipping every 4th key against its g2 verdict
+    _write_ledger(tmp_path, gens=("g3",), n=12, flip_every=4)
+    wh.ingest_store(str(tmp_path), events=False)
+    assert Index(path).flips() ==         Index(path, use_warehouse=False).flips()
+
+
+def test_alert_signals_touch_rollup_tables_only(tmp_path):
+    """THE O(rollup rows) pin: the alert tick's warehouse leg may not
+    read campaign_records or record_spans — trace-asserted, so a
+    future 'quick join' cannot quietly make the tick O(runs)."""
+    path = _write_ledger(tmp_path, gens=("g1", "g2"), n=25,
+                         flip_every=6)
+    wh = _fresh(tmp_path, path)
+    stmts = []
+    wh.db.set_trace_callback(stmts.append)
+    try:
+        sig = wh.alert_signals()
+    finally:
+        wh.db.set_trace_callback(None)
+    for s in stmts:
+        low = s.lower()
+        assert "campaign_records" not in low, s
+        assert "record_spans" not in low, s
+    assert sig["flips"] > 0
+    assert sig["span-p95-s:check:la"] > 0
+    # compaction only shifts rows between tables the signals already
+    # aggregate — the answers survive it
+    wh.compact_ledger(path, str(tmp_path), keep_gens=1)
+    sig2 = wh.alert_signals()
+    assert sig2["flips"] == sig["flips"]
+    assert sig2["compacted-gens"] == 1.0
+
+
+def test_100k_store_speedup_compacted(tmp_path):
+    """THE ISSUE 20 acceptance criterion: a synthetic 100k-run store —
+    batched ingest, compacted rollups — answers flips + span_trend +
+    the alert-signal query >= 10x faster than the jsonl scan with
+    identical results; re-ingest is a digest no-op; the alert tick
+    stays O(rollup rows).  (Timing is interleaved best-of like the 1k
+    pin, so ambient suite load hits both paths alike.)"""
+    cdir = tmp_path / "campaigns"
+    os.makedirs(cdir)
+    path = str(cdir / "big.jsonl")
+    rng = random.Random(0)
+    with open(path, "w") as f:
+        for gen in ("g1", "g2"):
+            for i in range(50000):
+                f.write(json.dumps({
+                    "campaign": "big", "run": f"r-{gen}-{i}",
+                    "key": f"la|none|{i % 500}", "workload": "la",
+                    "fault": None, "seed": i,
+                    "valid?": not (gen == "g2" and i % 97 == 0),
+                    "dir": f"d/{gen}/{i}", "ops": 100, "wall_s": 9.0,
+                    "gen": gen, "ts": "2026-08-03T00:00:00Z",
+                    "spans": {
+                        "check:la": round(rng.uniform(0.9, 1.1), 6),
+                        "workload": round(rng.uniform(1, 3), 6),
+                    }}) + "\n")
+    wh = wmod.open_or_create(str(tmp_path))
+    stats = wh.ingest_store(str(tmp_path), events=False)
+    assert stats["records"] == 100000
+    wh.compact_ledger(path, str(tmp_path), keep_gens=1)
+
+    def scan():
+        idx = Index(path, use_warehouse=False)
+        return idx.flips(), idx.span_trend("check:la")
+
+    def sql():
+        idx = Index(path)
+        return idx.flips(), idx.span_trend("check:la")
+
+    assert scan() == sql()
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    t_scan = min(timed(scan) for _ in range(3))
+    t_sql = float("inf")
+    for _ in range(3):
+        timed(scan)  # interleave: noise hits both paths alike
+        t_sql = min(t_sql, timed(sql))
+    assert t_scan >= 10 * t_sql, \
+        f"scan {t_scan * 1e3:.2f}ms vs sql {t_sql * 1e3:.2f}ms " \
+        f"({t_scan / t_sql:.1f}x, need >= 10x)"
+    # the alert tick is rollup-bounded: orders of magnitude under the
+    # scan even on the 100k store
+    t_alert = min(timed(wh.alert_signals) for _ in range(3))
+    assert t_alert * 10 <= t_sql + t_scan, \
+        f"alert tick {t_alert * 1e3:.2f}ms is not O(rollup rows)"
+    sig = wh.alert_signals()
+    assert sig["flips"] > 0 and sig["compacted-gens"] == 1.0
+    # batched ingest left every cursor flush: the re-ingest is a no-op
+    again = wh.ingest_store(str(tmp_path), events=False)
+    assert again["records"] == 0 and again["ledgers"] == 1
+
+
 # ------------------------------------------------- run dirs + rebuild
 
 def _mk_run(base, name, ts, valid=True, telemetry=True, witness=False,
@@ -925,6 +1118,19 @@ def _golden_exposition(base):
     wh.ingest_bench({"metric": "check-throughput", "value": 277000.0,
                      "unit": "ops/s", "n_txns": 1000000,
                      "backend": "cpu"}, "BENCH_r05.json")
+    # the watchtower (ISSUE 20): one firing + one pending alert in the
+    # durable journal -> literal ALERTS{...} series on the exposition
+    # (deterministic: state comes from the injected evaluation `now`)
+    from jepsen_tpu.telemetry import alerts as alerts_mod
+
+    eng = alerts_mod.AlertEngine(str(base), rules=alerts_mod.load_rules([
+        {"name": "claim-latency-blowout", "kind": "threshold",
+         "severity": "page", "signal": "gauge:x", "op": ">",
+         "value": 0.0, "for": 0.0},
+        {"name": "journal-growth", "kind": "threshold",
+         "severity": "warn", "signal": "gauge:x", "op": ">",
+         "value": 0.0, "for": 3600.0}]), sinks=[])
+    eng.evaluate(signals={"gauge:x": 1.0}, now=990.0)
     return prometheus.exposition(base=str(base), registry=reg,
                                  now=1000.0, fleet=_GoldenFleet())
 
